@@ -1,0 +1,28 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A from-scratch rebuild of the capability surface of Tendermint Core
+(reference: /root/reference, v0.35.0-unreleased): BFT consensus, authenticated
+P2P gossip, mempool, evidence, block/state sync, light clients, ABCI
+application boundary, RPC, and validator key management — with the
+per-height vote-signature verification hot path (VerifyCommit /
+VerifyCommitLight and the light-client header loop) offloaded to batched,
+fixed-shape JAX/Pallas kernels on TPU behind the `crypto.batch` seam.
+
+Layout (mirrors the reference layer map in SURVEY.md §1, redesigned TPU-first):
+  crypto/    key/signature/hash abstractions + host implementations
+  ops/       TPU compute path: limb field arithmetic, curve ops, batched verify
+  parallel/  device-mesh sharding of the verification batch (pjit/shard_map)
+  wire/      deterministic protobuf encoding (sign bytes are consensus-critical)
+  types/     Block/Vote/ValidatorSet/Commit + commit verification
+  abci/      application boundary
+  storage/   key-value, block and state stores
+  mempool/   priority mempool + gossip
+  consensus/ the BFT state machine, WAL, replay
+  p2p/       router, peer manager, transports, secret connection
+  light/     light client verification
+  privval/   validator key management (file + remote signers)
+  rpc/       JSON-RPC service
+  node/      composition root
+"""
+
+__version__ = "0.1.0"

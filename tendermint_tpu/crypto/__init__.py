@@ -1,0 +1,90 @@
+"""Crypto abstractions: keys, signatures, batch verification.
+
+Reference parity: crypto/crypto.go:22-54 — PubKey / PrivKey / BatchVerifier
+interfaces, Address = SHA256(pubkey)[:20]. Implementations register
+themselves in KEY_TYPES so proto codecs and JSON can round-trip key types
+(the reference does this with libs/json type registry + crypto/encoding).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+from typing import Dict, List, Tuple, Type
+
+from . import tmhash
+
+ADDRESS_SIZE = tmhash.TRUNCATED_SIZE
+
+
+def address_hash(data: bytes) -> bytes:
+    """Address of raw key bytes: first 20 bytes of SHA-256 (crypto/crypto.go:8-20)."""
+    return tmhash.sum_truncated(data)
+
+
+class PubKey(abc.ABC):
+    @abc.abstractmethod
+    def address(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    @abc.abstractmethod
+    def type(self) -> str: ...
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PubKey)
+            and self.type() == other.type()
+            and self.bytes() == other.bytes()
+        )
+
+    def __hash__(self):
+        return hash((self.type(), self.bytes()))
+
+    def __repr__(self):
+        return f"PubKey{self.type().capitalize()}{{{self.bytes().hex().upper()}}}"
+
+
+class PrivKey(abc.ABC):
+    @abc.abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def type(self) -> str: ...
+
+
+class BatchVerifier(abc.ABC):
+    """Accumulate (pubkey, msg, sig) triples, verify all at once.
+
+    Reference parity: crypto/crypto.go:44-54. verify() returns
+    (all_valid, per_entry_validity) like curve25519-voi's BatchVerifier.
+    """
+
+    @abc.abstractmethod
+    def add(self, key: PubKey, msg: bytes, sig: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def verify(self) -> Tuple[bool, List[bool]]: ...
+
+
+# key-type registry: type name -> (PubKey class, pubkey byte size)
+KEY_TYPES: Dict[str, Tuple[Type[PubKey], int]] = {}
+
+
+def register_key_type(name: str, pubkey_cls: Type[PubKey], size: int) -> None:
+    KEY_TYPES[name] = (pubkey_cls, size)
+
+
+def c_reader_random(n: int) -> bytes:
+    """Cryptographic randomness (crypto/random.go CReader)."""
+    return os.urandom(n)

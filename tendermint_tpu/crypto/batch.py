@@ -1,0 +1,69 @@
+"""Batch-verifier dispatch — the seam where the TPU engine plugs in.
+
+Reference parity: crypto/batch/batch.go:11-33 — CreateBatchVerifier /
+SupportsBatchVerifier keyed on pubkey type; ed25519 and sr25519 batch,
+secp256k1 does not.
+
+The default ed25519 batch verifier here is the device-backed one from
+tendermint_tpu.ops (JAX: TPU when available, CPU otherwise). Its semantics
+are *per-signature* cofactored ZIP-215 verification evaluated in a single
+fixed-shape vmapped kernel — deterministic, and exactly equal to the
+reference's single-verify semantics (the reference's random-linear-
+combination batch accepts the same set except with negligible probability;
+on failure it too falls back to per-signature checks, ed25519.go:225-227).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from . import BatchVerifier, PubKey
+from . import ed25519 as _ed25519
+from . import _edwards
+
+
+class Ed25519HostBatchVerifier(BatchVerifier):
+    """Host-only fallback: per-signature ZIP-215 via the OpenSSL fast path."""
+
+    def __init__(self):
+        self._entries: List[Tuple[bytes, bytes, bytes]] = []
+
+    def add(self, key: PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(key, _ed25519.PubKey):
+            raise TypeError("pubkey is not ed25519")
+        if len(sig) != _ed25519.SIGNATURE_SIZE:
+            raise ValueError("invalid signature length")
+        self._entries.append((key.bytes(), msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        valid = [
+            _ed25519.verify_zip215_fast(pub, msg, sig) for pub, msg, sig in self._entries
+        ]
+        return all(valid) and len(valid) > 0, valid
+
+
+_device_verifier_factory = None
+
+
+def use_device_engine(factory) -> None:
+    """Install the device (TPU) batch-verifier factory. Called by
+    tendermint_tpu.ops on import; kept injectable for tests."""
+    global _device_verifier_factory
+    _device_verifier_factory = factory
+
+
+def create_batch_verifier(pk: PubKey) -> Optional[BatchVerifier]:
+    """crypto/batch/batch.go:11-24. Returns None if unsupported."""
+    if pk.type() == _ed25519.KEY_TYPE:
+        if _device_verifier_factory is not None:
+            return _device_verifier_factory()
+        return Ed25519HostBatchVerifier()
+    # sr25519 batch lands with the sr25519 key type; secp256k1 never batches.
+    return None
+
+
+def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
+    """crypto/batch/batch.go:26-33."""
+    if pk is None:
+        return False
+    return pk.type() == _ed25519.KEY_TYPE

@@ -1,0 +1,156 @@
+"""RFC-6962-style Merkle trees and proofs.
+
+Reference parity: crypto/merkle/tree.go (HashFromByteSlices, leaf/inner
+prefixes 0x00/0x01, split at largest power of two < n) and
+crypto/merkle/proof.go (Proof with index/total/leaf_hash/aunts,
+ProofOperator chains for multi-store proofs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+MAX_AUNTS = 100  # proof.go: maxAunts
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def split_point(length: int) -> int:
+    """Largest power of 2 strictly less than length (tree.go:92-103)."""
+    if length < 1:
+        raise ValueError("length must be >= 1")
+    bit_len = (length - 1).bit_length()
+    k = 1 << (bit_len - 1) if bit_len > 0 else 1
+    if k == length:
+        k >>= 1
+    return max(k, 1) if length > 1 else 0
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root of the list (tree.go:11-29). Empty list hashes to
+    SHA256("")."""
+    n = len(items)
+    if n == 0:
+        return _sha256(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = split_point(n)
+    left = hash_from_byte_slices(items[:k])
+    right = hash_from_byte_slices(items[k:])
+    return inner_hash(left, right)
+
+
+class Proof:
+    """Merkle inclusion proof (crypto/merkle/proof.go:23-35)."""
+
+    __slots__ = ("total", "index", "leaf_hash", "aunts")
+
+    def __init__(self, total: int, index: int, leaf_hash_: bytes, aunts: List[bytes]):
+        self.total = total
+        self.index = index
+        self.leaf_hash = leaf_hash_
+        self.aunts = aunts
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        """Raise ValueError unless this proves `leaf` at index under root
+        (proof.go:59-79)."""
+        if self.total < 0:
+            raise ValueError("proof total must be positive")
+        if self.index < 0:
+            raise ValueError("proof index cannot be negative")
+        lh = leaf_hash(leaf)
+        if lh != self.leaf_hash:
+            raise ValueError("invalid leaf hash")
+        computed = self.compute_root_hash()
+        if computed != root_hash:
+            raise ValueError("invalid root hash")
+
+    def compute_root_hash(self) -> Optional[bytes]:
+        return _compute_hash_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    """proof.go:137-168."""
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    if not aunts:
+        return None
+    k = split_point(total)
+    if index < k:
+        left = _compute_hash_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> Tuple[bytes, List[Proof]]:
+    """Root hash + one proof per item (proof.go:87-103)."""
+    trails, root = _trails_from_byte_slices(list(items))
+    root_hash = root.hash
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(Proof(len(items), i, trail.hash, trail.flatten_aunts()))
+    return root_hash, proofs
+
+
+class _ProofNode:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None  # left sibling (aunt) node
+        self.right = None  # right sibling (aunt) node
+
+    def flatten_aunts(self) -> List[bytes]:
+        aunts: List[bytes] = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: List[bytes]) -> Tuple[List[_ProofNode], _ProofNode]:
+    n = len(items)
+    if n == 0:
+        return [], _ProofNode(_sha256(b""))
+    if n == 1:
+        trail = _ProofNode(leaf_hash(items[0]))
+        return [trail], trail
+    k = split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _ProofNode(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root

@@ -1,0 +1,123 @@
+"""secp256k1 ECDSA keys.
+
+Reference parity: crypto/secp256k1/secp256k1.go and secp256k1_nocgo.go —
+  - PubKey is 33-byte compressed SEC1; Address = RIPEMD160(SHA256(pub)) (:141-153)
+  - Sign: ECDSA over SHA256(msg), 64-byte R||S, lower-S form (nocgo:20-32)
+  - VerifySignature rejects non-lower-S signatures (nocgo:34-54)
+  - No batch support (crypto/batch/batch.go:26-33) — stays host-side in the
+    TPU build as well.
+
+Backed by the `cryptography` OpenSSL binding; lower-S normalization and the
+64-byte wire format are handled here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+
+from . import PrivKey as _PrivKey, PubKey as _PubKey, register_key_type
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33
+PRIV_KEY_SIZE = 32
+SIGNATURE_LENGTH = 64
+
+PUB_KEY_NAME = "tendermint/PubKeySecp256k1"
+PRIV_KEY_NAME = "tendermint/PrivKeySecp256k1"
+
+# Curve order of secp256k1.
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_CURVE = ec.SECP256K1()
+
+
+class PubKey(_PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        sha = hashlib.sha256(self._bytes).digest()
+        return hashlib.new("ripemd160", sha).digest()
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_LENGTH:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if r <= 0 or s <= 0 or r >= _N:
+            return False
+        if s > _N // 2:  # reject non-lower-S (nocgo:35,41-44)
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self._bytes)
+            pub.verify(
+                encode_dss_signature(r, s),
+                hashlib.sha256(msg).digest(),
+                ec.ECDSA(Prehashed(hashes.SHA256())),
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+class PrivKey(_PrivKey):
+    __slots__ = ("_bytes", "_sk")
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        d = int.from_bytes(data, "big")
+        if not (0 < d < _N):
+            raise ValueError("invalid secp256k1 scalar")
+        self._sk = ec.derive_private_key(d, _CURVE)
+
+    def sign(self, msg: bytes) -> bytes:
+        digest = hashlib.sha256(msg).digest()
+        der = self._sk.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:  # normalize to lower-S
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKey:
+        pub = self._sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        return PubKey(pub)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    while True:
+        cand = os.urandom(PRIV_KEY_SIZE)
+        d = int.from_bytes(cand, "big")
+        if 0 < d < _N:
+            return PrivKey(cand)
+
+
+register_key_type(KEY_TYPE, PubKey, PUB_KEY_SIZE)

@@ -1,0 +1,19 @@
+"""SHA-256 hashing, full and 20-byte truncated.
+
+Reference parity: crypto/tmhash/hash.go — Sum (32B) and SumTruncated (20B,
+used for addresses: crypto/crypto.go:8-20).
+"""
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+BLOCK_SIZE = 64
+
+
+def sum_sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

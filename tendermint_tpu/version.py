@@ -1,0 +1,13 @@
+"""Version constants.
+
+Reference parity: version/version.go:13,22-27 — the wire protocol versions
+must match so artifacts (blocks, handshakes) are interoperable in shape.
+"""
+
+TM_CORE_SEM_VER = "0.35.0-tpu"
+ABCI_SEM_VER = "0.17.0"
+ABCI_VERSION = ABCI_SEM_VER
+
+# Protocol versions (uint64 on the wire).
+P2P_PROTOCOL = 8
+BLOCK_PROTOCOL = 11

@@ -1,0 +1,16 @@
+"""Test harness: force the CPU backend with an 8-device virtual mesh so
+multi-chip sharding (pjit/shard_map over a Mesh) is exercised without TPU
+hardware. Mirrors the reference's "multi-node without a cluster" pattern
+(in-memory p2p transport, SURVEY.md §4) at the device level.
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

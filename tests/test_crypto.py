@@ -1,0 +1,204 @@
+"""Crypto layer tests: ed25519 (incl. ZIP-215 edge cases), secp256k1,
+merkle, tmhash. Differential oracle checks mirror the reference's
+crypto/ed25519/ed25519_test.go and crypto/merkle/tree_test.go coverage."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import _edwards, batch, ed25519, merkle, secp256k1, tmhash
+
+
+class TestEdwardsOracle:
+    def test_base_point_order(self):
+        # [L]B == identity, [L-1]B != identity
+        assert _edwards.is_identity(_edwards.scalar_mult(_edwards.L, _edwards.BASE))
+        assert not _edwards.is_identity(
+            _edwards.scalar_mult(_edwards.L - 1, _edwards.BASE)
+        )
+
+    def test_compress_roundtrip(self):
+        for k in (1, 2, 7, 12345, _edwards.L - 1):
+            pt = _edwards.scalar_mult(k, _edwards.BASE)
+            enc = _edwards.compress(pt)
+            back = _edwards.decompress(enc)
+            assert back is not None
+            assert _edwards.point_equal(pt, back)
+
+    def test_pure_sign_matches_openssl(self):
+        seed = bytes(range(32))
+        sk = ed25519.gen_priv_key(seed)
+        msg = b"tendermint-tpu"
+        assert _edwards.sign(seed, msg) == sk.sign(msg)
+        assert _edwards.pubkey_from_seed(seed) == sk.pub_key().bytes()
+
+    def test_oracle_accepts_valid_rejects_forged(self):
+        seed = hashlib.sha256(b"k1").digest()
+        sk = ed25519.gen_priv_key(seed)
+        pub = sk.pub_key().bytes()
+        msg = b"a vote"
+        sig = sk.sign(msg)
+        assert _edwards.verify_zip215(pub, msg, sig)
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not _edwards.verify_zip215(pub, msg, bytes(bad))
+        assert not _edwards.verify_zip215(pub, b"other msg", sig)
+
+    def test_rejects_noncanonical_s(self):
+        seed = hashlib.sha256(b"k2").digest()
+        sk = ed25519.gen_priv_key(seed)
+        msg = b"m"
+        sig = bytearray(sk.sign(msg))
+        s = int.from_bytes(sig[32:], "little")
+        sig[32:] = (s + _edwards.L).to_bytes(32, "little")
+        assert not _edwards.verify_zip215(sk.pub_key().bytes(), msg, bytes(sig))
+
+    def test_small_order_pubkey_accepted(self):
+        # ZIP-215 accepts small-order A. Identity point pubkey: y=1, x=0.
+        ident_enc = (1).to_bytes(32, "little")
+        # With A = O, equation is [8]([s]B - R) == O; pick s=0, R=O.
+        sig = ident_enc + (0).to_bytes(32, "little")
+        assert _edwards.verify_zip215(ident_enc, b"anything", sig)
+
+    def test_noncanonical_point_encoding_accepted(self):
+        # y = p + 1 encodes the same point as y = 1 (identity) but
+        # non-canonically; ZIP-215 accepts it, strict RFC8032 would not.
+        nc = (_edwards.P + 1).to_bytes(32, "little")
+        assert _edwards.decompress(nc) is not None
+        assert _edwards.decompress(nc, allow_noncanonical=False) is None
+        sig = (1).to_bytes(32, "little") + (0).to_bytes(32, "little")
+        assert _edwards.verify_zip215(nc, b"x", sig)
+
+    def test_torsion_points_exist_and_verify_structure(self):
+        # order-4 point: x = +-sqrt(-1), y = 0
+        x = _edwards.SQRT_M1
+        pt = (x, 0, 1, 0)
+        p2 = _edwards.point_double(pt)
+        p4 = _edwards.point_double(p2)
+        assert not _edwards.is_identity(p2)
+        assert _edwards.is_identity(p4)
+
+
+class TestEd25519Keys:
+    def test_sign_verify(self):
+        sk = ed25519.gen_priv_key()
+        msg = b"hello consensus"
+        sig = sk.sign(msg)
+        assert len(sig) == 64
+        assert sk.pub_key().verify_signature(msg, sig)
+        assert not sk.pub_key().verify_signature(msg + b"!", sig)
+        assert not sk.pub_key().verify_signature(msg, sig[:-1])
+
+    def test_address(self):
+        sk = ed25519.gen_priv_key(bytes(32))
+        addr = sk.pub_key().address()
+        assert addr == hashlib.sha256(sk.pub_key().bytes()).digest()[:20]
+        assert len(addr) == 20
+
+    def test_privkey_format_seed_pub(self):
+        seed = hashlib.sha256(b"fmt").digest()
+        sk = ed25519.gen_priv_key(seed)
+        raw = sk.bytes()
+        assert len(raw) == 64
+        assert raw[:32] == seed
+        assert raw[32:] == sk.pub_key().bytes()
+
+    def test_zip215_vs_openssl_divergence_handled(self):
+        # small-order key rejected by OpenSSL but accepted by our ZIP-215 path
+        ident_enc = (1).to_bytes(32, "little")
+        sig = ident_enc + (0).to_bytes(32, "little")
+        pk = ed25519.PubKey(ident_enc)
+        assert pk.verify_signature(b"m", sig)
+
+
+class TestSecp256k1:
+    def test_sign_verify_lower_s(self):
+        sk = secp256k1.gen_priv_key()
+        msg = b"tx bytes"
+        sig = sk.sign(msg)
+        assert len(sig) == 64
+        pk = sk.pub_key()
+        assert pk.verify_signature(msg, sig)
+        # flip to upper-S: must be rejected
+        r = sig[:32]
+        s = int.from_bytes(sig[32:], "big")
+        upper = r + (secp256k1._N - s).to_bytes(32, "big")
+        assert not pk.verify_signature(msg, upper)
+        assert not pk.verify_signature(b"other", sig)
+
+    def test_address_is_ripemd160_sha256(self):
+        sk = secp256k1.gen_priv_key()
+        pk = sk.pub_key()
+        expect = hashlib.new("ripemd160", hashlib.sha256(pk.bytes()).digest()).digest()
+        assert pk.address() == expect
+        assert len(pk.address()) == 20
+
+
+class TestMerkle:
+    def test_empty(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+    def test_single_leaf(self):
+        assert merkle.hash_from_byte_slices([b"abc"]) == hashlib.sha256(
+            b"\x00abc"
+        ).digest()
+
+    def test_rfc6962_structure(self):
+        # two leaves: inner(leaf(a), leaf(b))
+        la = hashlib.sha256(b"\x00a").digest()
+        lb = hashlib.sha256(b"\x00b").digest()
+        expect = hashlib.sha256(b"\x01" + la + lb).digest()
+        assert merkle.hash_from_byte_slices([b"a", b"b"]) == expect
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33])
+    def test_proofs_verify(self, n):
+        items = [bytes([i]) * (i + 1) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            proof.verify(root, items[i])
+            with pytest.raises(ValueError):
+                proof.verify(root, b"wrong leaf")
+
+    def test_split_point(self):
+        assert merkle.split_point(2) == 1
+        assert merkle.split_point(3) == 2
+        assert merkle.split_point(4) == 2
+        assert merkle.split_point(5) == 4
+        assert merkle.split_point(8) == 4
+        assert merkle.split_point(9) == 8
+
+
+class TestBatchDispatch:
+    def test_supports(self):
+        ed = ed25519.gen_priv_key().pub_key()
+        sec = secp256k1.gen_priv_key().pub_key()
+        assert batch.supports_batch_verifier(ed)
+        assert not batch.supports_batch_verifier(sec)
+        assert not batch.supports_batch_verifier(None)
+
+    def test_host_batch_verifier(self):
+        bv = batch.Ed25519HostBatchVerifier()
+        keys = [ed25519.gen_priv_key() for _ in range(4)]
+        msgs = [f"msg {i}".encode() for i in range(4)]
+        for sk, m in zip(keys, msgs):
+            bv.add(sk.pub_key(), m, sk.sign(m))
+        ok, valid = bv.verify()
+        assert ok and valid == [True] * 4
+
+        bv2 = batch.Ed25519HostBatchVerifier()
+        for i, (sk, m) in enumerate(zip(keys, msgs)):
+            sig = sk.sign(m)
+            if i == 2:
+                sig = sig[:-1] + bytes([sig[-1] ^ 0xFF])
+            bv2.add(sk.pub_key(), m, sig)
+        ok, valid = bv2.verify()
+        assert not ok
+        assert valid == [True, True, False, True]
+
+
+class TestTmhash:
+    def test_sizes(self):
+        assert len(tmhash.sum_sha256(b"x")) == 32
+        assert len(tmhash.sum_truncated(b"x")) == 20
+        assert tmhash.sum_truncated(b"x") == tmhash.sum_sha256(b"x")[:20]
