@@ -57,8 +57,12 @@ def _sqrt_ratio(u: int, v: int) -> Optional[int]:
 def decompress(s: bytes, allow_noncanonical: bool = True) -> Optional[Point]:
     """Decode a 32-byte point encoding -> extended point, or None.
 
-    ZIP-215 mode (allow_noncanonical=True) skips the y < p canonicity check;
-    the x = 0 with sign bit 1 case still fails (RFC 8032 §5.1.3 step 4).
+    ZIP-215 mode (allow_noncanonical=True) follows curve25519-dalek's
+    decompression (which ZIP 215 specifies and curve25519-voi implements):
+    the y < p canonicity check is skipped AND the RFC 8032 §5.1.3 step-4
+    rule ("x = 0 with sign bit 1 fails") is dropped — a conditional negate
+    of x = 0 is a no-op, so "negative zero" encodings decode to x = 0.
+    Strict mode applies both RFC 8032 checks.
     """
     if len(s) != 32:
         return None
@@ -73,7 +77,7 @@ def decompress(s: bytes, allow_noncanonical: bool = True) -> Optional[Point]:
     x = _sqrt_ratio(u, v)
     if x is None:
         return None
-    if x == 0 and sign:
+    if x == 0 and sign and not allow_noncanonical:
         return None
     if (x & 1) != sign:
         x = P - x

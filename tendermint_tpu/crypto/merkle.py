@@ -8,17 +8,14 @@ ProofOperator chains for multi-store proofs).
 
 from __future__ import annotations
 
-import hashlib
 from typing import List, Optional, Sequence, Tuple
+
+from .tmhash import SIZE as HASH_SIZE, sum_sha256 as _sha256
 
 LEAF_PREFIX = b"\x00"
 INNER_PREFIX = b"\x01"
 
 MAX_AUNTS = 100  # proof.go: maxAunts
-
-
-def _sha256(b: bytes) -> bytes:
-    return hashlib.sha256(b).digest()
 
 
 def leaf_hash(leaf: bytes) -> bytes:
@@ -65,13 +62,26 @@ class Proof:
         self.leaf_hash = leaf_hash_
         self.aunts = aunts
 
-    def verify(self, root_hash: bytes, leaf: bytes) -> None:
-        """Raise ValueError unless this proves `leaf` at index under root
-        (proof.go:59-79)."""
+    def validate_basic(self) -> None:
+        """Stateless sanity checks on an untrusted proof (proof.go:95-116)."""
         if self.total < 0:
             raise ValueError("proof total must be positive")
         if self.index < 0:
             raise ValueError("proof index cannot be negative")
+        if len(self.leaf_hash) != HASH_SIZE:
+            raise ValueError(
+                f"expected leaf_hash size to be {HASH_SIZE}, got {len(self.leaf_hash)}"
+            )
+        if len(self.aunts) > MAX_AUNTS:
+            raise ValueError(f"expected no more than {MAX_AUNTS} aunts")
+        for i, aunt in enumerate(self.aunts):
+            if len(aunt) != HASH_SIZE:
+                raise ValueError(f"expected aunt #{i} size to be {HASH_SIZE}")
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        """Raise ValueError unless this proves `leaf` at index under root
+        (proof.go:59-79)."""
+        self.validate_basic()
         lh = leaf_hash(leaf)
         if lh != self.leaf_hash:
             raise ValueError("invalid leaf hash")

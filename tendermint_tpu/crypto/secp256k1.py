@@ -92,8 +92,12 @@ class PrivKey(_PrivKey):
         self._sk = ec.derive_private_key(d, _CURVE)
 
     def sign(self, msg: bytes) -> bytes:
+        # RFC 6979 deterministic nonces, matching btcec (nocgo:20-32): same
+        # (key, msg) must always yield the same signature bytes.
         digest = hashlib.sha256(msg).digest()
-        der = self._sk.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        der = self._sk.sign(
+            digest, ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True)
+        )
         r, s = decode_dss_signature(der)
         if s > _N // 2:  # normalize to lower-S
             s = _N - s

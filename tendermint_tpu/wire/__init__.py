@@ -1,0 +1,17 @@
+"""Deterministic protobuf wire encoding.
+
+Sign bytes are consensus-critical: every validator must produce the identical
+byte string for the same vote, so this package hand-rolls proto3 encoding
+with gogoproto's exact emission rules instead of relying on a generic
+protobuf runtime. See wire/proto.py for the primitives and wire/canonical.py
+for the canonical sign-bytes messages.
+"""
+
+from .proto import (  # noqa: F401
+    ProtoWriter,
+    decode_message,
+    encode_uvarint,
+    decode_uvarint,
+    marshal_delimited,
+    unmarshal_delimited,
+)

@@ -1,0 +1,125 @@
+"""Canonical sign-bytes construction.
+
+Reference parity: types/canonical.go (CanonicalizeVote/Proposal/BlockID),
+proto/tendermint/types/canonical.proto, and the generated marshalers in
+canonical.pb.go:370-567. The resulting byte strings are what validators
+ed25519-sign; they must match the reference bit-for-bit.
+
+Encoded layout (gogoproto emission rules, see wire/proto.py docstring):
+  CanonicalVote:     1 type(varint) 2 height(sfixed64) 3 round(sfixed64)
+                     4 block_id(msg, nil-omitted) 5 timestamp(msg, ALWAYS)
+                     6 chain_id(string)
+  CanonicalProposal: 1 type 2 height 3 round 4 pol_round(varint)
+                     5 block_id 6 timestamp(ALWAYS) 7 chain_id
+  CanonicalBlockID:  1 hash(bytes) 2 part_set_header(msg, ALWAYS)
+  CanonicalPartSetHeader: 1 total(varint) 2 hash(bytes)
+  Timestamp:         1 seconds(varint int64) 2 nanos(varint int32)
+
+The whole message is uvarint length-prefixed (types/vote.go:93-95,
+protoio MarshalDelimited) — kept for hardware-signer compatibility.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+from .proto import ProtoWriter, marshal_delimited
+
+# SignedMsgType enum (proto/tendermint/types/types.pb.go:70-87)
+SIGNED_MSG_TYPE_UNKNOWN = 0
+SIGNED_MSG_TYPE_PREVOTE = 1
+SIGNED_MSG_TYPE_PRECOMMIT = 2
+SIGNED_MSG_TYPE_PROPOSAL = 32
+
+# Go's zero time.Time (0001-01-01T00:00:00Z) as a proto Timestamp.
+GO_ZERO_TIME_SECONDS = -62135596800
+
+
+class Timestamp(NamedTuple):
+    """google.protobuf.Timestamp value; Go zero time is the zero() value."""
+
+    seconds: int = GO_ZERO_TIME_SECONDS
+    nanos: int = 0
+
+    @classmethod
+    def zero(cls) -> "Timestamp":
+        return cls(GO_ZERO_TIME_SECONDS, 0)
+
+    def is_zero(self) -> bool:
+        return self.seconds == GO_ZERO_TIME_SECONDS and self.nanos == 0
+
+
+def encode_timestamp(ts: Timestamp) -> bytes:
+    w = ProtoWriter()
+    w.write_varint(1, ts.seconds)
+    w.write_varint(2, ts.nanos)
+    return w.bytes()
+
+
+class CanonicalPartSetHeader(NamedTuple):
+    total: int
+    hash: bytes
+
+
+class CanonicalBlockID(NamedTuple):
+    hash: bytes
+    part_set_header: CanonicalPartSetHeader
+
+
+def encode_canonical_part_set_header(psh: CanonicalPartSetHeader) -> bytes:
+    w = ProtoWriter()
+    w.write_varint(1, psh.total)
+    w.write_bytes(2, psh.hash)
+    return w.bytes()
+
+
+def encode_canonical_block_id(bid: CanonicalBlockID) -> bytes:
+    w = ProtoWriter()
+    w.write_bytes(1, bid.hash)
+    # part_set_header is gogoproto non-nullable: always emitted
+    w.write_message(2, encode_canonical_part_set_header(bid.part_set_header), always=True)
+    return w.bytes()
+
+
+def canonical_vote_sign_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: Optional[CanonicalBlockID],
+    timestamp: Timestamp,
+) -> bytes:
+    """VoteSignBytes (types/vote.go:84-101): delimited CanonicalVote.
+
+    block_id must already be canonicalized: None iff the vote's BlockID is
+    zero (types/canonical.go:18-34)."""
+    w = ProtoWriter()
+    w.write_varint(1, msg_type)
+    w.write_sfixed64(2, height)
+    w.write_sfixed64(3, round_)
+    if block_id is not None:
+        w.write_message(4, encode_canonical_block_id(block_id), always=True)
+    w.write_message(5, encode_timestamp(timestamp), always=True)
+    w.write_string(6, chain_id)
+    return marshal_delimited(w.bytes())
+
+
+def canonical_proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: Optional[CanonicalBlockID],
+    timestamp: Timestamp,
+) -> bytes:
+    """ProposalSignBytes (types/proposal.go): delimited CanonicalProposal."""
+    w = ProtoWriter()
+    w.write_varint(1, SIGNED_MSG_TYPE_PROPOSAL)
+    w.write_sfixed64(2, height)
+    w.write_sfixed64(3, round_)
+    w.write_varint(4, pol_round)
+    if block_id is not None:
+        w.write_message(5, encode_canonical_block_id(block_id), always=True)
+    w.write_message(6, encode_timestamp(timestamp), always=True)
+    w.write_string(7, chain_id)
+    return marshal_delimited(w.bytes())

@@ -69,6 +69,17 @@ class TestEdwardsOracle:
         sig = (1).to_bytes(32, "little") + (0).to_bytes(32, "little")
         assert _edwards.verify_zip215(nc, b"x", sig)
 
+    def test_negative_zero_encoding_accepted(self):
+        # ZIP-215 follows dalek decompression: "x = 0 with sign bit 1" is NOT
+        # rejected (conditional negate of 0 is a no-op). Strict RFC 8032 rejects.
+        neg_ident = ((1) | (1 << 255)).to_bytes(32, "little")  # y=1, sign=1
+        pt = _edwards.decompress(neg_ident)
+        assert pt is not None and _edwards.is_identity(pt)
+        assert _edwards.decompress(neg_ident, allow_noncanonical=False) is None
+        # and it verifies as a small-order pubkey with s=0, R=O
+        sig = (1).to_bytes(32, "little") + bytes(32)
+        assert _edwards.verify_zip215(neg_ident, b"m", sig)
+
     def test_torsion_points_exist_and_verify_structure(self):
         # order-4 point: x = +-sqrt(-1), y = 0
         x = _edwards.SQRT_M1
@@ -126,6 +137,11 @@ class TestSecp256k1:
         assert not pk.verify_signature(msg, upper)
         assert not pk.verify_signature(b"other", sig)
 
+    def test_deterministic_rfc6979(self):
+        sk = secp256k1.gen_priv_key()
+        assert sk.sign(b"same msg") == sk.sign(b"same msg")
+        assert sk.sign(b"same msg") != sk.sign(b"other msg")
+
     def test_address_is_ripemd160_sha256(self):
         sk = secp256k1.gen_priv_key()
         pk = sk.pub_key()
@@ -159,6 +175,19 @@ class TestMerkle:
             proof.verify(root, items[i])
             with pytest.raises(ValueError):
                 proof.verify(root, b"wrong leaf")
+
+    def test_proof_validate_basic(self):
+        root, proofs = merkle.proofs_from_byte_slices([b"a", b"b"])
+        p = proofs[0]
+        bad = merkle.Proof(p.total, p.index, p.leaf_hash, [b"x" * 64])
+        with pytest.raises(ValueError, match="aunt #0"):
+            bad.verify(root, b"a")
+        huge = merkle.Proof(p.total, p.index, p.leaf_hash, [b"\0" * 32] * 101)
+        with pytest.raises(ValueError, match="no more than 100"):
+            huge.verify(root, b"a")
+        short_leaf = merkle.Proof(p.total, p.index, b"\0" * 20, p.aunts)
+        with pytest.raises(ValueError, match="leaf_hash"):
+            short_leaf.verify(root, b"a")
 
     def test_split_point(self):
         assert merkle.split_point(2) == 1
