@@ -1,0 +1,80 @@
+"""Benchmark: VerifyCommit hot path — 10k-validator ed25519 commit.
+
+BASELINE.md north star: device batch verification vs the host per-signature
+path (OpenSSL via `cryptography`, the fastest CPU verifier available here;
+the reference's Go crypto/batch cannot run in this image — no Go toolchain).
+
+Prints ONE JSON line:
+  {"metric": "verify_commit_10k", "value": <device sigs/s>,
+   "unit": "sigs/s", "vs_baseline": <device/host speedup>}
+
+Timing is end-to-end per batch (host prep: SHA-512 challenge scalars +
+limb packing + transfer, then the device ladder) — what VerifyCommit
+actually pays per commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    backend_kind = jax.default_backend()
+    on_accel = backend_kind not in ("cpu",)
+    n_sigs = int(os.environ.get("TM_TPU_BENCH_SIGS", "10000" if on_accel else "512"))
+
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.ops import backend
+
+    # Build a synthetic 10k-validator commit: unique keys, ~120B canonical
+    # vote-sized messages (types/vote.go:93 sign bytes scale).
+    entries = []
+    msg_pad = b"\x08\x02\x10\x01" + b"p" * 100
+    for i in range(n_sigs):
+        sk = ed25519.gen_priv_key(i.to_bytes(32, "little"))
+        msg = i.to_bytes(8, "big") + msg_pad
+        entries.append((sk.pub_key().bytes(), msg, sk.sign(msg)))
+
+    # Host baseline: per-signature OpenSSL verify (ZIP-215 fast path).
+    n_base = min(n_sigs, 2000)
+    t0 = time.perf_counter()
+    ok = all(
+        ed25519.verify_zip215_fast(p, m, s) for p, m, s in entries[:n_base]
+    )
+    host_s = (time.perf_counter() - t0) / n_base
+    assert ok
+
+    # Device path: warm up (compile), then steady-state.
+    bucket = backend._bucket_for(n_sigs)
+    t0 = time.perf_counter()
+    res = backend.verify_batch(entries)
+    warm = time.perf_counter() - t0
+    assert bool(res.all()), "all benchmark signatures must verify"
+
+    reps = 3 if on_accel else 1
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        backend.verify_batch(entries)
+    dev_s = (time.perf_counter() - t0) / reps / n_sigs
+
+    out = {
+        "metric": f"verify_commit_{n_sigs}",
+        "value": round(1.0 / dev_s, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(host_s / dev_s, 3),
+    }
+    print(json.dumps(out))
+    print(
+        f"# backend={backend_kind} bucket={bucket} warmup={warm:.1f}s "
+        f"host={1.0/host_s:.0f} sigs/s device={1.0/dev_s:.0f} sigs/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""tendermint_tpu.ops — the device (TPU) compute engine.
+
+JAX/XLA kernels replacing the reference's native-performance seams
+(SURVEY.md §2: the batch signature-verification engine,
+crypto/ed25519/ed25519.go:192-227) with TPU-first designs:
+
+- fe:             GF(2^255-19) limb arithmetic (int32, 13-bit limbs)
+- ed25519_verify: batched branchless ZIP-215 verification kernel
+- backend:        bucketing host driver + BatchVerifier implementation
+- sharded:        multi-chip sharding of verification over a jax Mesh
+
+Importing this package installs the device batch-verifier factory into
+crypto.batch (the reference's CreateBatchVerifier seam).
+"""
+
+from __future__ import annotations
+
+from .backend import Ed25519DeviceBatchVerifier, verify_batch, warmup  # noqa: F401
+from ..crypto import batch as _batch
+
+_batch.use_device_engine(Ed25519DeviceBatchVerifier)

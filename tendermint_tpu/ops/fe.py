@@ -1,0 +1,249 @@
+"""Field arithmetic over GF(2^255 - 19) in 13-bit limbs, for TPU/XLA.
+
+This is the arithmetic core of the device verification engine (SURVEY.md §7
+stage 1; the kernel that replaces the reference's curve25519-voi batch
+verifier, crypto/ed25519/ed25519.go:192-227).
+
+Design notes — why 13-bit limbs in int32:
+- TPU has no native 64-bit integer multiply. A field element is stored as
+  20 int32 limbs of 13 bits (limb i holds bits [13*i, 13*i+13)), so a full
+  20x20 schoolbook product accumulates at most 20 terms of < 2^26.01 each:
+  20 * (2^13 + 8)^2 < 1.35e9 < 2^31 — no overflow, no carry-save needed
+  inside the convolution.
+- All ops are shape-polymorphic over leading batch dims: an element is an
+  int32 array (..., 20). The batch dimension is the data-parallel axis the
+  TPU VPU vectorizes over; 39-coefficient limb convolutions are expressed
+  as a gather + contraction so XLA sees one fused dot per field-mul.
+- Limbs are *signed*: subtraction produces small negative limbs which flow
+  through arithmetic-shift carries correctly; values are only made
+  canonical (in [0, p)) at comparison points via `canon`.
+
+Invariants:
+- "reduced" form (output of carry/add/sub/mul/sq): every limb in
+  (-2^15, 2^13 + 8], |value| < 2^261, value correct mod p. Safe as input
+  to any op here.
+- "canonical" form (output of canon): limbs in [0, 2^13), value in [0, p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1  # 8191
+
+P = 2**255 - 19
+# 2^260 mod p: the carry out of limb 19 wraps with this factor (2^5 * 19).
+_TOP_WRAP = 608
+
+
+def limbs_raw(v: int) -> np.ndarray:
+    """Nonnegative int < 2^260 -> 20-limb int32 array, NO mod-p reduction."""
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = (v >> (RADIX * i)) & MASK
+    return out
+
+
+def limbs_from_int(v: int) -> np.ndarray:
+    """Python int -> canonical (mod-p-reduced) 20-limb int32 array."""
+    return limbs_raw(v % P)
+
+
+def int_from_limbs(a) -> int:
+    """Limb array (20,) -> Python int (host helper; no mod-p reduction)."""
+    a = np.asarray(a, dtype=object)
+    return int(sum(int(a[i]) << (RADIX * i) for i in range(NLIMBS)))
+
+
+ZERO = jnp.zeros(NLIMBS, dtype=jnp.int32)
+ONE = jnp.asarray(limbs_from_int(1))
+P_LIMBS = jnp.asarray(limbs_raw(P))  # limbs of p itself (NOT reduced!)
+
+# 8p in radix-13 limbs (fits: 8p < 2^258 < 2^260). Added before
+# canonicalization so possibly-negative reduced values become positive.
+P8_LIMBS = jnp.asarray(limbs_raw(8 * P))
+
+# Convolution index/mask matrices: TOEP_IDX[k, i] = k - i (clipped),
+# TOEP_MSK[k, i] = 1 iff 0 <= k - i < NLIMBS.
+_k = np.arange(2 * NLIMBS - 1)[:, None]
+_i = np.arange(NLIMBS)[None, :]
+TOEP_IDX = jnp.asarray(np.clip(_k - _i, 0, NLIMBS - 1).astype(np.int32))
+TOEP_MSK = jnp.asarray((((_k - _i) >= 0) & ((_k - _i) < NLIMBS)).astype(np.int32))
+
+
+def carry(x):
+    """Propagate carries: (..., 20) int32 with |limb| < 2^31 -> reduced form.
+
+    Sequential 20-step chain (unrolled; each step is one vector op over the
+    batch). The final carry (weight 2^260) wraps via 2^260 ≡ 608 (mod p).
+    """
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        t = x[..., i] + c
+        c = t >> RADIX  # arithmetic shift == floor division (signed-safe)
+        out.append(t & MASK)
+    t0 = out[0] + c * _TOP_WRAP
+    c0 = t0 >> RADIX
+    out[0] = t0 & MASK
+    t1 = out[1] + c0
+    c1 = t1 >> RADIX
+    out[1] = t1 & MASK
+    out[2] = out[2] + c1  # |c1| <= 3: limb2 in [-3, 2^13+3]
+    return jnp.stack(out, axis=-1)
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    return carry(a - b)
+
+
+def neg(a):
+    return carry(-a)
+
+
+def mul(a, b):
+    """Field multiply: 39-coefficient limb convolution + fold + carry."""
+    bt = jnp.take(b, TOEP_IDX, axis=-1) * TOEP_MSK  # (..., 39, 20)
+    c39 = jnp.einsum(
+        "...i,...ki->...k", a, bt, preferred_element_type=jnp.int32
+    )
+    lo = c39[..., :NLIMBS]
+    hi = c39[..., NLIMBS:]  # coefficients k = 20..38
+    # Split the high coefficients before scaling by 608 so products stay
+    # within int32: hi = hi_hi * 2^13 + hi_lo.
+    hi_lo = hi & MASK
+    hi_hi = hi >> RADIX
+    pad = [(0, 0)] * (c39.ndim - 1)
+    # 608 * hi_lo lands at k-20 (positions 0..18); 608 * hi_hi at k-19 (1..19).
+    r = (
+        lo
+        + _TOP_WRAP * jnp.pad(hi_lo, pad + [(0, 1)])
+        + _TOP_WRAP * jnp.pad(hi_hi, pad + [(1, 0)])
+    )
+    return carry(r)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def sqn(a, n: int):
+    """n successive squarings; uses fori_loop so the trace stays small."""
+    if n <= 4:
+        for _ in range(n):
+            a = sq(a)
+        return a
+    return lax.fori_loop(0, n, lambda _, v: sq(v), a)
+
+
+def mul_small(a, c: int):
+    """Multiply by a small constant (|c| * 2^13 must fit int32 headroom)."""
+    return carry(a * c)
+
+
+def pow22523(z):
+    """z^((p-5)/8) = z^(2^252 - 3) — the sqrt-ratio exponent chain
+    (standard ref10 addition chain: ~254 squarings, 12 multiplies)."""
+    x2 = sq(z)  # z^2
+    x9 = mul(z, sqn(x2, 2))  # z^9
+    x11 = mul(x2, x9)  # z^11
+    x31 = mul(x9, sq(x11))  # z^(2^5-1)
+    xa = mul(sqn(x31, 5), x31)  # 2^10-1
+    xb = mul(sqn(xa, 10), xa)  # 2^20-1
+    xc = mul(sqn(xb, 20), xb)  # 2^40-1
+    xd = mul(sqn(xc, 10), xa)  # 2^50-1
+    xe = mul(sqn(xd, 50), xd)  # 2^100-1
+    xf = mul(sqn(xe, 100), xe)  # 2^200-1
+    xg = mul(sqn(xf, 50), xd)  # 2^250-1
+    return mul(sqn(xg, 2), z)  # 2^252-3
+
+
+def invert(z):
+    """z^(p-2) = z^(2^255 - 21) (for compression/utilities; the verify
+    kernel itself is inversion-free)."""
+    t = pow22523(z)  # z^(2^252-3)
+    # z^(p-2) = (z^(2^252-3))^8 * z^3  since 8*(2^252-3) + 3 = 2^255 - 21
+    return mul(mul(sqn(t, 3), sq(z)), z)
+
+
+def _fold255(x):
+    """Fold bits >= 2^255 down (2^255 ≡ 19): requires limbs in [0, 2^13)+eps.
+    Output: full carry chain re-run; value < 2^255 + small."""
+    q = x[..., NLIMBS - 1] >> 8  # bits of weight >= 2^255
+    parts = [x[..., i] for i in range(NLIMBS)]
+    parts[NLIMBS - 1] = parts[NLIMBS - 1] & 0xFF
+    parts[0] = parts[0] + 19 * q
+    out = []
+    c = jnp.zeros_like(parts[0])
+    for i in range(NLIMBS):
+        t = parts[i] + c
+        c = t >> RADIX
+        out.append(t & MASK)
+    out[NLIMBS - 1] = out[NLIMBS - 1] + (c << RADIX)  # c is 0 here by bounds
+    return jnp.stack(out, axis=-1)
+
+
+def _cond_sub(x, const_limbs):
+    """x - const if x >= const else x (both nonneg canonical-ish limbs)."""
+    d = x - const_limbs
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        t = d[..., i] + c
+        c = t >> RADIX
+        out.append(t & MASK)
+    t = jnp.stack(out, axis=-1)
+    keep = (c < 0)[..., None]  # borrow out -> x < const
+    return jnp.where(keep, x, t)
+
+
+def canon(x):
+    """Fully canonicalize: reduced form -> limbs in [0, 2^13), value in [0, p)."""
+    x = carry(x)
+    x = carry(x + P8_LIMBS)  # make value strictly positive
+    x = _fold255(x)
+    x = _fold255(x)  # value now < 2^255 + eps < 2p
+    x = _cond_sub(x, P_LIMBS)
+    x = _cond_sub(x, P_LIMBS)
+    return x
+
+
+def is_zero(x):
+    """(...,) bool: value ≡ 0 (mod p)."""
+    return jnp.all(canon(x) == 0, axis=-1)
+
+
+def eq(a, b):
+    return is_zero(a - b)
+
+
+def parity(x):
+    """Canonical low bit (the RFC 8032 sign-of-x bit)."""
+    return canon(x)[..., 0] & 1
+
+
+def to_bytes_words(x):
+    """Canonical value -> 8 little-endian uint32 words (..., 8) for output."""
+    c = canon(x).astype(jnp.uint32)
+    words = []
+    for w in range(8):
+        acc = jnp.zeros_like(c[..., 0])
+        for i in range(NLIMBS):
+            lo_bit = RADIX * i
+            if lo_bit >= 32 * (w + 1) or lo_bit + RADIX <= 32 * w:
+                continue
+            sh = lo_bit - 32 * w
+            if sh >= 0:
+                acc = acc | (c[..., i] << sh)
+            else:
+                acc = acc | (c[..., i] >> (-sh))
+        words.append(acc)
+    return jnp.stack(words, axis=-1)

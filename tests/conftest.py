@@ -3,14 +3,29 @@ multi-chip sharding (pjit/shard_map over a Mesh) is exercised without TPU
 hardware. Mirrors the reference's "multi-node without a cluster" pattern
 (in-memory p2p transport, SURVEY.md §4) at the device level.
 
-Must run before the first `import jax` anywhere in the test session.
+The environment's sitecustomize registers a remote-TPU ("axon") PJRT
+plugin at interpreter start and points JAX_PLATFORMS at it; backend
+*initialization* is lazy, so flipping the jax_platforms config here —
+before any jax.devices()/jit call — keeps the whole test session on the
+in-process CPU backend (the remote chip is single-tenant and must stay
+free for the benchmark driver).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# The verify kernel is a large XLA program (~60s cold compile on one CPU
+# core); persist compiled executables across test sessions.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
