@@ -42,19 +42,18 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 def _commit_step(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok, power, live):
     """Per-shard body: verify local signatures, then all-reduce the tally.
 
-    power: (B,) int64-as-2xint32 is overkill — voting power caps at
-    MaxTotalVotingPower = 2^63/8 (types/validator_set.go:25), but a single
-    commit's sum fits float64/int64; we carry it as two int32 words
-    (lo/hi base 2^30) to stay in TPU-native integer lanes.
+    power: (B, 4) int32 — voting power split into base-2^16 lanes (see
+    split_power) so 63-bit totals survive int32-only TPU lanes.
     """
     valid = _kernel.verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok)
     ok = valid & live
-    lo = jnp.sum(jnp.where(ok, power[..., 0], 0))
-    hi = jnp.sum(jnp.where(ok, power[..., 1], 0))
-    lo = jax.lax.psum(lo, AXIS)
-    hi = jax.lax.psum(hi, AXIS)
+    # Tally voting power of valid signatures in 4 base-2^16 int32 lanes:
+    # power < MaxTotalVotingPower = 2^60 (types/validator_set.go:25), so
+    # each lane < 2^16 and a 10240-row lane sum < 2^30 — no overflow.
+    lanes = jnp.sum(jnp.where(ok[..., None], power, 0), axis=0)
+    lanes = jax.lax.psum(lanes, AXIS)
     all_valid = jax.lax.psum(jnp.sum(jnp.where(live & ~valid, 1, 0)), AXIS) == 0
-    return valid, lo, hi, all_valid
+    return valid, lanes, all_valid
 
 
 def sharded_commit_verifier(mesh: Mesh):
@@ -72,22 +71,27 @@ def sharded_commit_verifier(mesh: Mesh):
             P(AXIS), P(AXIS), P(AXIS), P(AXIS),
             P(None, AXIS), P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
         ),
-        out_specs=(P(AXIS), P(), P(), P()),
+        out_specs=(P(AXIS), P(), P()),
     )
     return jax.jit(fn), (batch_sharded, bits_sharded, replicated)
 
 
-POWER_BASE = 1 << 30
+POWER_LANES = 4
+POWER_BASE = 1 << 16
 
 
 def split_power(powers: np.ndarray) -> np.ndarray:
-    """(B,) python-int-ish voting powers -> (B, 2) int32 lo/hi base-2^30."""
+    """(B,) voting powers (< 2^60 = MaxTotalVotingPower cap) -> (B, 4)
+    int32 base-2^16 lanes."""
     p = np.asarray(powers, dtype=np.int64)
-    return np.stack([(p % POWER_BASE), (p // POWER_BASE)], axis=1).astype(np.int32)
+    if (p < 0).any() or (p >= 1 << 62).any():
+        raise ValueError("voting power out of range")
+    lanes = [(p >> (16 * i)) & 0xFFFF for i in range(POWER_LANES)]
+    return np.stack(lanes, axis=1).astype(np.int32)
 
 
-def join_power(lo: int, hi: int) -> int:
-    return int(lo) + POWER_BASE * int(hi)
+def join_power(lanes) -> int:
+    return sum(int(v) << (16 * i) for i, v in enumerate(np.asarray(lanes)))
 
 
 def verify_commit_sharded(
@@ -110,13 +114,13 @@ def verify_commit_sharded(
     args = _backend.prepare_batch(entries, bucket)
     live = np.zeros((bucket,), dtype=bool)
     live[:n] = True
-    pw = np.zeros((bucket, 2), dtype=np.int32)
+    pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
     pw[:n] = split_power(np.asarray(powers[:n]))
     fn, _ = _jitted_for(mesh)
-    valid, lo, hi, all_valid = fn(*args, pw, live)
+    valid, lanes, all_valid = fn(*args, pw, live)
     return (
         np.asarray(valid)[:n],
-        join_power(np.asarray(lo), np.asarray(hi)),
+        join_power(lanes),
         bool(np.asarray(all_valid)),
     )
 

@@ -170,7 +170,12 @@ class TestShardedCommit:
     def test_power_split_roundtrip(self):
         from tendermint_tpu.ops import sharded
 
-        vals = [0, 1, 2**30 - 1, 2**30, 2**62 // 3, 2**62]
+        # Domain: up to MaxTotalVotingPower = 2^63/8 (validator_set.go:25).
+        vals = [0, 1, 2**16, 2**30 - 1, 2**30, 2**60 - 1, 2**63 // 8]
         sp = sharded.split_power(np.asarray(vals))
-        for (lo, hi), v in zip(sp, vals):
-            assert sharded.join_power(lo, hi) == v
+        for lanes, v in zip(sp, vals):
+            assert sharded.join_power(lanes) == v
+        with pytest.raises(ValueError):
+            sharded.split_power(np.asarray([2**62]))
+        with pytest.raises(ValueError):
+            sharded.split_power(np.asarray([-1]))
