@@ -1,0 +1,44 @@
+"""PubKey ⇄ proto conversion.
+
+Reference parity: crypto/encoding/codec.go (PubKeyToProto/PubKeyFromProto)
+and proto/tendermint/crypto/keys.proto — PublicKey is a oneof:
+  1 ed25519 (bytes) | 2 secp256k1 (bytes) | 3 sr25519 (bytes)
+"""
+
+from __future__ import annotations
+
+from ..wire.proto import ProtoWriter, decode_message, field_bytes
+from . import PubKey
+from . import ed25519 as _ed25519
+from . import secp256k1 as _secp256k1
+from . import sr25519 as _sr25519
+
+_FIELD_ED25519 = 1
+_FIELD_SECP256K1 = 2
+_FIELD_SR25519 = 3
+
+
+def pubkey_to_proto(pk: PubKey) -> bytes:
+    """Encode a PubKey as a tendermint.crypto.PublicKey message."""
+    w = ProtoWriter()
+    t = pk.type()
+    if t == _ed25519.KEY_TYPE:
+        w.write_bytes(_FIELD_ED25519, pk.bytes(), always=True)
+    elif t == _secp256k1.KEY_TYPE:
+        w.write_bytes(_FIELD_SECP256K1, pk.bytes(), always=True)
+    elif t == _sr25519.KEY_TYPE:
+        w.write_bytes(_FIELD_SR25519, pk.bytes(), always=True)
+    else:
+        raise ValueError(f"unsupported key type {t}")
+    return w.bytes()
+
+
+def pubkey_from_proto(data: bytes) -> PubKey:
+    fields = decode_message(data)
+    if _FIELD_ED25519 in fields:
+        return _ed25519.PubKey(field_bytes(fields, _FIELD_ED25519))
+    if _FIELD_SECP256K1 in fields:
+        return _secp256k1.PubKey(field_bytes(fields, _FIELD_SECP256K1))
+    if _FIELD_SR25519 in fields:
+        return _sr25519.PubKey(field_bytes(fields, _FIELD_SR25519))
+    raise ValueError("unknown or empty PublicKey oneof")

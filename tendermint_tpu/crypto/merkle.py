@@ -92,6 +92,40 @@ class Proof:
     def compute_root_hash(self) -> Optional[bytes]:
         return _compute_hash_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
 
+    def encode(self) -> bytes:
+        """tendermint.crypto.Proof wire form (proto/tendermint/crypto/
+        proof.pb.go): 1 total 2 index 3 leaf_hash 4 aunts(repeated)."""
+        from ..wire.proto import ProtoWriter
+
+        w = ProtoWriter()
+        w.write_varint(1, self.total)
+        w.write_varint(2, self.index)
+        w.write_bytes(3, self.leaf_hash)
+        for aunt in self.aunts:
+            w.write_bytes(4, aunt, always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proof":
+        from ..wire.proto import decode_message, field_bytes, field_int, to_signed64
+
+        f = decode_message(data)
+        return cls(
+            total=to_signed64(field_int(f, 1)),
+            index=to_signed64(field_int(f, 2)),
+            leaf_hash_=field_bytes(f, 3),
+            aunts=[raw for _, raw in f.get(4, [])],
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Proof)
+            and self.total == other.total
+            and self.index == other.index
+            and self.leaf_hash == other.leaf_hash
+            and self.aunts == other.aunts
+        )
+
 
 def _compute_hash_from_aunts(
     index: int, total: int, leaf: bytes, aunts: List[bytes]

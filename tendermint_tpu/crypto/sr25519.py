@@ -1,0 +1,76 @@
+"""sr25519 (Schnorr over ristretto255, schnorrkel flavor).
+
+Reference parity: crypto/sr25519/ — pubkey/privkey/batch verifier backed by
+curve25519-voi's schnorrkel implementation. Signing context is the
+schnorrkel default "substrate" context used by the reference
+(crypto/sr25519/signature.go).
+
+Status: key container + address/type plumbing are complete (enough for
+encoding, validator sets and config); sign/verify land with the
+ristretto255 + merlin transcript implementation (tracked in README
+roadmap). Verification raises rather than returning False so nothing can
+silently treat unimplemented crypto as an invalid-signature result.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import PrivKey as _PrivKey, PubKey as _PubKey, address_hash, register_key_type
+
+KEY_TYPE = "sr25519"
+PUB_KEY_SIZE = 32
+PRIV_KEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+PUB_KEY_NAME = "tendermint/PubKeySr25519"
+PRIV_KEY_NAME = "tendermint/PrivKeySr25519"
+
+
+class PubKey(_PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(f"sr25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        return address_hash(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        raise NotImplementedError("sr25519 verification not yet implemented")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+class PrivKey(_PrivKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(f"sr25519 privkey must be {PRIV_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def sign(self, msg: bytes) -> bytes:
+        raise NotImplementedError("sr25519 signing not yet implemented")
+
+    def pub_key(self) -> PubKey:
+        raise NotImplementedError("sr25519 key derivation not yet implemented")
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key(seed: bytes | None = None) -> PrivKey:
+    return PrivKey(seed if seed is not None else os.urandom(PRIV_KEY_SIZE))
+
+
+register_key_type(KEY_TYPE, PubKey, PUB_KEY_SIZE)

@@ -1,0 +1,1 @@
+"""tendermint_tpu.libs — utility libraries (reference libs/, SURVEY.md L0)."""

@@ -1,0 +1,43 @@
+"""tendermint_tpu.types — core chain data types (reference types/, L2).
+
+Block/Header/Commit/Vote/VoteSet/ValidatorSet plus commit verification
+routed through the device batch-verify engine (types/validation.py).
+"""
+
+from .block import (  # noqa: F401
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+    PartSetHeader,
+    SignedHeader,
+    Version,
+    ZERO_BLOCK_ID,
+)
+from .part_set import BLOCK_PART_SIZE_BYTES, Part, PartSet  # noqa: F401
+from .validation import (  # noqa: F401
+    DEFAULT_TRUST_LEVEL,
+    Fraction,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from .validator_set import (  # noqa: F401
+    MAX_TOTAL_VOTING_POWER,
+    ErrNotEnoughVotingPowerSigned,
+    Validator,
+    ValidatorSet,
+)
+from .vote import (  # noqa: F401
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    Vote,
+    vote_from_commit_sig,
+)
+from .vote_set import MAX_VOTES_COUNT, ErrVoteConflictingVotes, VoteSet  # noqa: F401
+from ..wire.canonical import Timestamp  # noqa: F401

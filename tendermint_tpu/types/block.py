@@ -1,0 +1,519 @@
+"""Block, Header, Commit, CommitSig, BlockID — core chain data types.
+
+Reference parity: types/block.go. Hashing is bit-exact:
+- Header.hash: merkle root over 14 proto-encoded fields (block.go:448-483)
+- Commit.hash: merkle root over proto-encoded CommitSigs (block.go:732-751)
+- Data.hash: merkle root over raw txs (types/tx.go Txs.Hash)
+- cdcEncode wrappers (types/encoding_helper.go): gogotypes
+  {String,Int64,Bytes}Value with the value in field 1; empty -> nil leaf.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from ..crypto import merkle, tmhash
+from ..wire import canonical as _canon
+from ..wire.canonical import Timestamp
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+
+MAX_HEADER_BYTES = 626  # types/block.go:570
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+MAX_SIGNATURE_SIZE = 64  # ed25519/sr25519; secp256k1 is <= 72 (types/vote.go:24)
+
+
+def cdc_encode_string(s: str) -> bytes:
+    if not s:
+        return b""
+    w = ProtoWriter()
+    w.write_string(1, s)
+    return w.bytes()
+
+
+def cdc_encode_int64(v: int) -> bytes:
+    if not v:
+        return b""
+    w = ProtoWriter()
+    w.write_varint(1, v)
+    return w.bytes()
+
+
+def cdc_encode_bytes(b: bytes) -> bytes:
+    if not b:
+        return b""
+    w = ProtoWriter()
+    w.write_bytes(1, b)
+    return w.bytes()
+
+
+@dataclass(frozen=True)
+class Version:
+    """Consensus version (proto/tendermint/version, version/version.go)."""
+
+    block: int = 11  # version.BlockProtocol (version/version.go:25)
+    app: int = 0
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.block)
+        w.write_varint(2, self.app)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Version":
+        f = decode_message(data)
+        return cls(block=field_int(f, 1), app=field_int(f, 2))
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and not self.hash
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.total)
+        w.write_bytes(2, self.hash)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PartSetHeader":
+        f = decode_message(data)
+        return cls(total=field_int(f, 1), hash=field_bytes(f, 2))
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError("wrong PartSetHeader hash size")
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        return not self.hash and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        """ValidateBasic-completeness (types/block.go:1153): hash and part
+        set header both fully set."""
+        return (
+            len(self.hash) == tmhash.SIZE
+            and self.part_set_header.total > 0
+            and len(self.part_set_header.hash) == tmhash.SIZE
+        )
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_bytes(1, self.hash)
+        w.write_message(2, self.part_set_header.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockID":
+        f = decode_message(data)
+        return cls(
+            hash=field_bytes(f, 1),
+            part_set_header=PartSetHeader.decode(field_bytes(f, 2)),
+        )
+
+    def canonical(self) -> Optional[_canon.CanonicalBlockID]:
+        """types/canonical.go CanonicalizeBlockID: nil for the zero ID."""
+        if self.is_zero():
+            return None
+        return _canon.CanonicalBlockID(
+            hash=self.hash,
+            part_set_header=_canon.CanonicalPartSetHeader(
+                total=self.part_set_header.total,
+                hash=self.part_set_header.hash,
+            ),
+        )
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError("wrong BlockID hash size")
+        self.part_set_header.validate_basic()
+
+    def key(self) -> bytes:
+        """Map key (types/block.go BlockID.Key)."""
+        return self.hash + self.part_set_header.encode()
+
+
+ZERO_BLOCK_ID = BlockID()
+
+
+@dataclass(frozen=True)
+class Header:
+    """types/block.go:370-412."""
+
+    version: Version = field(default_factory=Version)
+    chain_id: str = ""
+    height: int = 0
+    time: Timestamp = field(default_factory=Timestamp.zero)
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes:
+        """Merkle root of proto-encoded fields (types/block.go:448-483).
+        Returns b"" when the header is incomplete (nil in Go)."""
+        if not self.validators_hash:
+            return b""
+        return merkle.hash_from_byte_slices(
+            [
+                self.version.encode(),
+                cdc_encode_string(self.chain_id),
+                cdc_encode_int64(self.height),
+                _canon.encode_timestamp(self.time),
+                self.last_block_id.encode(),
+                cdc_encode_bytes(self.last_commit_hash),
+                cdc_encode_bytes(self.data_hash),
+                cdc_encode_bytes(self.validators_hash),
+                cdc_encode_bytes(self.next_validators_hash),
+                cdc_encode_bytes(self.consensus_hash),
+                cdc_encode_bytes(self.app_hash),
+                cdc_encode_bytes(self.last_results_hash),
+                cdc_encode_bytes(self.evidence_hash),
+                cdc_encode_bytes(self.proposer_address),
+            ]
+        )
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_message(1, self.version.encode(), always=True)
+        w.write_string(2, self.chain_id)
+        w.write_varint(3, self.height)
+        w.write_message(4, _canon.encode_timestamp(self.time), always=True)
+        w.write_message(5, self.last_block_id.encode(), always=True)
+        w.write_bytes(6, self.last_commit_hash)
+        w.write_bytes(7, self.data_hash)
+        w.write_bytes(8, self.validators_hash)
+        w.write_bytes(9, self.next_validators_hash)
+        w.write_bytes(10, self.consensus_hash)
+        w.write_bytes(11, self.app_hash)
+        w.write_bytes(12, self.last_results_hash)
+        w.write_bytes(13, self.evidence_hash)
+        w.write_bytes(14, self.proposer_address)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        f = decode_message(data)
+        ts_f = decode_message(field_bytes(f, 4))
+        return cls(
+            version=Version.decode(field_bytes(f, 1)),
+            chain_id=field_bytes(f, 2).decode("utf-8"),
+            height=to_signed64(field_int(f, 3)),
+            time=Timestamp(
+                seconds=to_signed64(field_int(ts_f, 1)),
+                nanos=to_signed32(field_int(ts_f, 2)),
+            ),
+            last_block_id=BlockID.decode(field_bytes(f, 5)),
+            last_commit_hash=field_bytes(f, 6),
+            data_hash=field_bytes(f, 7),
+            validators_hash=field_bytes(f, 8),
+            next_validators_hash=field_bytes(f, 9),
+            consensus_hash=field_bytes(f, 10),
+            app_hash=field_bytes(f, 11),
+            last_results_hash=field_bytes(f, 12),
+            evidence_hash=field_bytes(f, 13),
+            proposer_address=field_bytes(f, 14),
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:413-446."""
+        if len(self.chain_id) > 50:
+            raise ValueError("chain_id is too long")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.height == 0:
+            raise ValueError("zero Height")
+        self.last_block_id.validate_basic()
+        for name, h in (
+            ("last_commit_hash", self.last_commit_hash),
+            ("data_hash", self.data_hash),
+            ("evidence_hash", self.evidence_hash),
+            ("last_results_hash", self.last_results_hash),
+            ("validators_hash", self.validators_hash),
+            ("next_validators_hash", self.next_validators_hash),
+            ("consensus_hash", self.consensus_hash),
+        ):
+            if h and len(h) != tmhash.SIZE:
+                raise ValueError(f"wrong {name} size")
+        if self.proposer_address and len(self.proposer_address) != tmhash.TRUNCATED_SIZE:
+            raise ValueError("invalid proposer_address size")
+
+
+@dataclass(frozen=True)
+class CommitSig:
+    """types/block.go:590-700."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls(block_id_flag=BLOCK_ID_FLAG_ABSENT)
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def is_absent(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The vote's BlockID implied by the flag (types/block.go:685-700)."""
+        if self.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            return BlockID()
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        if self.block_id_flag == BLOCK_ID_FLAG_NIL:
+            return BlockID()
+        raise ValueError(f"unknown BlockIDFlag: {self.block_id_flag}")
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.block_id_flag)
+        w.write_bytes(2, self.validator_address)
+        w.write_message(3, _canon.encode_timestamp(self.timestamp), always=True)
+        w.write_bytes(4, self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CommitSig":
+        f = decode_message(data)
+        ts_f = decode_message(field_bytes(f, 3))
+        return cls(
+            block_id_flag=field_int(f, 1),
+            validator_address=field_bytes(f, 2),
+            timestamp=Timestamp(
+                seconds=to_signed64(field_int(ts_f, 1)),
+                nanos=to_signed32(field_int(ts_f, 2)),
+            ),
+            signature=field_bytes(f, 4),
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:702-741."""
+        if self.block_id_flag not in (
+            BLOCK_ID_FLAG_ABSENT,
+            BLOCK_ID_FLAG_COMMIT,
+            BLOCK_ID_FLAG_NIL,
+        ):
+            raise ValueError(f"unknown BlockIDFlag: {self.block_id_flag}")
+        if self.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            if self.validator_address:
+                raise ValueError("validator address is present for absent CommitSig")
+            if not self.timestamp.is_zero():
+                raise ValueError("time is present for absent CommitSig")
+            if self.signature:
+                raise ValueError("signature is present for absent CommitSig")
+        else:
+            if len(self.validator_address) != tmhash.TRUNCATED_SIZE:
+                raise ValueError("expected ValidatorAddress size")
+            if not self.signature:
+                raise ValueError("signature is missing")
+            if len(self.signature) > MAX_SIGNATURE_SIZE:
+                raise ValueError("signature is too big")
+
+
+@dataclass
+class Commit:
+    """types/block.go:744-830."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    signatures: List[CommitSig] = field(default_factory=list)
+
+    _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [cs.encode() for cs in self.signatures]
+            )
+        return self._hash
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Canonical sign bytes of the vote at idx (types/block.go:816-819)."""
+        cs = self.signatures[idx]
+        return _canon.canonical_vote_sign_bytes(
+            chain_id=chain_id,
+            msg_type=_canon.SIGNED_MSG_TYPE_PRECOMMIT,
+            height=self.height,
+            round_=self.round,
+            block_id=cs.block_id(self.block_id).canonical(),
+            timestamp=cs.timestamp,
+        )
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.height)
+        w.write_varint(2, self.round)
+        w.write_message(3, self.block_id.encode(), always=True)
+        for cs in self.signatures:
+            w.write_message(4, cs.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        f = decode_message(data)
+        sigs = [CommitSig.decode(raw) for _, raw in f.get(4, [])]
+        return cls(
+            height=to_signed64(field_int(f, 1)),
+            round=to_signed32(field_int(f, 2)),
+            block_id=BlockID.decode(field_bytes(f, 3)),
+            signatures=sigs,
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:779-800."""
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for i, cs in enumerate(self.signatures):
+                try:
+                    cs.validate_basic()
+                except ValueError as e:
+                    raise ValueError(f"wrong CommitSig #{i}: {e}") from e
+
+
+@dataclass
+class Data:
+    """Block transactions (types/block.go Data)."""
+
+    txs: List[bytes] = field(default_factory=list)
+    _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(list(self.txs))
+        return self._hash
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        for tx in self.txs:
+            w.write_bytes(1, tx, always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Data":
+        f = decode_message(data)
+        return cls(txs=[raw for _, raw in f.get(1, [])])
+
+
+@dataclass
+class Block:
+    """types/block.go:37-67 (evidence carried as raw encoded list for now;
+    typed evidence lands with types/evidence.py)."""
+
+    header: Header = field(default_factory=Header)
+    data: Data = field(default_factory=Data)
+    evidence: List[bytes] = field(default_factory=list)  # encoded Evidence msgs
+    last_commit: Optional[Commit] = None
+
+    def hash(self) -> bytes:
+        return self.header.hash()
+
+    def hash_evidence(self) -> bytes:
+        return merkle.hash_from_byte_slices(list(self.evidence))
+
+    def fill_header(self) -> None:
+        """types/block.go:108-124: populate derived header hashes."""
+        h = self.header
+        updates = {}
+        if not h.last_commit_hash and self.last_commit is not None:
+            updates["last_commit_hash"] = self.last_commit.hash()
+        if not h.data_hash:
+            updates["data_hash"] = self.data.hash()
+        if not h.evidence_hash:
+            updates["evidence_hash"] = self.hash_evidence()
+        if updates:
+            self.header = replace(h, **updates)
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_message(1, self.header.encode(), always=True)
+        w.write_message(2, self.data.encode(), always=True)
+        ev = ProtoWriter()
+        for e in self.evidence:
+            ev.write_message(1, e, always=True)
+        w.write_message(3, ev.bytes(), always=True)
+        if self.last_commit is not None:
+            w.write_message(4, self.last_commit.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        f = decode_message(data)
+        ev_f = decode_message(field_bytes(f, 3))
+        return cls(
+            header=Header.decode(field_bytes(f, 1)),
+            data=Data.decode(field_bytes(f, 2)),
+            evidence=[raw for _, raw in ev_f.get(1, [])],
+            last_commit=Commit.decode(field_bytes(f, 4)) if 4 in f else None,
+        )
+
+    def validate_basic(self) -> None:
+        """types/block.go:69-106."""
+        self.header.validate_basic()
+        if self.last_commit is not None:
+            self.last_commit.validate_basic()
+            if self.header.last_commit_hash != self.last_commit.hash():
+                raise ValueError("wrong last_commit_hash")
+        elif self.header.height > 1:
+            raise ValueError("nil LastCommit")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("wrong data_hash")
+        if self.header.evidence_hash != self.hash_evidence():
+            raise ValueError("wrong evidence_hash")
+
+
+@dataclass(frozen=True)
+class SignedHeader:
+    """Header + the commit that signed it (types/block.go:833-890)."""
+
+    header: Optional[Header] = None
+    commit: Optional[Commit] = None
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header is None:
+            raise ValueError("missing header")
+        if self.commit is None:
+            raise ValueError("missing commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header belongs to another chain {self.header.chain_id!r}, not {chain_id!r}"
+            )
+        if self.header.height != self.commit.height:
+            raise ValueError("header and commit height mismatch")
+        if self.header.hash() != self.commit.block_id.hash:
+            raise ValueError("commit signs a header other than this one")

@@ -1,0 +1,165 @@
+"""PartSet — block chunking for gossip (64kB parts + merkle proofs).
+
+Reference parity: types/part_set.go. A block is proto-encoded then split
+into BlockPartSizeBytes chunks; each Part carries a merkle proof against
+the PartSetHeader hash so peers can verify parts independently.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..crypto import merkle, tmhash
+from ..libs.bits import BitArray
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int
+from .block import PartSetHeader
+
+BLOCK_PART_SIZE_BYTES = 65536  # types/params.go BlockPartSizeBytes
+MAX_PARTS_COUNT = 1601  # 100MB / 64kB + 1 (types/part_set.go:23)
+
+
+@dataclass(frozen=True)
+class Part:
+    index: int
+    bytes: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self) -> None:
+        """part_set.go:48-62."""
+        if len(self.bytes) > BLOCK_PART_SIZE_BYTES:
+            raise ValueError(f"part too big: {len(self.bytes)} > {BLOCK_PART_SIZE_BYTES}")
+        if (
+            self.proof.leaf_hash != merkle.leaf_hash(self.bytes)
+            or len(self.proof.leaf_hash) != tmhash.SIZE
+        ):
+            raise ValueError("wrong leaf hash in part proof")
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.index)
+        w.write_bytes(2, self.bytes)
+        w.write_message(3, self.proof.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Part":
+        f = decode_message(data)
+        return cls(
+            index=field_int(f, 1),
+            bytes=field_bytes(f, 2),
+            proof=merkle.Proof.decode(field_bytes(f, 3)),
+        )
+
+
+class PartSet:
+    """part_set.go:150-400."""
+
+    def __init__(
+        self,
+        header: PartSetHeader,
+        parts: List[Optional[Part]],
+        parts_bit_array: BitArray,
+        count: int,
+        byte_size: int,
+    ):
+        self._header = header
+        self._parts = parts
+        self._bit_array = parts_bit_array
+        self._count = count
+        self._byte_size = byte_size
+        self._mtx = threading.Lock()
+
+    # -- constructors ---------------------------------------------------
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES) -> "PartSet":
+        """NewPartSetFromData (part_set.go:158-189): chunk + build proofs."""
+        total = (len(data) + part_size - 1) // part_size
+        if total == 0:
+            total = 1
+        chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        parts: List[Optional[Part]] = [
+            Part(index=i, bytes=chunks[i], proof=proofs[i]) for i in range(total)
+        ]
+        ba = BitArray(total)
+        for i in range(total):
+            ba.set_index(i, True)
+        return cls(
+            header=PartSetHeader(total=total, hash=root),
+            parts=parts,
+            parts_bit_array=ba,
+            count=total,
+            byte_size=len(data),
+        )
+
+    @classmethod
+    def new_from_header(cls, header: PartSetHeader) -> "PartSet":
+        return cls(
+            header=header,
+            parts=[None] * header.total,
+            parts_bit_array=BitArray(header.total),
+            count=0,
+            byte_size=0,
+        )
+
+    # -- accessors ------------------------------------------------------
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self._header == header
+
+    def bit_array(self) -> BitArray:
+        with self._mtx:
+            return self._bit_array.copy()
+
+    def hash(self) -> bytes:
+        return self._header.hash
+
+    def total(self) -> int:
+        return self._header.total
+
+    def count(self) -> int:
+        return self._count
+
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def is_complete(self) -> bool:
+        return self._count == self._header.total
+
+    def get_part(self, index: int) -> Optional[Part]:
+        with self._mtx:
+            if index >= len(self._parts):
+                return None
+            return self._parts[index]
+
+    # -- assembly -------------------------------------------------------
+
+    def add_part(self, part: Optional[Part]) -> bool:
+        """part_set.go:260-292: False for duplicates; raises for invalid."""
+        if part is None:
+            raise ValueError("nil part")
+        with self._mtx:
+            if part.index >= self._header.total:
+                raise ValueError("unexpected part index")
+            if self._parts[part.index] is not None:
+                return False
+            # Check hash proof against the part set root.
+            part.validate_basic()
+            part.proof.verify(self._header.hash, part.bytes)
+            self._parts[part.index] = part
+            self._bit_array.set_index(part.index, True)
+            self._count += 1
+            self._byte_size += len(part.bytes)
+            return True
+
+    def assemble(self) -> bytes:
+        """Reader equivalent: concatenated part bytes (must be complete)."""
+        if not self.is_complete():
+            raise ValueError("part set is not complete")
+        return b"".join(p.bytes for p in self._parts)  # type: ignore[union-attr]

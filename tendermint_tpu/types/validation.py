@@ -1,0 +1,245 @@
+"""Commit verification — the north-star hot path.
+
+Reference parity: types/validation.go. VerifyCommit/VerifyCommitLight/
+VerifyCommitLightTrusting route through the crypto.batch seam, where the
+device (TPU) batch verifier is installed — a commit's signatures become one
+fixed-shape device batch (SURVEY.md §3.4). Behavior (error cases, tally
+accounting, blame assignment for the first bad signature) is byte-identical
+to the single-verify path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..crypto import batch as _batch
+from ..crypto import tmhash
+from .block import BlockID, Commit, CommitSig
+from .validator_set import ErrNotEnoughVotingPowerSigned, ValidatorSet, safe_mul
+
+BATCH_VERIFY_THRESHOLD = 2  # validation.go:12
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """libs/math.Fraction (used for light-client trust level)."""
+
+    numerator: int
+    denominator: int
+
+    def validate(self) -> None:
+        if self.denominator == 0:
+            raise ValueError("fraction has zero denominator")
+
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class ErrInvalidCommitHeight(ValueError):
+    def __init__(self, expected: int, actual: int):
+        super().__init__(f"invalid commit height: expected {expected}, got {actual}")
+
+
+class ErrInvalidCommitSignatures(ValueError):
+    def __init__(self, expected: int, actual: int):
+        super().__init__(
+            f"invalid commit -- wrong set size: {expected} vs {actual}"
+        )
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    proposer = vals.get_proposer()
+    return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and _batch.supports_batch_verifier(
+        proposer.pub_key if proposer else None
+    )
+
+
+def verify_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """validation.go:25-52: +2/3 signed, ALL signatures checked (the app's
+    LastCommitInfo incentive accounting depends on every sig)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.is_absent()  # noqa: E731
+    count = lambda c: c.for_block()  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+        )
+
+
+def verify_commit_light(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """validation.go:59-86: +2/3 signed; may exit early."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: not c.for_block()  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+        )
+
+
+def verify_commit_light_trusting(
+    chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction
+) -> None:
+    """validation.go:94-135: trustLevel of vals signed; vals need not match
+    the commit's validator set — look up by address, reject double votes."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    total_mul, overflow = safe_mul(vals.total_voting_power(), trust_level.numerator)
+    if overflow:
+        raise OverflowError(
+            "int64 overflow while calculating voting power needed; "
+            "please provide smaller trustLevel numerator"
+        )
+    voting_power_needed = total_mul // trust_level.denominator
+    ignore = lambda c: not c.for_block()  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+
+
+def validate_hash(h: bytes) -> None:
+    """validation.go:138-147."""
+    if h and len(h) != tmhash.SIZE:
+        raise ValueError(f"expected size to be {tmhash.SIZE} bytes, got {len(h)} bytes")
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """validation.go:152-263."""
+    tallied = 0
+    seen_vals: dict = {}
+    batch_sig_idxs = []
+    proposer = vals.get_proposer()
+    bv = _batch.create_batch_verifier(proposer.pub_key if proposer else None)
+    if bv is None or len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        raise RuntimeError(
+            "unsupported signature algorithm or insufficient signatures for batch verification"
+        )
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            sig = commit.signatures[idx]
+            raise ValueError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+            )
+    raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """validation.go:265-334."""
+    tallied = 0
+    seen_vals: dict = {}
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(vote_sign_bytes, commit_sig.signature):
+            raise ValueError(
+                f"wrong signature (#{idx}): {commit_sig.signature.hex().upper()}"
+            )
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+
+
+def _verify_basic_vals_and_commit(
+    vals: Optional[ValidatorSet],
+    commit: Optional[Commit],
+    height: int,
+    block_id: BlockID,
+) -> None:
+    """validation.go:336-358."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if commit is None:
+        raise ValueError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise ErrInvalidCommitSignatures(vals.size(), len(commit.signatures))
+    if height != commit.height:
+        raise ErrInvalidCommitHeight(height, commit.height)
+    if block_id != commit.block_id:
+        raise ValueError(
+            f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+        )

@@ -1,0 +1,150 @@
+"""Vote — a prevote/precommit signed by a validator.
+
+Reference parity: types/vote.go. Sign bytes are the uvarint-delimited
+proto encoding of CanonicalVote (vote.go:93-101); Vote.Verify checks the
+signer address and the signature over them (vote.go:147-165).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..crypto import PubKey, tmhash
+from ..wire import canonical as _canon
+from ..wire.canonical import Timestamp
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+from .block import BlockID, MAX_SIGNATURE_SIZE, CommitSig, BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL
+
+PREVOTE_TYPE = _canon.SIGNED_MSG_TYPE_PREVOTE
+PRECOMMIT_TYPE = _canon.SIGNED_MSG_TYPE_PRECOMMIT
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
+class ErrVoteInvalidValidatorAddress(ValueError):
+    pass
+
+
+class ErrVoteInvalidSignature(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Vote:
+    """types/vote.go:51-63."""
+
+    type: int = 0
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    validator_address: bytes = b""
+    validator_index: int = 0
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        """VoteSignBytes (vote.go:93-101)."""
+        return _canon.canonical_vote_sign_bytes(
+            chain_id=chain_id,
+            msg_type=self.type,
+            height=self.height,
+            round_=self.round,
+            block_id=self.block_id.canonical(),
+            timestamp=self.timestamp,
+        )
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """vote.go:147-165: address match + signature over sign bytes."""
+        if pub_key.address() != self.validator_address:
+            raise ErrVoteInvalidValidatorAddress("invalid validator address")
+        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+            raise ErrVoteInvalidSignature("invalid signature")
+
+    def to_commit_sig(self) -> CommitSig:
+        """vote.go:246-266 (CommitSig): flag from the vote's BlockID."""
+        if self.block_id.is_complete():
+            flag = BLOCK_ID_FLAG_COMMIT
+        elif self.block_id.is_zero():
+            flag = BLOCK_ID_FLAG_NIL
+        else:
+            raise ValueError(f"blockID {self.block_id} is not commit nor nil")
+        return CommitSig(
+            block_id_flag=flag,
+            validator_address=self.validator_address,
+            timestamp=self.timestamp,
+            signature=self.signature,
+        )
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.type)
+        w.write_varint(2, self.height)
+        w.write_varint(3, self.round)
+        w.write_message(4, self.block_id.encode(), always=True)
+        w.write_message(5, _canon.encode_timestamp(self.timestamp), always=True)
+        w.write_bytes(6, self.validator_address)
+        w.write_varint(7, self.validator_index)
+        w.write_bytes(8, self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Vote":
+        f = decode_message(data)
+        ts_f = decode_message(field_bytes(f, 5))
+        return cls(
+            type=field_int(f, 1),
+            height=to_signed64(field_int(f, 2)),
+            round=to_signed32(field_int(f, 3)),
+            block_id=BlockID.decode(field_bytes(f, 4)),
+            timestamp=Timestamp(
+                seconds=to_signed64(field_int(ts_f, 1)),
+                nanos=to_signed32(field_int(ts_f, 2)),
+            ),
+            validator_address=field_bytes(f, 6),
+            validator_index=to_signed32(field_int(f, 7)),
+            signature=field_bytes(f, 8),
+        )
+
+    def validate_basic(self) -> None:
+        """vote.go:167-200."""
+        if not is_vote_type_valid(self.type):
+            raise ValueError("invalid Type")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        self.block_id.validate_basic()
+        if not self.block_id.is_zero() and not self.block_id.is_complete():
+            raise ValueError(f"blockID must be either empty or complete, got: {self.block_id}")
+        if len(self.validator_address) != tmhash.TRUNCATED_SIZE:
+            raise ValueError("expected ValidatorAddress size")
+        if self.validator_index < 0:
+            raise ValueError("negative ValidatorIndex")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
+            raise ValueError("signature is too big")
+
+    def is_absent(self) -> bool:
+        return False
+
+
+def vote_from_commit_sig(
+    cs: CommitSig, commit_block_id: BlockID, height: int, round_: int, idx: int
+) -> Optional[Vote]:
+    """Commit.GetVote (types/block.go:803-815)."""
+    if cs.is_absent():
+        return None
+    return Vote(
+        type=PRECOMMIT_TYPE,
+        height=height,
+        round=round_,
+        block_id=cs.block_id(commit_block_id),
+        timestamp=cs.timestamp,
+        validator_address=cs.validator_address,
+        validator_index=idx,
+        signature=cs.signature,
+    )

@@ -1,0 +1,300 @@
+"""VoteSet — accumulates votes for one (height, round, type).
+
+Reference parity: types/vote_set.go. Tracks the canonical vote per
+validator, per-block vote tallies (votesByBlock), the first +2/3 block
+(maj23), conflicting votes for evidence, and peer maj23 claims.
+
+The signature check in add_vote is the per-vote hot path
+(vote_set.go:203 → vote.Verify); commits arriving via blocksync/light
+flow through types.validation instead, where the device batch engine runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..libs.bits import BitArray
+from .block import BlockID, Commit, CommitSig
+from .validator_set import ValidatorSet
+from .vote import PRECOMMIT_TYPE, Vote, is_vote_type_valid
+
+MAX_VOTES_COUNT = 10000  # vote_set.go:18
+
+
+class ErrVoteUnexpectedStep(ValueError):
+    pass
+
+
+class ErrVoteInvalidValidatorIndex(ValueError):
+    pass
+
+
+class ErrVoteInvalidValidatorAddress(ValueError):
+    pass
+
+
+class ErrVoteNonDeterministicSignature(ValueError):
+    pass
+
+
+class ErrVoteConflictingVotes(ValueError):
+    """NewConflictingVoteError (types/errors.go): carries both votes for
+    DuplicateVoteEvidence construction."""
+
+    def __init__(self, vote_a: Vote, vote_b: Vote):
+        super().__init__("conflicting votes from validator "
+                         f"{vote_a.validator_address.hex().upper()}")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+class _BlockVotes:
+    """vote_set.go:625-660."""
+
+    __slots__ = ("peer_maj23", "bit_array", "votes", "sum")
+
+    def __init__(self, peer_maj23: bool, num_validators: int):
+        self.peer_maj23 = peer_maj23
+        self.bit_array = BitArray(num_validators)
+        self.votes: List[Optional[Vote]] = [None] * num_validators
+        self.sum = 0
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if self.votes[idx] is None:
+            self.bit_array.set_index(idx, True)
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        return self.votes[idx]
+
+
+class VoteSet:
+    """vote_set.go:62-137."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        signed_msg_type: int,
+        val_set: ValidatorSet,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height == 0")
+        if not is_vote_type_valid(signed_msg_type):
+            raise ValueError(f"invalid vote type {signed_msg_type}")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+        self._mtx = threading.RLock()
+        self.votes_bit_array = BitArray(val_set.size())
+        self.votes: List[Optional[Vote]] = [None] * val_set.size()
+        self.sum = 0
+        self.maj23: Optional[BlockID] = None
+        self.votes_by_block: Dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: Dict[str, BlockID] = {}
+
+    # -- adding votes ---------------------------------------------------
+
+    def add_vote(self, vote: Optional[Vote]) -> bool:
+        """vote_set.go:143-216. Returns True if the vote was added; False
+        for exact duplicates; raises for everything else."""
+        with self._mtx:
+            return self._add_vote(vote)
+
+    def _add_vote(self, vote: Optional[Vote]) -> bool:
+        if vote is None:
+            raise ValueError("nil vote")
+        val_index = vote.validator_index
+        val_addr = vote.validator_address
+        block_key = vote.block_id.key()
+
+        if val_index < 0:
+            raise ErrVoteInvalidValidatorIndex("index < 0")
+        if not val_addr:
+            raise ErrVoteInvalidValidatorAddress("empty address")
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type != self.signed_msg_type
+        ):
+            raise ErrVoteUnexpectedStep(
+                f"expected {self.height}/{self.round}/{self.signed_msg_type}, "
+                f"got {vote.height}/{vote.round}/{vote.type}"
+            )
+        lookup_addr, val = self.val_set.get_by_index(val_index)
+        if val is None:
+            raise ErrVoteInvalidValidatorIndex(
+                f"cannot find validator {val_index} in valSet of size {self.val_set.size()}"
+            )
+        if val_addr != lookup_addr:
+            raise ErrVoteInvalidValidatorAddress(
+                f"vote.validator_address ({val_addr.hex()}) does not match address "
+                f"({lookup_addr.hex()}) for vote.validator_index ({val_index})"
+            )
+        existing = self._get_vote(val_index, block_key)
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return False  # duplicate
+            raise ErrVoteNonDeterministicSignature(
+                f"existing vote: {existing}; new vote: {vote}"
+            )
+        # Check signature (the per-vote hot path).
+        vote.verify(self.chain_id, val.pub_key)
+
+        added, conflicting = self._add_verified_vote(vote, block_key, val.voting_power)
+        if conflicting is not None:
+            raise ErrVoteConflictingVotes(conflicting, vote)
+        if not added:
+            raise RuntimeError("expected to add non-conflicting vote")
+        return added
+
+    def _get_vote(self, val_index: int, block_key: bytes) -> Optional[Vote]:
+        existing = self.votes[val_index]
+        if existing is not None and existing.block_id.key() == block_key:
+            return existing
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            return bv.get_by_index(val_index)
+        return None
+
+    def _add_verified_vote(
+        self, vote: Vote, block_key: bytes, voting_power: int
+    ) -> Tuple[bool, Optional[Vote]]:
+        """vote_set.go:230-296."""
+        conflicting: Optional[Vote] = None
+        val_index = vote.validator_index
+
+        existing = self.votes[val_index]
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                raise RuntimeError("addVerifiedVote does not expect duplicate votes")
+            conflicting = existing
+            if self.maj23 is not None and self.maj23.key() == block_key:
+                self.votes[val_index] = vote
+                self.votes_bit_array.set_index(val_index, True)
+        else:
+            self.votes[val_index] = vote
+            self.votes_bit_array.set_index(val_index, True)
+            self.sum += voting_power
+
+        votes_by_block = self.votes_by_block.get(block_key)
+        if votes_by_block is not None:
+            if conflicting is not None and not votes_by_block.peer_maj23:
+                return False, conflicting
+        else:
+            if conflicting is not None:
+                return False, conflicting
+            votes_by_block = _BlockVotes(False, self.val_set.size())
+            self.votes_by_block[block_key] = votes_by_block
+
+        orig_sum = votes_by_block.sum
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        votes_by_block.add_verified_vote(vote, voting_power)
+        if orig_sum < quorum <= votes_by_block.sum:
+            if self.maj23 is None:
+                self.maj23 = vote.block_id
+                for i, v in enumerate(votes_by_block.votes):
+                    if v is not None:
+                        self.votes[i] = v
+        return True, conflicting
+
+    # -- peer claims ----------------------------------------------------
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """vote_set.go:303-337."""
+        with self._mtx:
+            block_key = block_id.key()
+            existing = self.peer_maj23s.get(peer_id)
+            if existing is not None:
+                if existing == block_id:
+                    return
+                raise ValueError(
+                    f"setPeerMaj23: received conflicting blockID from peer {peer_id}: "
+                    f"got {block_id}, expected {existing}"
+                )
+            self.peer_maj23s[peer_id] = block_id
+            votes_by_block = self.votes_by_block.get(block_key)
+            if votes_by_block is not None:
+                votes_by_block.peer_maj23 = True
+            else:
+                self.votes_by_block[block_key] = _BlockVotes(True, self.val_set.size())
+
+    # -- queries --------------------------------------------------------
+
+    def bit_array(self) -> BitArray:
+        with self._mtx:
+            return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> Optional[BitArray]:
+        with self._mtx:
+            bv = self.votes_by_block.get(block_id.key())
+            return bv.bit_array.copy() if bv is not None else None
+
+    def get_by_index(self, val_index: int) -> Optional[Vote]:
+        with self._mtx:
+            if val_index >= len(self.votes):
+                return None
+            return self.votes[val_index]
+
+    def get_by_address(self, address: bytes) -> Optional[Vote]:
+        with self._mtx:
+            idx, val = self.val_set.get_by_address(address)
+            if val is None:
+                raise ValueError("address not in validator set")
+            return self.votes[idx]
+
+    def has_two_thirds_majority(self) -> bool:
+        with self._mtx:
+            return self.maj23 is not None
+
+    def is_commit(self) -> bool:
+        if self.signed_msg_type != PRECOMMIT_TYPE:
+            return False
+        with self._mtx:
+            return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        with self._mtx:
+            return self.sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        with self._mtx:
+            return self.sum == self.val_set.total_voting_power()
+
+    def two_thirds_majority(self) -> Tuple[BlockID, bool]:
+        with self._mtx:
+            if self.maj23 is not None:
+                return self.maj23, True
+            return BlockID(), False
+
+    # -- commit construction --------------------------------------------
+
+    def make_commit(self) -> Commit:
+        """vote_set.go:596-623."""
+        if self.signed_msg_type != PRECOMMIT_TYPE:
+            raise ValueError("cannot make_commit() unless VoteSet.type is precommit")
+        with self._mtx:
+            if self.maj23 is None:
+                raise ValueError("cannot make_commit() unless a blockhash has +2/3")
+            commit_sigs: List[CommitSig] = []
+            for v in self.votes:
+                if v is None:
+                    cs = CommitSig.absent()
+                else:
+                    cs = v.to_commit_sig()
+                    if cs.for_block() and v.block_id != self.maj23:
+                        cs = CommitSig.absent()
+                commit_sigs.append(cs)
+            return Commit(
+                height=self.height,
+                round=self.round,
+                block_id=self.maj23,
+                signatures=commit_sigs,
+            )

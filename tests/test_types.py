@@ -1,0 +1,351 @@
+"""Types layer tests: hashing, wire round-trips, proposer rotation,
+vote sets, and commit verification through both host and device paths.
+
+Mirrors the reference's test strategy for types/ (SURVEY.md §4):
+validator_set_test.go proposer-rotation cases, vote_set_test.go quorum
+cases, block_test.go hashing/ValidateBasic."""
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Data,
+    Fraction,
+    Header,
+    PartSetHeader,
+    Timestamp,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    ErrNotEnoughVotingPowerSigned,
+    ErrVoteConflictingVotes,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.vote import vote_from_commit_sig
+
+CHAIN_ID = "test-chain"
+
+
+def make_validators(n, power=None):
+    """n deterministic validators; returns (privkeys, ValidatorSet)."""
+    pairs = []
+    for i in range(n):
+        sk = ed25519.gen_priv_key(bytes([i + 1]) * 32)
+        pairs.append((sk, Validator.new(sk.pub_key(), power[i] if power else 100)))
+    vset = ValidatorSet.new([v for _, v in pairs])
+    # key privkeys by address so they follow the set's sort order
+    by_addr = {v.address: sk for sk, v in pairs}
+    return [by_addr[v.address] for v in vset.validators], vset
+
+
+def sign_vote(sk, vset, vote_type, height, round_, block_id, ts=None):
+    addr = sk.pub_key().address()
+    idx, _ = vset.get_by_address(addr)
+    vote = Vote(
+        type=vote_type,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=ts or Timestamp(seconds=1_600_000_000, nanos=0),
+        validator_address=addr,
+        validator_index=idx,
+    )
+    sig = sk.sign(vote.sign_bytes(CHAIN_ID))
+    return Vote(**{**vote.__dict__, "signature": sig})
+
+
+def make_block_id(tag=b"\x01"):
+    return BlockID(
+        hash=tag * 32, part_set_header=PartSetHeader(total=1, hash=tag * 32)
+    )
+
+
+class TestBlockHashing:
+    def test_header_hash_deterministic_and_field_sensitive(self):
+        h = Header(
+            chain_id=CHAIN_ID,
+            height=5,
+            time=Timestamp(seconds=100, nanos=5),
+            validators_hash=b"\x01" * 32,
+            next_validators_hash=b"\x02" * 32,
+            consensus_hash=b"\x03" * 32,
+            app_hash=b"app",
+            proposer_address=b"\x04" * 20,
+        )
+        h2 = Header(**{**h.__dict__, "height": 6})
+        assert h.hash() != h2.hash()
+        assert len(h.hash()) == 32
+        assert Header(chain_id=CHAIN_ID, height=5).hash() == b""  # no valhash
+
+    def test_header_wire_roundtrip(self):
+        h = Header(
+            chain_id=CHAIN_ID,
+            height=7,
+            time=Timestamp(seconds=123, nanos=456),
+            last_block_id=make_block_id(),
+            validators_hash=b"\x01" * 32,
+            proposer_address=b"\x04" * 20,
+        )
+        assert Header.decode(h.encode()) == h
+
+    def test_commit_hash_and_roundtrip(self):
+        cs = CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=b"\x05" * 20,
+            timestamp=Timestamp(seconds=9),
+            signature=b"\x06" * 64,
+        )
+        commit = Commit(height=3, round=1, block_id=make_block_id(), signatures=[cs])
+        assert len(commit.hash()) == 32
+        rt = Commit.decode(commit.encode())
+        assert rt.height == 3 and rt.round == 1 and rt.signatures == [cs]
+        assert rt.block_id == commit.block_id
+
+    def test_block_fill_header_and_validate(self):
+        lc = Commit(
+            height=1,
+            round=0,
+            block_id=make_block_id(),
+            signatures=[
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=b"\x05" * 20,
+                    timestamp=Timestamp(seconds=9),
+                    signature=b"\x06" * 64,
+                )
+            ],
+        )
+        b = Block(
+            header=Header(
+                chain_id=CHAIN_ID,
+                height=2,
+                validators_hash=b"\x01" * 32,
+                next_validators_hash=b"\x01" * 32,
+                consensus_hash=b"\x02" * 32,
+                proposer_address=b"\x04" * 20,
+            ),
+            data=Data(txs=[b"tx1", b"tx2"]),
+            last_commit=lc,
+        )
+        b.fill_header()
+        b.validate_basic()
+        rt = Block.decode(b.encode())
+        assert rt.header == b.header
+        assert rt.data.txs == [b"tx1", b"tx2"]
+        assert rt.last_commit.hash() == lc.hash()
+
+
+class TestPartSet:
+    def test_chunk_proof_reassemble(self):
+        data = bytes(range(256)) * 1024  # 256 KiB -> 4 parts
+        ps = PartSet.from_data(data)
+        assert ps.total() == 4 and ps.is_complete()
+        ps2 = PartSet.new_from_header(ps.header())
+        # add out of order; duplicates rejected as False
+        for idx in (2, 0, 3, 1):
+            assert ps2.add_part(ps.get_part(idx))
+        assert not ps2.add_part(ps.get_part(1))
+        assert ps2.is_complete()
+        assert ps2.assemble() == data
+
+    def test_corrupt_part_rejected(self):
+        data = b"x" * 200000
+        ps = PartSet.from_data(data)
+        ps2 = PartSet.new_from_header(ps.header())
+        p = ps.get_part(0)
+        from tendermint_tpu.types.part_set import Part
+
+        bad = Part(index=0, bytes=p.bytes[:-1] + b"\x00", proof=p.proof)
+        with pytest.raises(ValueError):
+            ps2.add_part(bad)
+
+
+class TestValidatorSet:
+    def test_sorting_and_hash(self):
+        _, vset = make_validators(5, power=[5, 4, 3, 2, 1])
+        powers = [v.voting_power for v in vset.validators]
+        assert powers == sorted(powers, reverse=True)
+        assert len(vset.hash()) == 32
+
+    def test_proposer_rotation_is_fair(self):
+        _, vset = make_validators(3, power=[1, 2, 3])
+        counts = {}
+        vs = vset.copy()
+        for _ in range(600):
+            p = vs.get_proposer()
+            counts[p.address] = counts.get(p.address, 0) + 1
+            vs.increment_proposer_priority(1)
+        by_power = {v.address: v.voting_power for v in vset.validators}
+        # each validator proposes proportionally to voting power (1:2:3)
+        for addr, c in counts.items():
+            assert abs(c - 100 * by_power[addr]) <= 2, (c, by_power[addr])
+
+    def test_update_with_change_set(self):
+        sks, vset = make_validators(3, power=[10, 10, 10])
+        tvp = vset.total_voting_power()
+        assert tvp == 30
+        # bump one validator, remove another, add a new one
+        newsk = ed25519.gen_priv_key(bytes([99]) * 32)
+        changes = [
+            Validator.new(sks[0].pub_key(), 20),
+            Validator.new(sks[1].pub_key(), 0),  # removal
+            Validator.new(newsk.pub_key(), 5),
+        ]
+        vset.update_with_change_set(changes)
+        assert vset.size() == 3
+        assert vset.total_voting_power() == 35
+        _, v = vset.get_by_address(sks[0].pub_key().address())
+        assert v.voting_power == 20
+        assert not vset.has_address(sks[1].pub_key().address())
+
+    def test_from_existing_preserves_priorities(self):
+        _, vset = make_validators(4)
+        vset.increment_proposer_priority(3)
+        rebuilt = ValidatorSet.from_existing([v.copy() for v in vset.validators])
+        assert [v.proposer_priority for v in rebuilt.validators] == [
+            v.proposer_priority for v in vset.validators
+        ]
+
+    def test_wire_roundtrip(self):
+        _, vset = make_validators(3)
+        rt = ValidatorSet.decode(vset.encode())
+        assert rt.hash() == vset.hash()
+        assert rt.total_voting_power() == vset.total_voting_power()
+
+
+def build_commit(n=4, power=None, height=10, round_=1):
+    sks, vset = make_validators(n, power=power)
+    block_id = make_block_id()
+    vote_set = VoteSet(CHAIN_ID, height, round_, PRECOMMIT_TYPE, vset)
+    for sk in sks:
+        vote_set.add_vote(sign_vote(sk, vset, PRECOMMIT_TYPE, height, round_, block_id))
+    return sks, vset, block_id, vote_set.make_commit()
+
+
+class TestVoteSet:
+    def test_quorum_tracking(self):
+        sks, vset = make_validators(4)  # 4 x 100 power, quorum = 267
+        block_id = make_block_id()
+        vs = VoteSet(CHAIN_ID, 10, 0, PREVOTE_TYPE, vset)
+        for i, sk in enumerate(sks[:2]):
+            assert vs.add_vote(sign_vote(sk, vset, PREVOTE_TYPE, 10, 0, block_id))
+        assert not vs.has_two_thirds_majority()
+        assert vs.add_vote(sign_vote(sks[2], vset, PREVOTE_TYPE, 10, 0, block_id))
+        assert vs.has_two_thirds_majority()
+        maj, ok = vs.two_thirds_majority()
+        assert ok and maj == block_id
+        # duplicate -> False, not an error
+        assert not vs.add_vote(sign_vote(sks[2], vset, PREVOTE_TYPE, 10, 0, block_id))
+
+    def test_conflicting_vote_raises_and_is_tracked(self):
+        sks, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 10, 0, PREVOTE_TYPE, vset)
+        a, b = make_block_id(b"\x0a"), make_block_id(b"\x0b")
+        assert vs.add_vote(sign_vote(sks[0], vset, PREVOTE_TYPE, 10, 0, a))
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            vs.add_vote(sign_vote(sks[0], vset, PREVOTE_TYPE, 10, 0, b))
+        assert ei.value.vote_a.block_id == a
+        assert ei.value.vote_b.block_id == b
+
+    def test_wrong_step_and_bad_signature(self):
+        sks, vset = make_validators(2)
+        vs = VoteSet(CHAIN_ID, 10, 0, PREVOTE_TYPE, vset)
+        with pytest.raises(ValueError):
+            vs.add_vote(sign_vote(sks[0], vset, PREVOTE_TYPE, 11, 0, make_block_id()))
+        good = sign_vote(sks[0], vset, PREVOTE_TYPE, 10, 0, make_block_id())
+        bad = Vote(**{**good.__dict__, "signature": b"\x00" * 64})
+        with pytest.raises(ValueError):
+            vs.add_vote(bad)
+
+    def test_make_commit_includes_nil_and_absent(self):
+        sks, vset = make_validators(4)
+        block_id = make_block_id()
+        vs = VoteSet(CHAIN_ID, 10, 0, PRECOMMIT_TYPE, vset)
+        for sk in sks[:3]:
+            vs.add_vote(sign_vote(sk, vset, PRECOMMIT_TYPE, 10, 0, block_id))
+        # 4th validator votes nil
+        vs.add_vote(sign_vote(sks[3], vset, PRECOMMIT_TYPE, 10, 0, BlockID()))
+        commit = vs.make_commit()
+        flags = [cs.block_id_flag for cs in commit.signatures]
+        assert flags.count(BLOCK_ID_FLAG_COMMIT) == 3
+        assert commit.block_id == block_id
+
+
+class TestVerifyCommit:
+    def test_verify_commit_host_path(self):
+        sks, vset, block_id, commit = build_commit(4)
+        verify_commit(CHAIN_ID, vset, block_id, 10, commit)  # no raise
+        verify_commit_light(CHAIN_ID, vset, block_id, 10, commit)
+        verify_commit_light_trusting(CHAIN_ID, vset, commit, Fraction(1, 3))
+
+    def test_verify_commit_device_path(self, monkeypatch):
+        import tendermint_tpu.ops  # noqa: F401 — installs device factory
+
+        monkeypatch.setenv("TM_TPU_FORCE_DEVICE", "1")
+        sks, vset, block_id, commit = build_commit(4)
+        verify_commit(CHAIN_ID, vset, block_id, 10, commit)
+
+    def test_verify_commit_device_blames_bad_signature(self, monkeypatch):
+        import tendermint_tpu.ops  # noqa: F401
+
+        monkeypatch.setenv("TM_TPU_FORCE_DEVICE", "1")
+        sks, vset, block_id, commit = build_commit(4)
+        bad = CommitSig(
+            block_id_flag=commit.signatures[2].block_id_flag,
+            validator_address=commit.signatures[2].validator_address,
+            timestamp=commit.signatures[2].timestamp,
+            signature=b"\x01" * 64,
+        )
+        commit.signatures[2] = bad
+        with pytest.raises(ValueError, match=r"wrong signature \(#2\)"):
+            verify_commit(CHAIN_ID, vset, block_id, 10, commit)
+
+    def test_not_enough_power(self):
+        sks, vset = make_validators(4)
+        block_id = make_block_id()
+        vs = VoteSet(CHAIN_ID, 10, 1, PRECOMMIT_TYPE, vset)
+        for sk in sks[:3]:
+            vs.add_vote(sign_vote(sk, vset, PRECOMMIT_TYPE, 10, 1, block_id))
+        commit = vs.make_commit()
+        # drop one signature to absent: tallied 200 of 400 < 2/3
+        commit.signatures[0] = CommitSig.absent()
+        commit.signatures[1] = CommitSig.absent()
+        with pytest.raises(ErrNotEnoughVotingPowerSigned):
+            verify_commit(CHAIN_ID, vset, block_id, 10, commit)
+
+    def test_commit_height_block_id_mismatch(self):
+        sks, vset, block_id, commit = build_commit(4)
+        with pytest.raises(ValueError):
+            verify_commit(CHAIN_ID, vset, block_id, 11, commit)
+        with pytest.raises(ValueError):
+            verify_commit(CHAIN_ID, vset, make_block_id(b"\x0f"), 10, commit)
+
+    def test_light_trusting_by_address_lookup(self):
+        # trusting path looks up validators by address: use a superset valset
+        sks, vset, block_id, commit = build_commit(4)
+        extra = ed25519.gen_priv_key(bytes([77]) * 32)
+        bigger = ValidatorSet.new(
+            [v.copy() for v in vset.validators] + [Validator.new(extra.pub_key(), 100)]
+        )
+        verify_commit_light_trusting(CHAIN_ID, bigger, commit, Fraction(1, 3))
+
+    def test_vote_roundtrip_and_commit_sig(self):
+        sks, vset = make_validators(2)
+        v = sign_vote(sks[0], vset, PRECOMMIT_TYPE, 5, 0, make_block_id())
+        assert Vote.decode(v.encode()) == v
+        cs = v.to_commit_sig()
+        assert cs.for_block()
+        back = vote_from_commit_sig(cs, v.block_id, 5, 0, v.validator_index)
+        assert back.sign_bytes(CHAIN_ID) == v.sign_bytes(CHAIN_ID)
