@@ -1,0 +1,7 @@
+"""tendermint_tpu.abci — the application boundary (reference abci/, L5)."""
+
+from . import types  # noqa: F401
+from .application import Application, BaseApplication  # noqa: F401
+from .client import LocalClient, SocketClient, new_client  # noqa: F401
+from .kvstore import KVStoreApplication, PersistentKVStoreApplication  # noqa: F401
+from .server import ABCIServer  # noqa: F401

@@ -1,0 +1,64 @@
+"""The ABCI Application interface.
+
+Reference parity: abci/types/application.go:11-31 — the 12-method contract
+a replicated application implements, plus BaseApplication defaults
+(application.go:36-92) so apps override only what they need.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from . import types as abci
+
+
+class Application(abc.ABC):
+    """abci/types/application.go:11-31."""
+
+    # Info/Query connection
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo()
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        return abci.ResponseQuery(code=abci.CODE_TYPE_OK)
+
+    # Mempool connection
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    # Consensus connection
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock()
+
+    def commit(self) -> abci.ResponseCommit:
+        return abci.ResponseCommit()
+
+    # State sync connection
+    def list_snapshots(self) -> abci.ResponseListSnapshots:
+        return abci.ResponseListSnapshots()
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        return abci.ResponseOfferSnapshot()
+
+    def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        return abci.ResponseLoadSnapshotChunk()
+
+    def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        return abci.ResponseApplySnapshotChunk()
+
+
+class BaseApplication(Application):
+    """Concrete no-op application (abci/types/application.go:36-92)."""

@@ -1,0 +1,251 @@
+"""ABCI clients: local (in-process) and socket.
+
+Reference parity: abci/client/ — local_client.go:29 (mutex-serialized
+in-process calls), socket_client.go:54 (async pipelined request/response
+over a length-delimited stream). The socket client here pipelines via a
+writer thread + reader thread with a response futures queue, mirroring
+the reference's sendRequestRoutine/recvResponseRoutine.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from concurrent.futures import Future
+from typing import Callable, Optional, Tuple
+
+from . import types as abci
+from .application import Application
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class LocalClient:
+    """abci/client/local_client.go — direct calls under one mutex."""
+
+    def __init__(self, app: Application):
+        self._app = app
+        self._mtx = threading.Lock()
+
+    def echo(self, msg: str) -> str:
+        return msg
+
+    def flush(self) -> None:
+        return None
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        with self._mtx:
+            return self._app.info(req)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        with self._mtx:
+            return self._app.query(req)
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        with self._mtx:
+            return self._app.check_tx(req)
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        with self._mtx:
+            return self._app.init_chain(req)
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        with self._mtx:
+            return self._app.begin_block(req)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        with self._mtx:
+            return self._app.deliver_tx(req)
+
+    def deliver_tx_async(self, req: abci.RequestDeliverTx) -> Future:
+        fut: Future = Future()
+        fut.set_result(self.deliver_tx(req))
+        return fut
+
+    def check_tx_async(self, req: abci.RequestCheckTx) -> Future:
+        fut: Future = Future()
+        fut.set_result(self.check_tx(req))
+        return fut
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        with self._mtx:
+            return self._app.end_block(req)
+
+    def commit(self) -> abci.ResponseCommit:
+        with self._mtx:
+            return self._app.commit()
+
+    def list_snapshots(self) -> abci.ResponseListSnapshots:
+        with self._mtx:
+            return self._app.list_snapshots()
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        with self._mtx:
+            return self._app.offer_snapshot(req)
+
+    def load_snapshot_chunk(self, req) -> abci.ResponseLoadSnapshotChunk:
+        with self._mtx:
+            return self._app.load_snapshot_chunk(req)
+
+    def apply_snapshot_chunk(self, req) -> abci.ResponseApplySnapshotChunk:
+        with self._mtx:
+            return self._app.apply_snapshot_chunk(req)
+
+    def close(self) -> None:
+        pass
+
+
+class SocketClient:
+    """abci/client/socket_client.go — pipelined over TCP or unix socket."""
+
+    def __init__(self, address: str):
+        self._address = address
+        self._sock = _dial(address)
+        self._pending: "queue.Queue[Tuple[str, Future]]" = queue.Queue()
+        self._wbuf_lock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._recv_routine, daemon=True)
+        self._reader.start()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _send(self, kind: str, req) -> Future:
+        payload = abci.enc_request_payload(kind, req)
+        framed = abci.write_message(abci.encode_request(kind, payload))
+        fut: Future = Future()
+        with self._wbuf_lock:
+            self._pending.put((kind, fut))
+            self._sock.sendall(framed)
+        return fut
+
+    def _call(self, kind: str, req):
+        fut = self._send(kind, req)
+        # flush after each sync call, like socket_client.go's *Sync methods
+        flush_fut = self._send("flush", None)
+        res = fut.result(timeout=30)
+        flush_fut.result(timeout=30)
+        return res
+
+    def _recv_routine(self) -> None:
+        buf = b""
+        try:
+            while not self._closed:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while True:
+                    try:
+                        msg, consumed = abci.read_message(buf)
+                    except ValueError:
+                        break
+                    buf = buf[consumed:]
+                    kind, payload = abci.decode_response(msg)
+                    want_kind, fut = self._pending.get_nowait()
+                    if kind == "exception":
+                        fut.set_exception(
+                            ClientError(abci.dec_response_payload(kind, payload))
+                        )
+                    elif kind != want_kind:
+                        fut.set_exception(
+                            ClientError(f"unexpected response {kind}, want {want_kind}")
+                        )
+                    else:
+                        fut.set_result(abci.dec_response_payload(kind, payload))
+        except (OSError, queue.Empty):
+            pass
+        # fail whatever is left
+        while True:
+            try:
+                _, fut = self._pending.get_nowait()
+                if not fut.done():
+                    fut.set_exception(ClientError("connection closed"))
+            except queue.Empty:
+                break
+
+    # -- API ------------------------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return self._call("echo", msg)
+
+    def flush(self) -> None:
+        self._send("flush", None).result(timeout=30)
+
+    def info(self, req) -> abci.ResponseInfo:
+        return self._call("info", req)
+
+    def query(self, req) -> abci.ResponseQuery:
+        return self._call("query", req)
+
+    def check_tx(self, req) -> abci.ResponseCheckTx:
+        return self._call("check_tx", req)
+
+    def check_tx_async(self, req) -> Future:
+        return self._send("check_tx", req)
+
+    def init_chain(self, req) -> abci.ResponseInitChain:
+        return self._call("init_chain", req)
+
+    def begin_block(self, req) -> abci.ResponseBeginBlock:
+        return self._call("begin_block", req)
+
+    def deliver_tx(self, req) -> abci.ResponseDeliverTx:
+        return self._call("deliver_tx", req)
+
+    def deliver_tx_async(self, req) -> Future:
+        """Pipelined deliver (execution.go:294 execBlockOnProxyApp pattern)."""
+        return self._send("deliver_tx", req)
+
+    def end_block(self, req) -> abci.ResponseEndBlock:
+        return self._call("end_block", req)
+
+    def commit(self) -> abci.ResponseCommit:
+        return self._call("commit", None)
+
+    def list_snapshots(self) -> abci.ResponseListSnapshots:
+        return self._call("list_snapshots", None)
+
+    def offer_snapshot(self, req) -> abci.ResponseOfferSnapshot:
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req) -> abci.ResponseLoadSnapshotChunk:
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req) -> abci.ResponseApplySnapshotChunk:
+        return self._call("apply_snapshot_chunk", req)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def _dial(address: str) -> socket.socket:
+    """tcp://host:port or unix:///path (abci/client/client.go address form)."""
+    if address.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(address[len("unix://") :])
+        return s
+    if address.startswith("tcp://"):
+        address = address[len("tcp://") :]
+    host, _, port = address.rpartition(":")
+    s = socket.create_connection((host or "127.0.0.1", int(port)))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def new_client(address: str, transport: str, app: Optional[Application] = None):
+    """abci/client/creators.go: "socket" dials; local wraps in-process."""
+    if transport == "local":
+        if app is None:
+            raise ValueError("local transport needs an app")
+        return LocalClient(app)
+    if transport == "socket":
+        return SocketClient(address)
+    raise ValueError(f"unknown ABCI transport {transport!r}")

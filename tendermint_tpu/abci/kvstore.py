@@ -1,0 +1,165 @@
+"""Example kvstore application.
+
+Reference parity: abci/example/kvstore/ — txs are "key=value" (or the raw
+tx as both key and value), state is a KV map with an app hash over the tx
+count; the persistent variant handles validator updates via txs of the
+form "val:<base64 pubkey>!<power>" (persistent_kvstore.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Dict, List, Optional
+
+from ..crypto import ed25519
+from ..crypto.encoding import pubkey_to_proto, pubkey_from_proto
+from ..db import DB, MemDB
+from . import types as abci
+from .application import BaseApplication
+
+VALIDATOR_TX_PREFIX = b"val:"
+PROTOCOL_VERSION = 1
+
+
+class KVStoreApplication(BaseApplication):
+    """abci/example/kvstore/kvstore.go."""
+
+    def __init__(self, db: Optional[DB] = None):
+        self._db = db or MemDB()
+        self._height = 0
+        self._app_hash = b""
+        self._size = 0
+        self._restore()
+
+    # -- state persistence ---------------------------------------------
+
+    def _restore(self) -> None:
+        raw = self._db.get(b"__state__")
+        if raw is not None:
+            self._height, self._size = struct.unpack(">qq", raw[:16])
+
+    def _persist(self) -> None:
+        self._db.set(b"__state__", struct.pack(">qq", self._height, self._size))
+
+    # -- ABCI -----------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"{{\"size\":{self._size}}}",
+            version="kvstore-tpu-0.1",
+            app_version=PROTOCOL_VERSION,
+            last_block_height=self._height,
+            last_block_app_hash=self._compute_app_hash(),
+        )
+
+    def _compute_app_hash(self) -> bytes:
+        if self._height == 0:
+            return b""
+        return struct.pack(">q", self._size).ljust(8, b"\x00")
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if not req.tx:
+            return abci.ResponseCheckTx(code=1, log="empty tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        key, _, value = req.tx.partition(b"=")
+        if not value:
+            key = value = req.tx
+        self._db.set(b"kv:" + key, value)
+        self._size += 1
+        events = [
+            abci.Event(
+                type="app",
+                attributes=[
+                    abci.EventAttribute(key="creator", value="Cosmoshi Netowoko", index=True),
+                    abci.EventAttribute(key="key", value=key.decode("utf-8", "replace"), index=True),
+                ],
+            )
+        ]
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, events=events)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        self._height = req.height
+        return abci.ResponseEndBlock()
+
+    def commit(self) -> abci.ResponseCommit:
+        self._persist()
+        return abci.ResponseCommit(data=self._compute_app_hash())
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/key" or req.path == "":
+            v = self._db.get(b"kv:" + req.data)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK,
+                key=req.data,
+                value=v or b"",
+                log="exists" if v is not None else "does not exist",
+                height=self._height,
+            )
+        return abci.ResponseQuery(code=1, log=f"unexpected path {req.path}")
+
+
+class PersistentKVStoreApplication(KVStoreApplication):
+    """abci/example/kvstore/persistent_kvstore.go — adds validator-set
+    updates driven by "val:<base64 pub>!<power>" transactions."""
+
+    def __init__(self, db: Optional[DB] = None):
+        super().__init__(db)
+        self._val_updates: Dict[bytes, abci.ValidatorUpdate] = {}
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for v in req.validators:
+            self._store_validator(v)
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self._val_updates = {}
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            return self._exec_validator_tx(req.tx)
+        return super().deliver_tx(req)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        super().end_block(req)
+        return abci.ResponseEndBlock(validator_updates=list(self._val_updates.values()))
+
+    def _exec_validator_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
+        body = tx[len(VALIDATOR_TX_PREFIX) :]
+        pub_b64, _, power_s = body.partition(b"!")
+        try:
+            pub_raw = base64.b64decode(pub_b64)
+            power = int(power_s)
+        except Exception:
+            return abci.ResponseDeliverTx(code=1, log="invalid validator tx")
+        pk = ed25519.PubKey(pub_raw)
+        update = abci.ValidatorUpdate(pub_key=pubkey_to_proto(pk), power=power)
+        self._val_updates[pub_raw] = update
+        self._store_validator(update)
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def _store_validator(self, v: abci.ValidatorUpdate) -> None:
+        pk = pubkey_from_proto(v.pub_key)
+        key = b"validator:" + pk.bytes()
+        if v.power == 0:
+            self._db.delete(key)
+        else:
+            self._db.set(key, struct.pack(">q", v.power))
+
+    def validators(self) -> List[abci.ValidatorUpdate]:
+        out = []
+        for k, raw in self._db.iterator(b"validator:", b"validator;"):
+            pk = ed25519.PubKey(k[len(b"validator:") :])
+            out.append(
+                abci.ValidatorUpdate(
+                    pub_key=pubkey_to_proto(pk), power=struct.unpack(">q", raw)[0]
+                )
+            )
+        return out
+
+
+def make_validator_tx(pub_key_bytes: bytes, power: int) -> bytes:
+    return VALIDATOR_TX_PREFIX + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()

@@ -1,0 +1,138 @@
+"""ABCI socket server — serve an Application over TCP or unix socket.
+
+Reference parity: abci/server/socket_server.go. One connection = one
+request stream processed in order (the app mutex serializes across
+connections, matching the reference's global app lock).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+from . import types as abci
+from .application import Application
+
+
+class ABCIServer:
+    def __init__(self, address: str, app: Application):
+        self._app = app
+        self._app_mtx = threading.Lock()
+        self._address = address
+        self._threads = []
+        self._listener: Optional[socket.socket] = None
+        self._closed = False
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    def start(self) -> None:
+        if self._address.startswith("unix://"):
+            path = self._address[len("unix://") :]
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(path)
+        else:
+            addr = self._address
+            if addr.startswith("tcp://"):
+                addr = addr[len("tcp://") :]
+            host, _, port = addr.rpartition(":")
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host or "127.0.0.1", int(port)))
+            if int(port) == 0:
+                h, p = self._listener.getsockname()
+                self._address = f"tcp://{h}:{p}"
+        self._listener.listen(8)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while not self._closed:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                out = bytearray()
+                while True:
+                    try:
+                        msg, consumed = abci.read_message(buf)
+                    except ValueError:
+                        break
+                    buf = buf[consumed:]
+                    out += self._handle(msg)
+                if out:
+                    conn.sendall(bytes(out))
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    def _handle(self, msg: bytes) -> bytes:
+        try:
+            kind, payload = abci.decode_request(msg)
+            req = abci.dec_request_payload(kind, payload)
+            with self._app_mtx:
+                resp_kind, resp = self._dispatch(kind, req)
+        except Exception as e:  # noqa: BLE001 — exceptions go on the wire
+            resp_kind, resp = "exception", str(e)
+        framed = abci.write_message(
+            abci.encode_response(resp_kind, abci.enc_response_payload(resp_kind, resp))
+        )
+        return framed
+
+    def _dispatch(self, kind: str, req) -> Tuple[str, object]:
+        app = self._app
+        if kind == "echo":
+            return "echo", req
+        if kind == "flush":
+            return "flush", None
+        if kind == "info":
+            return "info", app.info(req)
+        if kind == "init_chain":
+            return "init_chain", app.init_chain(req)
+        if kind == "query":
+            return "query", app.query(req)
+        if kind == "begin_block":
+            return "begin_block", app.begin_block(req)
+        if kind == "check_tx":
+            return "check_tx", app.check_tx(req)
+        if kind == "deliver_tx":
+            return "deliver_tx", app.deliver_tx(req)
+        if kind == "end_block":
+            return "end_block", app.end_block(req)
+        if kind == "commit":
+            return "commit", app.commit()
+        if kind == "list_snapshots":
+            return "list_snapshots", app.list_snapshots()
+        if kind == "offer_snapshot":
+            return "offer_snapshot", app.offer_snapshot(req)
+        if kind == "load_snapshot_chunk":
+            return "load_snapshot_chunk", app.load_snapshot_chunk(req)
+        if kind == "apply_snapshot_chunk":
+            return "apply_snapshot_chunk", app.apply_snapshot_chunk(req)
+        raise ValueError(f"unknown request kind {kind}")
+
+    def stop(self) -> None:
+        self._closed = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass

@@ -1,0 +1,258 @@
+"""tendermint_tpu.db — ordered key-value store abstraction.
+
+Reference parity: the external tm-db interface the reference builds its
+stores on (SURVEY.md L4; config/config.go:179-194 backend selection).
+Backends here:
+  - MemDB:    in-memory ordered map (tm-db memdb) — tests, light store
+  - SQLiteDB: persistent backend on Python's stdlib sqlite3 (replaces
+    goleveldb as the zero-dependency default; WAL mode, single writer)
+  - PrefixDB: namespaced view over another DB (tm-db prefixdb)
+
+Iteration is byte-order ascending over [start, end) like tm-db's Iterator;
+reverse_iterator mirrors ReverseIterator ((start, end] semantics are NOT
+copied — tm-db uses [start, end) reversed, which is what we do).
+"""
+
+from __future__ import annotations
+
+import abc
+import bisect
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class DB(abc.ABC):
+    @abc.abstractmethod
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> Iterator[Tuple[bytes, bytes]]: ...
+
+    @abc.abstractmethod
+    def reverse_iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> Iterator[Tuple[bytes, bytes]]: ...
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def write_batch(self, ops: List[Tuple[str, bytes, Optional[bytes]]]) -> None:
+        """Atomic-ish batch: ops are ("set", k, v) or ("delete", k, None)."""
+        for op, k, v in ops:
+            if op == "set":
+                self.set(k, v)  # type: ignore[arg-type]
+            elif op == "delete":
+                self.delete(k)
+            else:
+                raise ValueError(f"unknown batch op {op}")
+
+    def close(self) -> None:
+        pass
+
+
+class Batch:
+    """tm-db Batch shim: accumulate then write atomically."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._ops: List[Tuple[str, bytes, Optional[bytes]]] = []
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._ops.append(("set", bytes(key), bytes(value)))
+
+    def delete(self, key: bytes) -> None:
+        self._ops.append(("delete", bytes(key), None))
+
+    def write(self) -> None:
+        self._db.write_batch(self._ops)
+        self._ops = []
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []
+        self._mtx = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._mtx:
+            return self._data.get(bytes(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        key = bytes(key)
+        with self._mtx:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        key = bytes(key)
+        with self._mtx:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def _range(self, start: Optional[bytes], end: Optional[bytes]) -> List[bytes]:
+        with self._mtx:
+            lo = bisect.bisect_left(self._keys, start) if start is not None else 0
+            hi = bisect.bisect_left(self._keys, end) if end is not None else len(self._keys)
+            return self._keys[lo:hi]
+
+    def iterator(self, start=None, end=None):
+        for k in self._range(start, end):
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def reverse_iterator(self, start=None, end=None):
+        for k in reversed(self._range(start, end)):
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+
+class SQLiteDB(DB):
+    """Persistent ordered KV on sqlite3 (stdlib; replaces goleveldb)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._mtx = threading.RLock()
+        with self._mtx:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._mtx:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return bytes(row[0]) if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._mtx:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def write_batch(self, ops) -> None:
+        with self._mtx:
+            for op, k, v in ops:
+                if op == "set":
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (k, v)
+                    )
+                else:
+                    self._conn.execute("DELETE FROM kv WHERE k = ?", (k,))
+            self._conn.commit()
+
+    def _query(self, start, end, desc: bool):
+        q = "SELECT k, v FROM kv"
+        clauses, args = [], []
+        if start is not None:
+            clauses.append("k >= ?")
+            args.append(bytes(start))
+        if end is not None:
+            clauses.append("k < ?")
+            args.append(bytes(end))
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        q += " ORDER BY k DESC" if desc else " ORDER BY k ASC"
+        with self._mtx:
+            rows = self._conn.execute(q, args).fetchall()
+        return [(bytes(k), bytes(v)) for k, v in rows]
+
+    def iterator(self, start=None, end=None):
+        yield from self._query(start, end, desc=False)
+
+    def reverse_iterator(self, start=None, end=None):
+        yield from self._query(start, end, desc=True)
+
+    def close(self) -> None:
+        with self._mtx:
+            self._conn.close()
+
+
+class PrefixDB(DB):
+    """Namespaced view (tm-db prefixdb)."""
+
+    def __init__(self, db: DB, prefix: bytes):
+        self._db = db
+        self._prefix = bytes(prefix)
+
+    def _k(self, key: bytes) -> bytes:
+        return self._prefix + bytes(key)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._db.get(self._k(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._db.set(self._k(key), value)
+
+    def delete(self, key: bytes) -> None:
+        self._db.delete(self._k(key))
+
+    def write_batch(self, ops) -> None:
+        self._db.write_batch([(op, self._k(k), v) for op, k, v in ops])
+
+    def _strip(self, it):
+        n = len(self._prefix)
+        for k, v in it:
+            yield k[n:], v
+
+    def iterator(self, start=None, end=None):
+        s = self._k(start) if start is not None else self._prefix
+        if end is not None:
+            e = self._k(end)
+        else:
+            e = _prefix_end(self._prefix)
+        yield from self._strip(self._db.iterator(s, e))
+
+    def reverse_iterator(self, start=None, end=None):
+        s = self._k(start) if start is not None else self._prefix
+        if end is not None:
+            e = self._k(end)
+        else:
+            e = _prefix_end(self._prefix)
+        yield from self._strip(self._db.reverse_iterator(s, e))
+
+
+def _prefix_end(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every key with this prefix."""
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] != 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return None
+
+
+def backend(kind: str, path: Optional[str] = None) -> DB:
+    """config/config.go:179-194 backend selection, TPU-build edition."""
+    if kind in ("memdb", "mem"):
+        return MemDB()
+    if kind in ("sqlite", "goleveldb", "default"):
+        if not path:
+            raise ValueError("persistent backend needs a path")
+        return SQLiteDB(path)
+    raise ValueError(f"unknown db backend {kind!r}")

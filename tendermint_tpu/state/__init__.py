@@ -1,0 +1,169 @@
+"""State — the replicated chain state between blocks.
+
+Reference parity: internal/state/state.go. Holds the validator-set window
+(Last/Current/Next), consensus params, last results/app hashes; produces
+proposal blocks (MakeBlock) with BFT-median block time (time.go
+weightedMedian).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Tuple
+
+from ..types import (
+    Block,
+    BlockID,
+    Commit,
+    Data,
+    Header,
+    Timestamp,
+    Validator,
+    ValidatorSet,
+    Version,
+)
+from ..types.genesis import GenesisDoc
+from ..types.params import ConsensusParams, default_consensus_params
+from ..types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+from ..version import BLOCK_PROTOCOL
+
+# InitStateVersion: the Consensus version an empty state starts at
+# (internal/state/state.go:38-44).
+INIT_STATE_VERSION = Version(block=BLOCK_PROTOCOL, app=0)
+
+
+@dataclass
+class State:
+    """internal/state/state.go:66-101."""
+
+    version: Version = field(default_factory=lambda: INIT_STATE_VERSION)
+    chain_id: str = ""
+    initial_height: int = 1
+
+    last_block_height: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_block_time: Timestamp = field(default_factory=Timestamp.zero)
+
+    next_validators: Optional[ValidatorSet] = None
+    validators: Optional[ValidatorSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    last_height_validators_changed: int = 0
+
+    consensus_params: ConsensusParams = field(default_factory=default_consensus_params)
+    last_height_consensus_params_changed: int = 0
+
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return State(
+            version=self.version,
+            chain_id=self.chain_id,
+            initial_height=self.initial_height,
+            last_block_height=self.last_block_height,
+            last_block_id=self.last_block_id,
+            last_block_time=self.last_block_time,
+            next_validators=self.next_validators.copy() if self.next_validators else None,
+            validators=self.validators.copy() if self.validators else None,
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+            last_height_validators_changed=self.last_height_validators_changed,
+            consensus_params=self.consensus_params,
+            last_height_consensus_params_changed=self.last_height_consensus_params_changed,
+            last_results_hash=self.last_results_hash,
+            app_hash=self.app_hash,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def equals(self, other: "State") -> bool:
+        return (
+            self.chain_id == other.chain_id
+            and self.last_block_height == other.last_block_height
+            and self.last_block_id == other.last_block_id
+            and self.app_hash == other.app_hash
+        )
+
+    # -- block production ----------------------------------------------
+
+    def make_block(
+        self,
+        height: int,
+        txs: List[bytes],
+        commit: Optional[Commit],
+        evidence: List[bytes],
+        proposer_address: bytes,
+    ) -> Tuple[Block, PartSet]:
+        """state.go:255-284."""
+        if height == self.initial_height:
+            timestamp = self.last_block_time  # genesis time
+        else:
+            timestamp = median_time(commit, self.last_validators)
+        header = Header(
+            version=self.version,
+            chain_id=self.chain_id,
+            height=height,
+            time=timestamp,
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash_consensus_params(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        block = Block(header=header, data=Data(txs=list(txs)), evidence=list(evidence), last_commit=commit)
+        block.fill_header()
+        parts = PartSet.from_data(block.encode(), BLOCK_PART_SIZE_BYTES)
+        return block, parts
+
+
+def median_time(commit: Optional[Commit], validators: Optional[ValidatorSet]) -> Timestamp:
+    """BFT-safe weighted median of commit timestamps (state.go:290-307,
+    time.go weightedMedian)."""
+    if commit is None or validators is None:
+        return Timestamp.zero()
+    weighted: List[Tuple[Timestamp, int]] = []
+    total_power = 0
+    for cs in commit.signatures:
+        if cs.is_absent():
+            continue
+        _, val = validators.get_by_address(cs.validator_address)
+        if val is not None:
+            total_power += val.voting_power
+            weighted.append((cs.timestamp, val.voting_power))
+    weighted.sort(key=lambda wt: (wt[0].seconds, wt[0].nanos))
+    median = total_power // 2
+    for ts, weight in weighted:
+        if median <= weight:
+            return ts
+        median -= weight
+    return Timestamp.zero()
+
+
+def make_genesis_state(gen_doc: GenesisDoc) -> State:
+    """state.go:330-380 MakeGenesisState."""
+    gen_doc.validate_and_complete()
+    if gen_doc.validators:
+        vals = [Validator.new(v.pub_key, v.power) for v in gen_doc.validators]
+        validator_set = ValidatorSet.new(vals)
+        next_validator_set = validator_set.copy_increment_proposer_priority(1)
+    else:
+        validator_set = ValidatorSet()  # to be set by InitChain response
+        next_validator_set = ValidatorSet()
+    params = gen_doc.consensus_params or default_consensus_params()
+    return State(
+        version=Version(block=BLOCK_PROTOCOL, app=params.version.app_version),
+        chain_id=gen_doc.chain_id,
+        initial_height=gen_doc.initial_height,
+        last_block_height=0,
+        last_block_id=BlockID(),
+        last_block_time=gen_doc.genesis_time,
+        next_validators=next_validator_set,
+        validators=validator_set,
+        last_validators=ValidatorSet(),
+        last_height_validators_changed=gen_doc.initial_height,
+        consensus_params=params,
+        last_height_consensus_params_changed=gen_doc.initial_height,
+        app_hash=gen_doc.app_hash,
+    )

@@ -1,0 +1,220 @@
+"""BlockStore — persisted blocks, parts, commits keyed by height.
+
+Reference parity: internal/store/store.go. Key scheme is ordered-iteration
+friendly: a 1-byte tag + big-endian height so height ranges are key ranges
+(the reference uses orderedcode; big-endian int64 gives the same ordering
+for non-negative heights).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..db import DB, Batch
+from ..types import Block, BlockID, Commit, Header, SignedHeader
+from ..types.part_set import Part, PartSet
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+
+_TAG_META = b"\x00"
+_TAG_PART = b"\x01"
+_TAG_COMMIT = b"\x02"
+_TAG_SEEN_COMMIT = b"\x03"
+_TAG_BLOCK_HASH = b"\x04"
+
+INT64_MAX = (1 << 63) - 1
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">q", height)
+
+
+def block_meta_key(height: int) -> bytes:
+    return _TAG_META + _h(height)
+
+
+def block_part_key(height: int, index: int) -> bytes:
+    return _TAG_PART + _h(height) + struct.pack(">i", index)
+
+
+def block_commit_key(height: int) -> bytes:
+    return _TAG_COMMIT + _h(height)
+
+
+def seen_commit_key() -> bytes:
+    return _TAG_SEEN_COMMIT
+
+
+def block_hash_key(h: bytes) -> bytes:
+    return _TAG_BLOCK_HASH + h
+
+
+@dataclass
+class BlockMeta:
+    """types/block_meta.go: BlockID + sizes + header + num_txs."""
+
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_message(1, self.block_id.encode(), always=True)
+        w.write_varint(2, self.block_size)
+        w.write_message(3, self.header.encode(), always=True)
+        w.write_varint(4, self.num_txs)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        f = decode_message(data)
+        return cls(
+            block_id=BlockID.decode(field_bytes(f, 1)),
+            block_size=to_signed64(field_int(f, 2)),
+            header=Header.decode(field_bytes(f, 3)),
+            num_txs=to_signed64(field_int(f, 4)),
+        )
+
+
+class BlockStore:
+    """internal/store/store.go:30-530."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.RLock()
+
+    # -- range info -----------------------------------------------------
+
+    def base(self) -> int:
+        for k, _ in self._db.iterator(block_meta_key(1), block_meta_key(INT64_MAX)):
+            return struct.unpack(">q", k[1:9])[0]
+        return 0
+
+    def height(self) -> int:
+        for k, _ in self._db.reverse_iterator(
+            block_meta_key(1), block_meta_key(INT64_MAX)
+        ):
+            return struct.unpack(">q", k[1:9])[0]
+        return 0
+
+    def size(self) -> int:
+        h = self.height()
+        return 0 if h == 0 else h - self.base() + 1
+
+    def load_base_meta(self) -> Optional[BlockMeta]:
+        b = self.base()
+        return self.load_block_meta(b) if b else None
+
+    # -- loads ----------------------------------------------------------
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.part_set_header.total):
+            p = self.load_block_part(height, i)
+            if p is None:
+                return None
+            parts.append(p.bytes)
+        return Block.decode(b"".join(parts))
+
+    def load_block_by_hash(self, h: bytes) -> Optional[Block]:
+        raw = self._db.get(block_hash_key(h))
+        if raw is None:
+            return None
+        return self.load_block(int(raw.decode()))
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self._db.get(block_part_key(height, index))
+        return Part.decode(raw) if raw is not None else None
+
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self._db.get(block_meta_key(height))
+        return BlockMeta.decode(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        raw = self._db.get(block_commit_key(height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def load_seen_commit(self) -> Optional[Commit]:
+        raw = self._db.get(seen_commit_key())
+        return Commit.decode(raw) if raw is not None else None
+
+    # -- saves ----------------------------------------------------------
+
+    def save_block(self, block: Block, block_parts: PartSet, seen_commit: Commit) -> None:
+        """store.go:429-490: meta + parts + last_commit + seen commit."""
+        if block is None:
+            raise ValueError("cannot save nil block")
+        with self._mtx:
+            height = block.header.height
+            hash_ = block.hash()
+            if not block_parts.is_complete():
+                raise ValueError("cannot save block with incomplete parts")
+            w = self.height()
+            if w > 0 and height != w + 1:
+                raise ValueError(f"cannot save block at height {height}, expected {w + 1}")
+
+            batch = Batch(self._db)
+            block_id = BlockID(hash=hash_, part_set_header=block_parts.header())
+            meta = BlockMeta(
+                block_id=block_id,
+                block_size=len(block.encode()),
+                header=block.header,
+                num_txs=len(block.data.txs),
+            )
+            batch.set(block_meta_key(height), meta.encode())
+            batch.set(block_hash_key(hash_), str(height).encode())
+            for i in range(block_parts.total()):
+                part = block_parts.get_part(i)
+                batch.set(block_part_key(height, i), part.encode())
+            if block.last_commit is not None:
+                batch.set(block_commit_key(height - 1), block.last_commit.encode())
+            batch.set(seen_commit_key(), seen_commit.encode())
+            batch.write()
+
+    def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
+        self._db.set(seen_commit_key(), seen_commit.encode())
+
+    def save_signed_header(self, sh: SignedHeader, block_id: BlockID) -> None:
+        """store.go:513-530 (used by statesync bootstrap)."""
+        height = sh.header.height
+        if self.load_block_meta(height) is not None:
+            raise ValueError(f"a header at height {height} already exists")
+        meta = BlockMeta(block_id=block_id, block_size=0, header=sh.header, num_txs=0)
+        batch = Batch(self._db)
+        batch.set(block_meta_key(height), meta.encode())
+        batch.set(block_commit_key(height), sh.commit.encode())
+        batch.write()
+
+    # -- pruning --------------------------------------------------------
+
+    def prune_blocks(self, height: int) -> int:
+        """store.go:287-338: delete everything below `height`."""
+        if height <= 0:
+            raise ValueError("height must be greater than 0")
+        with self._mtx:
+            if height > self.height():
+                raise ValueError("cannot prune beyond the latest height")
+            if height < self.base():
+                return 0
+            pruned = 0
+            batch = Batch(self._db)
+            for k, raw in list(self._db.iterator(block_meta_key(0), block_meta_key(height))):
+                meta = BlockMeta.decode(raw)
+                batch.delete(block_hash_key(meta.block_id.hash))
+                batch.delete(k)
+                for i in range(meta.block_id.part_set_header.total):
+                    h = struct.unpack(">q", k[1:9])[0]
+                    batch.delete(block_part_key(h, i))
+                pruned += 1
+            for k, _ in list(
+                self._db.iterator(block_commit_key(0), block_commit_key(height))
+            ):
+                batch.delete(k)
+            batch.write()
+            return pruned

@@ -1,0 +1,211 @@
+"""GenesisDoc — the chain's origin document (genesis.json).
+
+Reference parity: types/genesis.go. JSON layout matches the reference's
+libs/json type-tagged encoding: pub keys serialize as
+{"type": "tendermint/PubKeyEd25519", "value": "<base64>"}.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..crypto import PubKey
+from ..crypto import ed25519 as _ed25519
+from ..crypto import secp256k1 as _secp256k1
+from ..crypto import sr25519 as _sr25519
+from ..wire.canonical import Timestamp
+from .params import ConsensusParams, default_consensus_params
+from .validator_set import Validator
+
+MAX_CHAIN_ID_LEN = 50  # types/genesis.go:23
+
+
+_KEY_NAME_TO_CLS = {
+    _ed25519.PUB_KEY_NAME: (_ed25519.PubKey, _ed25519.KEY_TYPE),
+    _secp256k1.PUB_KEY_NAME: (_secp256k1.PubKey, _secp256k1.KEY_TYPE),
+    _sr25519.PUB_KEY_NAME: (_sr25519.PubKey, _sr25519.KEY_TYPE),
+}
+_KEY_TYPE_TO_NAME = {
+    _ed25519.KEY_TYPE: _ed25519.PUB_KEY_NAME,
+    _secp256k1.KEY_TYPE: _secp256k1.PUB_KEY_NAME,
+    _sr25519.KEY_TYPE: _sr25519.PUB_KEY_NAME,
+}
+
+
+def pubkey_to_json(pk: PubKey) -> dict:
+    return {
+        "type": _KEY_TYPE_TO_NAME[pk.type()],
+        "value": base64.b64encode(pk.bytes()).decode(),
+    }
+
+
+def pubkey_from_json(obj: dict) -> PubKey:
+    cls, _ = _KEY_NAME_TO_CLS[obj["type"]]
+    return cls(base64.b64decode(obj["value"]))
+
+
+@dataclass
+class GenesisValidator:
+    """types/genesis.go:36-42."""
+
+    address: bytes
+    pub_key: PubKey
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    """types/genesis.go:44-55."""
+
+    chain_id: str
+    genesis_time: Timestamp = field(default_factory=lambda: Timestamp(0, 0))
+    initial_height: int = 1
+    consensus_params: Optional[ConsensusParams] = None
+    validators: List[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: Any = None
+
+    def validate_and_complete(self) -> None:
+        """types/genesis.go:89-136."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id in genesis doc is too long (max: {MAX_CHAIN_ID_LEN})")
+        if self.initial_height < 0:
+            raise ValueError(f"initial_height cannot be negative (got {self.initial_height})")
+        if self.initial_height == 0:
+            self.initial_height = 1
+        if self.consensus_params is None:
+            self.consensus_params = default_consensus_params()
+        else:
+            self.consensus_params.validate_consensus_params()
+        for i, v in enumerate(self.validators):
+            if v.power == 0:
+                raise ValueError(f"genesis file cannot contain validators with no voting power: {v}")
+            if v.address and v.pub_key.address() != v.address:
+                raise ValueError(f"incorrect address for validator {i} in the genesis file")
+            if not v.address:
+                v.address = v.pub_key.address()
+
+    def validator_hash(self) -> bytes:
+        from .validator_set import ValidatorSet
+
+        vals = [Validator.new(v.pub_key, v.power) for v in self.validators]
+        return ValidatorSet.new(vals).hash()
+
+    # -- JSON -----------------------------------------------------------
+
+    def to_json(self) -> str:
+        obj = {
+            "genesis_time": _time_to_rfc3339(self.genesis_time),
+            "chain_id": self.chain_id,
+            "initial_height": str(self.initial_height),
+            "consensus_params": _params_to_json(self.consensus_params),
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": pubkey_to_json(v.pub_key),
+                    "power": str(v.power),
+                    "name": v.name,
+                }
+                for v in self.validators
+            ],
+            "app_hash": self.app_hash.hex().upper(),
+        }
+        if self.app_state is not None:
+            obj["app_state"] = self.app_state
+        return json.dumps(obj, indent=2)
+
+    @classmethod
+    def from_json(cls, data: str) -> "GenesisDoc":
+        obj = json.loads(data)
+        doc = cls(
+            chain_id=obj["chain_id"],
+            genesis_time=_time_from_rfc3339(obj.get("genesis_time", "1970-01-01T00:00:00Z")),
+            initial_height=int(obj.get("initial_height", "1") or 1),
+            consensus_params=_params_from_json(obj.get("consensus_params")),
+            validators=[
+                GenesisValidator(
+                    address=bytes.fromhex(v.get("address", "")),
+                    pub_key=pubkey_from_json(v["pub_key"]),
+                    power=int(v["power"]),
+                    name=v.get("name", ""),
+                )
+                for v in obj.get("validators") or []
+            ],
+            app_hash=bytes.fromhex(obj.get("app_hash", "")),
+            app_state=obj.get("app_state"),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.to_json())
+
+    @classmethod
+    def from_file(cls, path: str) -> "GenesisDoc":
+        with open(path) as fh:
+            return cls.from_json(fh.read())
+
+
+def _time_to_rfc3339(ts: Timestamp) -> str:
+    import datetime
+
+    base = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    dt = base + datetime.timedelta(seconds=ts.seconds)
+    s = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if ts.nanos:
+        s += f".{ts.nanos:09d}".rstrip("0")
+    return s + "Z"
+
+
+def _time_from_rfc3339(s: str) -> Timestamp:
+    import datetime
+
+    s = s.rstrip("Z")
+    nanos = 0
+    if "." in s:
+        s, frac = s.split(".")
+        nanos = int(frac.ljust(9, "0")[:9])
+    dt = datetime.datetime.fromisoformat(s).replace(tzinfo=datetime.timezone.utc)
+    return Timestamp(seconds=int(dt.timestamp()), nanos=nanos)
+
+
+def _params_to_json(p: Optional[ConsensusParams]) -> Optional[dict]:
+    if p is None:
+        return None
+    return {
+        "block": {"max_bytes": str(p.block.max_bytes), "max_gas": str(p.block.max_gas)},
+        "evidence": {
+            "max_age_num_blocks": str(p.evidence.max_age_num_blocks),
+            "max_age_duration": str(p.evidence.max_age_duration_ns),
+            "max_bytes": str(p.evidence.max_bytes),
+        },
+        "validator": {"pub_key_types": list(p.validator.pub_key_types)},
+        "version": {"app_version": str(p.version.app_version)},
+    }
+
+
+def _params_from_json(obj: Optional[dict]) -> Optional[ConsensusParams]:
+    if obj is None:
+        return None
+    from .params import BlockParams, EvidenceParams, ValidatorParams, VersionParams
+
+    return ConsensusParams(
+        block=BlockParams(
+            max_bytes=int(obj["block"]["max_bytes"]),
+            max_gas=int(obj["block"]["max_gas"]),
+        ),
+        evidence=EvidenceParams(
+            max_age_num_blocks=int(obj["evidence"]["max_age_num_blocks"]),
+            max_age_duration_ns=int(obj["evidence"]["max_age_duration"]),
+            max_bytes=int(obj["evidence"].get("max_bytes", "1048576")),
+        ),
+        validator=ValidatorParams(pub_key_types=tuple(obj["validator"]["pub_key_types"])),
+        version=VersionParams(app_version=int(obj.get("version", {}).get("app_version", "0"))),
+    )

@@ -1,0 +1,206 @@
+"""ConsensusParams — on-chain consensus parameters.
+
+Reference parity: types/params.go + proto/tendermint/types/params.pb.go.
+HashConsensusParams hashes only HashedParams{BlockMaxBytes, BlockMaxGas}
+(params.go HashConsensusParams) — kept bit-exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from ..crypto import tmhash
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB (types/params.go:21)
+
+ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
+ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
+ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
+
+HOUR_NS = 3600 * 10**9
+
+
+@dataclass(frozen=True)
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB
+    max_gas: int = -1
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.max_bytes)
+        w.write_varint(2, self.max_gas)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockParams":
+        f = decode_message(data)
+        return cls(
+            max_bytes=to_signed64(field_int(f, 1)),
+            max_gas=to_signed64(field_int(f, 2)),
+        )
+
+
+@dataclass(frozen=True)
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * HOUR_NS  # stdduration on the wire
+    max_bytes: int = 1048576  # 1MB
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.max_age_num_blocks)
+        dur = ProtoWriter()
+        dur.write_varint(1, self.max_age_duration_ns // 10**9)
+        dur.write_varint(2, self.max_age_duration_ns % 10**9)
+        w.write_message(2, dur.bytes(), always=True)
+        w.write_varint(3, self.max_bytes)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EvidenceParams":
+        f = decode_message(data)
+        d = decode_message(field_bytes(f, 2))
+        ns = to_signed64(field_int(d, 1)) * 10**9 + to_signed64(field_int(d, 2))
+        return cls(
+            max_age_num_blocks=to_signed64(field_int(f, 1)),
+            max_age_duration_ns=ns,
+            max_bytes=to_signed64(field_int(f, 3)),
+        )
+
+
+@dataclass(frozen=True)
+class ValidatorParams:
+    pub_key_types: tuple = (ABCI_PUBKEY_TYPE_ED25519,)
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        for t in self.pub_key_types:
+            w.write_string(1, t, always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValidatorParams":
+        f = decode_message(data)
+        return cls(pub_key_types=tuple(raw.decode() for _, raw in f.get(1, [])))
+
+    def is_valid_pubkey_type(self, t: str) -> bool:
+        return t in self.pub_key_types
+
+
+@dataclass(frozen=True)
+class VersionParams:
+    app_version: int = 0
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.app_version)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VersionParams":
+        f = decode_message(data)
+        return cls(app_version=field_int(f, 1))
+
+
+@dataclass(frozen=True)
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    version: VersionParams = field(default_factory=VersionParams)
+
+    def hash_consensus_params(self) -> bytes:
+        """params.go HashConsensusParams: SHA256 of proto HashedParams
+        {1 block_max_bytes, 2 block_max_gas}."""
+        w = ProtoWriter()
+        w.write_varint(1, self.block.max_bytes)
+        w.write_varint(2, self.block.max_gas)
+        return tmhash.sum_sha256(w.bytes())
+
+    def validate_consensus_params(self) -> None:
+        """params.go:129-170."""
+        if self.block.max_bytes <= 0:
+            raise ValueError(f"block.MaxBytes must be greater than 0. Got {self.block.max_bytes}")
+        if self.block.max_bytes > MAX_BLOCK_SIZE_BYTES:
+            raise ValueError(
+                f"block.MaxBytes is too big. {self.block.max_bytes} > {MAX_BLOCK_SIZE_BYTES}"
+            )
+        if self.block.max_gas < -1:
+            raise ValueError(f"block.MaxGas must be greater or equal to -1. Got {self.block.max_gas}")
+        if self.evidence.max_age_num_blocks <= 0:
+            raise ValueError("evidence.MaxAgeNumBlocks must be greater than 0")
+        if self.evidence.max_age_duration_ns <= 0:
+            raise ValueError("evidence.MaxAgeDuration must be greater than 0")
+        if self.evidence.max_bytes > self.block.max_bytes:
+            raise ValueError("evidence.MaxBytes is greater than block.MaxBytes")
+        if self.evidence.max_bytes < 0:
+            raise ValueError("evidence.MaxBytes must be non negative")
+        if not self.validator.pub_key_types:
+            raise ValueError("len(validator.PubKeyTypes) must be greater than 0")
+        for t in self.validator.pub_key_types:
+            if t not in (
+                ABCI_PUBKEY_TYPE_ED25519,
+                ABCI_PUBKEY_TYPE_SECP256K1,
+                ABCI_PUBKEY_TYPE_SR25519,
+            ):
+                raise ValueError(f"unknown pubkey type {t}")
+
+    def update_consensus_params(self, updates: Optional["ConsensusParams"]) -> "ConsensusParams":
+        """params.go UpdateConsensusParams: nil sub-messages keep current."""
+        if updates is None:
+            return self
+        return updates
+
+    def update_from_proto_subset(
+        self,
+        block: Optional[BlockParams],
+        evidence: Optional[EvidenceParams],
+        validator: Optional[ValidatorParams],
+        version: Optional[VersionParams],
+    ) -> "ConsensusParams":
+        res = self
+        if block is not None:
+            res = replace(res, block=block)
+        if evidence is not None:
+            res = replace(res, evidence=evidence)
+        if validator is not None:
+            res = replace(res, validator=validator)
+        if version is not None:
+            res = replace(res, version=version)
+        return res
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_message(1, self.block.encode(), always=True)
+        w.write_message(2, self.evidence.encode(), always=True)
+        w.write_message(3, self.validator.encode(), always=True)
+        w.write_message(4, self.version.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ConsensusParams":
+        f = decode_message(data)
+        return cls(
+            block=BlockParams.decode(field_bytes(f, 1)),
+            evidence=EvidenceParams.decode(field_bytes(f, 2)),
+            validator=ValidatorParams.decode(field_bytes(f, 3)),
+            version=VersionParams.decode(field_bytes(f, 4)),
+        )
+
+    @classmethod
+    def decode_update_subset(cls, data: bytes):
+        """Decode an ABCI ConsensusParams update where absent sub-messages
+        mean 'no change' — returns the 4-tuple of Optionals."""
+        f = decode_message(data)
+        return (
+            BlockParams.decode(field_bytes(f, 1)) if 1 in f else None,
+            EvidenceParams.decode(field_bytes(f, 2)) if 2 in f else None,
+            ValidatorParams.decode(field_bytes(f, 3)) if 3 in f else None,
+            VersionParams.decode(field_bytes(f, 4)) if 4 in f else None,
+        )
+
+
+def default_consensus_params() -> ConsensusParams:
+    return ConsensusParams()

@@ -1,0 +1,29 @@
+"""ABCI deliver-tx results hashing (the header's last_results_hash).
+
+Reference parity: types/results.go — ABCIResults.Hash() is the merkle root
+over *deterministic* proto encodings of each ResponseDeliverTx, where
+deterministic means only {Code, Data} are kept
+(types/results.go:41-48 deterministicResponseDeliverTx).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..crypto import merkle
+from ..wire.proto import ProtoWriter
+
+
+def deterministic_response_deliver_tx(code: int, data: bytes) -> bytes:
+    """ResponseDeliverTx{1 code, 2 data} subset encoding."""
+    w = ProtoWriter()
+    w.write_varint(1, code)
+    w.write_bytes(2, data)
+    return w.bytes()
+
+
+def results_hash(results: Sequence[tuple]) -> bytes:
+    """results: iterable of (code, data) pairs from DeliverTx responses."""
+    return merkle.hash_from_byte_slices(
+        [deterministic_response_deliver_tx(c, d) for c, d in results]
+    )

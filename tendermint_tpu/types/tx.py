@@ -1,0 +1,57 @@
+"""Tx helpers — hashing, merkle proofs over block data.
+
+Reference parity: types/tx.go (Tx.Hash = SHA256, Txs.Hash = merkle root of
+raw txs, TxProof)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+from ..crypto import merkle, tmhash
+
+
+def tx_hash(tx: bytes) -> bytes:
+    """types/tx.go:31-33."""
+    return tmhash.sum_sha256(tx)
+
+
+def txs_hash(txs: Sequence[bytes]) -> bytes:
+    return merkle.hash_from_byte_slices(list(txs))
+
+
+def tx_key(tx: bytes) -> bytes:
+    """Mempool cache key (types/tx.go TxKey): the full SHA256."""
+    return tx_hash(tx)
+
+
+@dataclass(frozen=True)
+class TxProof:
+    """types/tx.go:59-89: inclusion proof of a tx in a block's data hash."""
+
+    root_hash: bytes
+    data: bytes
+    proof: merkle.Proof
+
+    def validate(self, data_hash: bytes) -> None:
+        if data_hash != self.root_hash:
+            raise ValueError("proof matches different data hash")
+        self.leaf_check()
+
+    def leaf_check(self) -> None:
+        self.proof.verify(self.root_hash, self.data)
+
+
+def tx_proof(txs: Sequence[bytes], index: int) -> TxProof:
+    root, proofs = merkle.proofs_from_byte_slices(list(txs))
+    return TxProof(root_hash=root, data=bytes(txs[index]), proof=proofs[index])
+
+
+def compute_proto_size_overhead(n_txs: int, total_tx_bytes: int) -> int:
+    """Approximation of types.ComputeProtoSizeForTxs for block-size checks:
+    field tag + varint length per tx."""
+    overhead = 0
+    # each tx: tag(1) + uvarint(len)
+    # conservative: 1 + 5 bytes per tx
+    overhead += n_txs * 6
+    return total_tx_bytes + overhead
