@@ -1,0 +1,40 @@
+"""Crash-point injection for crash-consistency testing.
+
+Reference parity: internal/libs/fail/fail.go:28 — the FAIL_TEST_INDEX env
+var names a numbered crash point; when execution reaches it the process
+dies, so tests can assert WAL/handshake recovery from every interleaving
+(used inside BlockExecutor.apply_block like execution.go:171-218).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ENV = "FAIL_TEST_INDEX"
+
+_counter = 0
+
+
+def _target() -> int:
+    v = os.environ.get(_ENV)
+    return int(v) if v else -1
+
+
+def fail_point(_ignored_index: int = 0) -> None:
+    """Die if the global call counter has reached FAIL_TEST_INDEX.
+    Counting is call-order based like the reference (fail.go:19-34)."""
+    global _counter
+    t = _target()
+    if t < 0:
+        return
+    if _counter == t:
+        sys.stderr.write(f"*** fail-test {t} ***\n")
+        sys.stderr.flush()
+        os._exit(1)
+    _counter += 1
+
+
+def reset() -> None:
+    global _counter
+    _counter = 0

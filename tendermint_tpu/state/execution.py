@@ -1,0 +1,402 @@
+"""BlockExecutor — validates blocks, drives the ABCI app, updates State.
+
+Reference parity: internal/state/execution.go (ApplyBlock:152,
+Commit:246, CreateProposalBlock:103, execBlockOnProxyApp:294,
+updateState:445) and internal/state/validation.go (validateBlock).
+
+LastCommit verification inside validateBlock routes through
+types.validation.verify_commit — i.e. through the device batch engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Callable, List, Optional, Tuple
+
+from ..abci import types as abci
+from ..crypto.encoding import pubkey_from_proto
+from ..libs.fail import fail_point
+from ..types import Block, BlockID, Commit, Validator, ValidatorSet
+from ..types.params import ConsensusParams
+from ..types.results import results_hash
+from ..types.validation import verify_commit
+from ..wire.proto import decode_message, field_bytes, field_int, to_signed64
+from . import State, median_time
+from .store import ABCIResponses, StateStore
+
+
+class InvalidBlockError(ValueError):
+    pass
+
+
+class BlockExecutor:
+    """execution.go:53-101."""
+
+    def __init__(
+        self,
+        state_store: StateStore,
+        proxy_app,  # consensus-connection ABCI client
+        mempool=None,
+        evpool=None,
+        block_store=None,
+        event_bus=None,
+    ):
+        self._store = state_store
+        self._proxy_app = proxy_app
+        self._mempool = mempool
+        self._evpool = evpool
+        self._block_store = block_store
+        self._event_bus = event_bus
+        self._validated_cache: set = set()
+
+    @property
+    def store(self) -> StateStore:
+        return self._store
+
+    # -- proposal creation (execution.go:103-150) ------------------------
+
+    def create_proposal_block(
+        self, height: int, state: State, commit: Optional[Commit], proposer_addr: bytes
+    ):
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = []
+        if self._evpool is not None:
+            evidence = self._evpool.pending_evidence_bytes(
+                state.consensus_params.evidence.max_bytes
+            )
+        txs: List[bytes] = []
+        if self._mempool is not None:
+            # data cap: MaxDataBytes(maxBytes, evidence size, #validators)
+            txs = self._mempool.reap_max_bytes_max_gas(max_bytes, max_gas)
+        return state.make_block(height, txs, commit, evidence, proposer_addr)
+
+    # -- validation ------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        key = bytes(block.hash())
+        if key in self._validated_cache:
+            return
+        validate_block(state, block)
+        if self._evpool is not None:
+            self._evpool.check_evidence(state, block.evidence)
+        self._validated_cache.add(key)
+
+    # -- the main entry (execution.go:152-240) ---------------------------
+
+    def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+        try:
+            self.validate_block(state, block)
+        except ValueError as e:
+            raise InvalidBlockError(str(e)) from e
+
+        abci_responses = exec_block_on_proxy_app(
+            self._proxy_app, block, self._store, state.initial_height
+        )
+        fail_point(1)
+        self._store.save_abci_responses(block.header.height, abci_responses)
+        fail_point(2)
+
+        end_block = abci.dec_response_payload("end_block", abci_responses.end_block)
+        validate_validator_updates(end_block.validator_updates, state.consensus_params)
+        validator_updates = [
+            Validator.new(pubkey_from_proto(v.pub_key), v.power)
+            for v in end_block.validator_updates
+        ]
+
+        state = update_state(state, block_id, block, abci_responses, validator_updates)
+
+        app_hash, retain_height = self.commit(state, block, abci_responses)
+
+        if self._evpool is not None:
+            self._evpool.update(state, block.evidence)
+        fail_point(3)
+
+        state = replace_app_hash(state, app_hash)
+        self._store.save(state)
+        fail_point(4)
+
+        if retain_height > 0 and self._block_store is not None:
+            try:
+                self._block_store.prune_blocks(retain_height)
+                self._store.prune_states(retain_height)
+            except ValueError:
+                pass
+
+        self._validated_cache = set()
+        if self._event_bus is not None:
+            fire_events(self._event_bus, block, block_id, abci_responses, validator_updates)
+        return state
+
+    # -- commit (execution.go:246-292) ------------------------------------
+
+    def commit(self, state: State, block: Block, abci_responses: ABCIResponses):
+        if self._mempool is not None:
+            self._mempool.lock()
+        try:
+            if self._mempool is not None:
+                self._mempool.flush_app_conn()
+            res = self._proxy_app.commit()
+            if self._mempool is not None:
+                deliver_txs = [
+                    abci.dec_response_payload("deliver_tx", raw)
+                    for raw in abci_responses.deliver_txs
+                ]
+                self._mempool.update(
+                    block.header.height,
+                    block.data.txs,
+                    deliver_txs,
+                    tx_pre_check(state),
+                    tx_post_check(state),
+                )
+            return res.data, res.retain_height
+        finally:
+            if self._mempool is not None:
+                self._mempool.unlock()
+
+
+def exec_block_on_proxy_app(
+    proxy_app, block: Block, store: StateStore, initial_height: int
+) -> ABCIResponses:
+    """execution.go:294-376: BeginBlock → DeliverTx×N (pipelined when the
+    client supports it) → EndBlock."""
+    commit_info = get_begin_block_validator_info(block, store, initial_height)
+    byz_vals: List[abci.ABCIEvidence] = []
+    from ..types.evidence import evidence_to_abci
+
+    for ev_raw in block.evidence:
+        byz_vals.extend(evidence_to_abci(ev_raw))
+
+    begin = proxy_app.begin_block(
+        abci.RequestBeginBlock(
+            hash=block.hash(),
+            header=block.header.encode(),
+            last_commit_info=commit_info,
+            byzantine_validators=byz_vals,
+        )
+    )
+    futs = []
+    if hasattr(proxy_app, "deliver_tx_async"):
+        for tx in block.data.txs:
+            futs.append(proxy_app.deliver_tx_async(abci.RequestDeliverTx(tx=tx)))
+        if hasattr(proxy_app, "flush"):
+            proxy_app.flush()
+        deliver_responses = [f.result(timeout=60) for f in futs]
+    else:
+        deliver_responses = [
+            proxy_app.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in block.data.txs
+        ]
+    end = proxy_app.end_block(abci.RequestEndBlock(height=block.header.height))
+    return ABCIResponses(
+        deliver_txs=[abci.enc_response_payload("deliver_tx", r) for r in deliver_responses],
+        end_block=abci.enc_response_payload("end_block", end),
+        begin_block=abci.enc_response_payload("begin_block", begin),
+    )
+
+
+def get_begin_block_validator_info(
+    block: Block, store: StateStore, initial_height: int
+) -> abci.LastCommitInfo:
+    """execution.go:378-420."""
+    last_commit = block.last_commit
+    if last_commit is None:
+        return abci.LastCommitInfo()
+    vote_infos: List[abci.VoteInfo] = []
+    if block.header.height > initial_height:
+        last_val_set = store.load_validators(block.header.height - 1)
+        commit_size = last_commit.size()
+        if commit_size != last_val_set.size():
+            raise RuntimeError(
+                f"commit size ({commit_size}) doesn't match valset length "
+                f"({last_val_set.size()}) at height {block.header.height}"
+            )
+        for i, val in enumerate(last_val_set.validators):
+            cs = last_commit.signatures[i]
+            vote_infos.append(
+                abci.VoteInfo(
+                    validator=abci.ABCIValidator(address=val.address, power=val.voting_power),
+                    signed_last_block=not cs.is_absent(),
+                )
+            )
+    return abci.LastCommitInfo(round=last_commit.round, votes=vote_infos)
+
+
+def validate_validator_updates(
+    updates: List[abci.ValidatorUpdate], params: ConsensusParams
+) -> None:
+    """execution.go:422-443."""
+    for u in updates:
+        if u.power < 0:
+            raise ValueError(f"voting power can't be negative: {u}")
+        if u.power == 0:
+            continue
+        pk = pubkey_from_proto(u.pub_key)
+        if not params.validator.is_valid_pubkey_type(pk.type()):
+            raise ValueError(
+                f"validator {pk.address().hex()} is using pubkey {pk.type()}, "
+                "which is unsupported for consensus"
+            )
+
+
+def update_state(
+    state: State,
+    block_id: BlockID,
+    block: Block,
+    abci_responses: ABCIResponses,
+    validator_updates: List[Validator],
+) -> State:
+    """execution.go:445-520."""
+    header = block.header
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if validator_updates:
+        n_val_set.update_with_change_set(validator_updates)
+        last_height_vals_changed = header.height + 1 + 1
+    n_val_set.increment_proposer_priority(1)
+
+    next_params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    version = state.version
+    end_block = abci.dec_response_payload("end_block", abci_responses.end_block)
+    if end_block.consensus_param_updates is not None:
+        subset = ConsensusParams.decode_update_subset(end_block.consensus_param_updates)
+        next_params = state.consensus_params.update_from_proto_subset(*subset)
+        next_params.validate_consensus_params()
+        version = replace(version, app=next_params.version.app_version)
+        last_height_params_changed = header.height + 1
+
+    deliver_results = [
+        _deliver_tx_code_data(raw) for raw in abci_responses.deliver_txs
+    ]
+    return State(
+        version=version,
+        chain_id=state.chain_id,
+        initial_height=state.initial_height,
+        last_block_height=header.height,
+        last_block_id=block_id,
+        last_block_time=header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=next_params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=results_hash(deliver_results),
+        app_hash=b"",  # set after Commit
+    )
+
+
+def _deliver_tx_code_data(raw: bytes) -> Tuple[int, bytes]:
+    f = decode_message(raw)
+    return field_int(f, 1), field_bytes(f, 2)
+
+
+def replace_app_hash(state: State, app_hash: bytes) -> State:
+    s = state.copy()
+    s.app_hash = app_hash
+    return s
+
+
+def validate_block(state: State, block: Block) -> None:
+    """internal/state/validation.go:14-120."""
+    block.validate_basic()
+    h = block.header
+    if h.version.app != state.version.app or h.version.block != state.version.block:
+        raise ValueError(
+            f"wrong Block.Header.Version. Expected {state.version}, got {h.version}"
+        )
+    if h.chain_id != state.chain_id:
+        raise ValueError(
+            f"wrong Block.Header.ChainID. Expected {state.chain_id}, got {h.chain_id}"
+        )
+    if state.last_block_height == 0 and h.height != state.initial_height:
+        raise ValueError(
+            f"wrong Block.Header.Height. Expected {state.initial_height} for initial block, got {h.height}"
+        )
+    if state.last_block_height > 0 and h.height != state.last_block_height + 1:
+        raise ValueError(
+            f"wrong Block.Header.Height. Expected {state.last_block_height + 1}, got {h.height}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise ValueError(
+            f"wrong Block.Header.LastBlockID. Expected {state.last_block_id}, got {h.last_block_id}"
+        )
+    if h.app_hash != state.app_hash:
+        raise ValueError(
+            f"wrong Block.Header.AppHash. Expected {state.app_hash.hex()}, got {h.app_hash.hex()}"
+        )
+    hash_cp = state.consensus_params.hash_consensus_params()
+    if h.consensus_hash != hash_cp:
+        raise ValueError("wrong Block.Header.ConsensusHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValueError("wrong Block.Header.LastResultsHash")
+    if h.validators_hash != state.validators.hash():
+        raise ValueError("wrong Block.Header.ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValueError("wrong Block.Header.NextValidatorsHash")
+
+    if h.height == state.initial_height:
+        if block.last_commit is not None and block.last_commit.signatures:
+            raise ValueError("initial block can't have LastCommit signatures")
+    else:
+        # THE batch hot path: LastCommit verified on the device engine.
+        verify_commit(
+            state.chain_id, state.last_validators, state.last_block_id,
+            h.height - 1, block.last_commit,
+        )
+
+    if not state.validators.has_address(h.proposer_address):
+        raise ValueError(
+            f"block.Header.ProposerAddress {h.proposer_address.hex()} is not a validator"
+        )
+
+    if h.height > state.initial_height:
+        if (h.time.seconds, h.time.nanos) <= (
+            state.last_block_time.seconds,
+            state.last_block_time.nanos,
+        ):
+            raise ValueError(
+                f"block time {h.time} not greater than last block time {state.last_block_time}"
+            )
+        med = median_time(block.last_commit, state.last_validators)
+        if h.time != med:
+            raise ValueError(f"invalid block time. Expected {med}, got {h.time}")
+    elif h.height == state.initial_height:
+        if h.time != state.last_block_time:
+            raise ValueError(
+                f"block time {h.time} is not equal to genesis time {state.last_block_time}"
+            )
+
+
+def fire_events(event_bus, block, block_id, abci_responses, validator_updates) -> None:
+    """execution.go:575-613 fireEvents."""
+    event_bus.publish_new_block(block, block_id, abci_responses)
+    event_bus.publish_new_block_header(block.header)
+    for i, tx in enumerate(block.data.txs):
+        event_bus.publish_tx(block.header.height, i, tx, abci_responses.deliver_txs[i])
+    if validator_updates:
+        event_bus.publish_validator_set_updates(validator_updates)
+
+
+def tx_pre_check(state: State) -> Callable:
+    """tx_filter.go PreCheckMaxBytes: tx must fit the block."""
+    from ..types.block import MAX_HEADER_BYTES
+
+    max_data_bytes = state.consensus_params.block.max_bytes - MAX_HEADER_BYTES - 1000
+
+    def check(tx: bytes) -> None:
+        if len(tx) > max_data_bytes:
+            raise ValueError(f"tx size {len(tx)} exceeds max {max_data_bytes}")
+
+    return check
+
+
+def tx_post_check(state: State) -> Callable:
+    """tx_filter.go PostCheckMaxGas."""
+    max_gas = state.consensus_params.block.max_gas
+
+    def check(tx: bytes, res) -> None:
+        if max_gas > -1 and res.gas_wanted > max_gas:
+            raise ValueError(f"gas wanted {res.gas_wanted} exceeds max {max_gas}")
+
+    return check

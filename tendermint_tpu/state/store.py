@@ -1,0 +1,225 @@
+"""State store — persists State, validator sets, params, ABCI responses.
+
+Reference parity: internal/state/store.go. Validator sets are stored at
+every height where they changed (with last_height_changed markers so
+lookups walk back to the last checkpoint), consensus params likewise;
+ABCI responses per height feed the /block_results RPC and last_results
+hash.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..db import DB, Batch
+from ..types import BlockID, Timestamp, ValidatorSet, Version
+from ..types.params import ConsensusParams
+from ..wire import canonical as _canon
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+from . import State
+
+_KEY_STATE = b"stateKey"
+
+
+def _validators_key(height: int) -> bytes:
+    return b"validatorsKey:" + struct.pack(">q", height)
+
+
+def _params_key(height: int) -> bytes:
+    return b"consensusParamsKey:" + struct.pack(">q", height)
+
+
+def _abci_responses_key(height: int) -> bytes:
+    return b"abciResponsesKey:" + struct.pack(">q", height)
+
+
+@dataclass
+class ABCIResponses:
+    """proto/tendermint/state ABCIResponses: deliver_txs + end_block +
+    begin_block, stored as the already-encoded response payloads."""
+
+    deliver_txs: List[bytes] = field(default_factory=list)
+    end_block: bytes = b""
+    begin_block: bytes = b""
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        for tx in self.deliver_txs:
+            w.write_message(1, tx, always=True)
+        w.write_message(2, self.end_block, always=True)
+        w.write_message(3, self.begin_block, always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ABCIResponses":
+        f = decode_message(data)
+        return cls(
+            deliver_txs=[raw for _, raw in f.get(1, [])],
+            end_block=field_bytes(f, 2),
+            begin_block=field_bytes(f, 3),
+        )
+
+
+class StateStore:
+    """internal/state/store.go:95-660."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    # -- State ----------------------------------------------------------
+
+    def save(self, state: State) -> None:
+        """Save state + its validator/params checkpoints (store.go:102-147)."""
+        next_height = state.last_block_height + 1
+        if state.last_block_height == 0:
+            next_height = state.initial_height
+            # genesis bootstrap: store validators for initial and next height
+            self._save_validators(next_height, state.validators,
+                                  state.last_height_validators_changed)
+        self._save_validators(next_height + 1, state.next_validators,
+                              state.last_height_validators_changed)
+        self._save_params(next_height, state.consensus_params,
+                          state.last_height_consensus_params_changed)
+        self._db.set(_KEY_STATE, _encode_state(state))
+
+    def load(self) -> Optional[State]:
+        raw = self._db.get(_KEY_STATE)
+        if raw is None:
+            return None
+        return _decode_state(raw)
+
+    def bootstrap(self, state: State) -> None:
+        """store.go Bootstrap — used by statesync to plant a trusted state."""
+        height = state.last_block_height + 1
+        if height == state.initial_height and state.last_validators is not None \
+                and not state.last_validators.is_nil_or_empty():
+            self._save_validators(height - 1, state.last_validators, height - 1)
+        if height > state.initial_height and state.last_validators is not None \
+                and not state.last_validators.is_nil_or_empty():
+            self._save_validators(height - 1, state.last_validators, height - 1)
+        self._save_validators(height, state.validators, height)
+        self._save_validators(height + 1, state.next_validators, height + 1)
+        self._save_params(height, state.consensus_params,
+                          state.last_height_consensus_params_changed)
+        self._db.set(_KEY_STATE, _encode_state(state))
+
+    # -- validators -----------------------------------------------------
+
+    def _save_validators(self, height: int, vals: ValidatorSet, last_changed: int) -> None:
+        w = ProtoWriter()
+        w.write_varint(1, last_changed)
+        if height == last_changed:
+            w.write_message(2, vals.encode(), always=True)
+        self._db.set(_validators_key(height), w.bytes())
+
+    def load_validators(self, height: int) -> ValidatorSet:
+        """store.go LoadValidators: walk back to the checkpoint then
+        increment priorities forward (store.go:244-294)."""
+        raw = self._db.get(_validators_key(height))
+        if raw is None:
+            raise KeyError(f"no validator set at height {height}")
+        f = decode_message(raw)
+        last_changed = to_signed64(field_int(f, 1))
+        if 2 in f:
+            return ValidatorSet.decode(field_bytes(f, 2))
+        raw2 = self._db.get(_validators_key(last_changed))
+        if raw2 is None:
+            raise KeyError(
+                f"validator checkpoint at height {last_changed} missing for height {height}"
+            )
+        f2 = decode_message(raw2)
+        vals = ValidatorSet.decode(field_bytes(f2, 2))
+        vals.increment_proposer_priority(height - last_changed)
+        return vals
+
+    # -- params ---------------------------------------------------------
+
+    def _save_params(self, height: int, params: ConsensusParams, last_changed: int) -> None:
+        w = ProtoWriter()
+        w.write_varint(1, last_changed)
+        if height == last_changed:
+            w.write_message(2, params.encode(), always=True)
+        self._db.set(_params_key(height), w.bytes())
+
+    def load_consensus_params(self, height: int) -> ConsensusParams:
+        raw = self._db.get(_params_key(height))
+        if raw is None:
+            raise KeyError(f"no consensus params at height {height}")
+        f = decode_message(raw)
+        last_changed = to_signed64(field_int(f, 1))
+        if 2 in f:
+            return ConsensusParams.decode(field_bytes(f, 2))
+        raw2 = self._db.get(_params_key(last_changed))
+        if raw2 is None:
+            raise KeyError(f"params checkpoint at {last_changed} missing")
+        f2 = decode_message(raw2)
+        return ConsensusParams.decode(field_bytes(f2, 2))
+
+    # -- ABCI responses --------------------------------------------------
+
+    def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
+        self._db.set(_abci_responses_key(height), responses.encode())
+
+    def load_abci_responses(self, height: int) -> Optional[ABCIResponses]:
+        raw = self._db.get(_abci_responses_key(height))
+        return ABCIResponses.decode(raw) if raw is not None else None
+
+    # -- pruning (store.go PruneStates) ----------------------------------
+
+    def prune_states(self, retain_height: int) -> None:
+        for key_fn in (_validators_key, _params_key, _abci_responses_key):
+            for k, _ in list(self._db.iterator(key_fn(0), key_fn(retain_height))):
+                self._db.delete(k)
+
+
+# -- State proto codec (proto/tendermint/state/types.pb.go State) ---------
+
+
+def _encode_state(s: State) -> bytes:
+    w = ProtoWriter()
+    ver = ProtoWriter()  # state.Version{1 consensus{1 block,2 app}, 2 software}
+    ver.write_message(1, s.version.encode(), always=True)
+    w.write_message(1, ver.bytes(), always=True)
+    w.write_string(2, s.chain_id)
+    w.write_varint(14, s.initial_height)
+    w.write_varint(3, s.last_block_height)
+    w.write_message(4, s.last_block_id.encode(), always=True)
+    w.write_message(5, _canon.encode_timestamp(s.last_block_time), always=True)
+    if s.next_validators is not None:
+        w.write_message(6, s.next_validators.encode())
+    if s.validators is not None:
+        w.write_message(7, s.validators.encode())
+    if s.last_validators is not None and not s.last_validators.is_nil_or_empty():
+        w.write_message(8, s.last_validators.encode())
+    w.write_varint(9, s.last_height_validators_changed)
+    w.write_message(10, s.consensus_params.encode(), always=True)
+    w.write_varint(11, s.last_height_consensus_params_changed)
+    w.write_bytes(12, s.last_results_hash)
+    w.write_bytes(13, s.app_hash)
+    return w.bytes()
+
+
+def _decode_state(data: bytes) -> State:
+    f = decode_message(data)
+    ver_f = decode_message(field_bytes(f, 1))
+    ts_f = decode_message(field_bytes(f, 5))
+    return State(
+        version=Version.decode(field_bytes(ver_f, 1)),
+        chain_id=field_bytes(f, 2).decode(),
+        initial_height=to_signed64(field_int(f, 14)) or 1,
+        last_block_height=to_signed64(field_int(f, 3)),
+        last_block_id=BlockID.decode(field_bytes(f, 4)),
+        last_block_time=Timestamp(
+            seconds=to_signed64(field_int(ts_f, 1)), nanos=field_int(ts_f, 2)
+        ),
+        next_validators=ValidatorSet.decode(field_bytes(f, 6)) if 6 in f else None,
+        validators=ValidatorSet.decode(field_bytes(f, 7)) if 7 in f else None,
+        last_validators=ValidatorSet.decode(field_bytes(f, 8)) if 8 in f else ValidatorSet(),
+        last_height_validators_changed=to_signed64(field_int(f, 9)),
+        consensus_params=ConsensusParams.decode(field_bytes(f, 10)),
+        last_height_consensus_params_changed=to_signed64(field_int(f, 11)),
+        last_results_hash=field_bytes(f, 12),
+        app_hash=field_bytes(f, 13),
+    )

@@ -1,0 +1,293 @@
+"""Evidence — proofs of validator misbehavior.
+
+Reference parity: types/evidence.go. DuplicateVoteEvidence (equivocation)
+and LightClientAttackEvidence (conflicting light block), their wire forms
+(proto/tendermint/types/evidence.pb.go), hashing, ABCI conversion, and
+the EvidenceList hashing used by Block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..crypto import merkle, tmhash
+from ..abci import types as abci
+from ..wire import canonical as _canon
+from ..wire.canonical import Timestamp
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+from .block import Commit, Header
+from .validator_set import Validator, ValidatorSet
+from .vote import Vote
+
+
+@dataclass
+class DuplicateVoteEvidence:
+    """types/evidence.go:38-48."""
+
+    vote_a: Vote
+    vote_b: Vote
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+
+    @classmethod
+    def new(
+        cls, vote1: Vote, vote2: Vote, block_time: Timestamp, val_set: ValidatorSet
+    ) -> "DuplicateVoteEvidence":
+        """evidence.go:51-80: votes ordered by BlockID key."""
+        if vote1 is None or vote2 is None:
+            raise ValueError("missing vote")
+        if val_set is None:
+            raise ValueError("missing validator set")
+        idx, val = val_set.get_by_address(vote1.validator_address)
+        if idx == -1:
+            raise ValueError("validator not in validator set")
+        if vote1.block_id.key() < vote2.block_id.key():
+            vote_a, vote_b = vote1, vote2
+        else:
+            vote_a, vote_b = vote2, vote1
+        return cls(
+            vote_a=vote_a,
+            vote_b=vote_b,
+            total_voting_power=val_set.total_voting_power(),
+            validator_power=val.voting_power,
+            timestamp=block_time,
+        )
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def time(self) -> Timestamp:
+        return self.timestamp
+
+    def bytes(self) -> bytes:
+        return self.encode()
+
+    def hash(self) -> bytes:
+        return tmhash.sum_sha256(self.encode())
+
+    def abci(self) -> List[abci.ABCIEvidence]:
+        return [
+            abci.ABCIEvidence(
+                type=abci.EVIDENCE_TYPE_DUPLICATE_VOTE,
+                validator=abci.ABCIValidator(
+                    address=self.vote_a.validator_address, power=self.validator_power
+                ),
+                height=self.vote_a.height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+        ]
+
+    def validate_basic(self) -> None:
+        """evidence.go:127-147."""
+        if self.vote_a is None or self.vote_b is None:
+            raise ValueError("empty duplicate vote evidence")
+        self.vote_a.validate_basic()
+        self.vote_b.validate_basic()
+        if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
+            raise ValueError("duplicate votes in invalid order")
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_message(1, self.vote_a.encode(), always=True)
+        w.write_message(2, self.vote_b.encode(), always=True)
+        w.write_varint(3, self.total_voting_power)
+        w.write_varint(4, self.validator_power)
+        w.write_message(5, _canon.encode_timestamp(self.timestamp), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "DuplicateVoteEvidence":
+        f = decode_message(data)
+        ts = decode_message(field_bytes(f, 5))
+        return cls(
+            vote_a=Vote.decode(field_bytes(f, 1)),
+            vote_b=Vote.decode(field_bytes(f, 2)),
+            total_voting_power=to_signed64(field_int(f, 3)),
+            validator_power=to_signed64(field_int(f, 4)),
+            timestamp=Timestamp(
+                seconds=to_signed64(field_int(ts, 1)), nanos=to_signed32(field_int(ts, 2))
+            ),
+        )
+
+
+@dataclass
+class LightBlockData:
+    """SignedHeader + ValidatorSet (types.LightBlock wire subset)."""
+
+    signed_header_raw: bytes  # encoded SignedHeader {1 header, 2 commit}
+    validator_set_raw: bytes  # encoded ValidatorSet
+
+    def header(self) -> Header:
+        f = decode_message(self.signed_header_raw)
+        return Header.decode(field_bytes(f, 1))
+
+    def commit(self) -> Commit:
+        f = decode_message(self.signed_header_raw)
+        return Commit.decode(field_bytes(f, 2))
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet.decode(self.validator_set_raw)
+
+
+@dataclass
+class LightClientAttackEvidence:
+    """types/evidence.go:200-248."""
+
+    conflicting_block: LightBlockData
+    common_height: int
+    byzantine_validators: List[Validator] = field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+
+    def height(self) -> int:
+        return self.common_height
+
+    def time(self) -> Timestamp:
+        return self.timestamp
+
+    def bytes(self) -> bytes:
+        return self.encode()
+
+    def hash(self) -> bytes:
+        """evidence.go:309-318: hash of (conflicting header hash, common
+        height) — stable across byzantine-validator discovery."""
+        w = ProtoWriter()
+        w.write_bytes(1, self.conflicting_block.header().hash())
+        w.write_varint(2, self.common_height)
+        return tmhash.sum_sha256(w.bytes())
+
+    def abci(self) -> List[abci.ABCIEvidence]:
+        return [
+            abci.ABCIEvidence(
+                type=abci.EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK,
+                validator=abci.ABCIValidator(address=v.address, power=v.voting_power),
+                height=self.common_height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+            for v in self.byzantine_validators
+        ]
+
+    def get_byzantine_validators(
+        self, common_vals: ValidatorSet, trusted_header_hash: bytes
+    ) -> List[Validator]:
+        """evidence.go:277-307: lunatic attack -> common-height signers of
+        the conflicting block; equivocation/amnesia -> conflicting signers."""
+        out: List[Validator] = []
+        conflicting_header = self.conflicting_block.header()
+        commit = self.conflicting_block.commit()
+        if conflicting_header.hash() == trusted_header_hash:
+            return out
+        if self.conflicting_header_is_invalid(trusted_header_hash, None):
+            # Lunatic: blame common-height validators who signed.
+            for cs in commit.signatures:
+                if cs.is_absent():
+                    continue
+                _, val = common_vals.get_by_address(cs.validator_address)
+                if val is not None:
+                    out.append(val)
+            out.sort(key=lambda v: v.address)
+        else:
+            # Equivocation/amnesia: blame conflicting-block signers.
+            vals = self.conflicting_block.validator_set()
+            for cs in commit.signatures:
+                if cs.is_absent():
+                    continue
+                _, val = vals.get_by_address(cs.validator_address)
+                if val is not None:
+                    out.append(val)
+            out.sort(key=lambda v: v.address)
+        return out
+
+    def conflicting_header_is_invalid(
+        self, trusted_header_hash: bytes, trusted_header: Optional[Header]
+    ) -> bool:
+        """evidence.go:320-330: lunatic iff the conflicting header's
+        val-hash machinery doesn't match the trusted one (approximated by
+        header-hash inequality at common height when no header given)."""
+        if trusted_header is None:
+            return True
+        ch = self.conflicting_block.header()
+        return not (
+            trusted_header.validators_hash == ch.validators_hash
+            and trusted_header.next_validators_hash == ch.next_validators_hash
+            and trusted_header.consensus_hash == ch.consensus_hash
+            and trusted_header.app_hash == ch.app_hash
+            and trusted_header.last_results_hash == ch.last_results_hash
+        )
+
+    def validate_basic(self) -> None:
+        if self.conflicting_block is None:
+            raise ValueError("conflicting block is nil")
+        if self.common_height <= 0:
+            raise ValueError("negative or zero common height")
+        self.conflicting_block.header()  # must parse
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        lb = ProtoWriter()
+        lb.write_message(1, self.conflicting_block.signed_header_raw, always=True)
+        lb.write_message(2, self.conflicting_block.validator_set_raw, always=True)
+        w.write_message(1, lb.bytes(), always=True)
+        w.write_varint(2, self.common_height)
+        for v in self.byzantine_validators:
+            w.write_message(3, v.encode(), always=True)
+        w.write_varint(4, self.total_voting_power)
+        w.write_message(5, _canon.encode_timestamp(self.timestamp), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LightClientAttackEvidence":
+        f = decode_message(data)
+        lb = decode_message(field_bytes(f, 1))
+        ts = decode_message(field_bytes(f, 5))
+        return cls(
+            conflicting_block=LightBlockData(
+                signed_header_raw=field_bytes(lb, 1),
+                validator_set_raw=field_bytes(lb, 2),
+            ),
+            common_height=to_signed64(field_int(f, 2)),
+            byzantine_validators=[Validator.decode(raw) for _, raw in f.get(3, [])],
+            total_voting_power=to_signed64(field_int(f, 4)),
+            timestamp=Timestamp(
+                seconds=to_signed64(field_int(ts, 1)), nanos=to_signed32(field_int(ts, 2))
+            ),
+        )
+
+
+# -- Evidence oneof wrapper (proto/tendermint/types/evidence.pb.go) -------
+
+_FIELD_DUPLICATE = 1
+_FIELD_LIGHT_ATTACK = 2
+
+
+def encode_evidence(ev) -> bytes:
+    w = ProtoWriter()
+    if isinstance(ev, DuplicateVoteEvidence):
+        w.write_message(_FIELD_DUPLICATE, ev.encode(), always=True)
+    elif isinstance(ev, LightClientAttackEvidence):
+        w.write_message(_FIELD_LIGHT_ATTACK, ev.encode(), always=True)
+    else:
+        raise TypeError(f"unknown evidence type {type(ev)}")
+    return w.bytes()
+
+
+def decode_evidence(data: bytes):
+    f = decode_message(data)
+    if _FIELD_DUPLICATE in f:
+        return DuplicateVoteEvidence.decode(field_bytes(f, _FIELD_DUPLICATE))
+    if _FIELD_LIGHT_ATTACK in f:
+        return LightClientAttackEvidence.decode(field_bytes(f, _FIELD_LIGHT_ATTACK))
+    raise ValueError("unknown evidence oneof")
+
+
+def evidence_to_abci(ev_raw: bytes) -> List[abci.ABCIEvidence]:
+    """Raw encoded Evidence -> abci.Evidence list (block execution path)."""
+    return decode_evidence(ev_raw).abci()
+
+
+def evidence_list_hash(evidence_raws: List[bytes]) -> bytes:
+    return merkle.hash_from_byte_slices(list(evidence_raws))

@@ -1,0 +1,180 @@
+"""State layer: genesis bootstrap, block production + execution against the
+kvstore app (the "one model running" e2e slice before consensus), state
+store checkpoints, median time."""
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.crypto.encoding import pubkey_to_proto
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.state import State, make_genesis_state, median_time
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import (
+    BlockID,
+    Timestamp,
+    Validator,
+    Vote,
+    VoteSet,
+    PRECOMMIT_TYPE,
+)
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN_ID = "exec-chain"
+
+
+def make_genesis(n=3):
+    sks = [ed25519.gen_priv_key(bytes([i + 1]) * 32) for i in range(n)]
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Timestamp(seconds=1_700_000_000),
+        validators=[
+            GenesisValidator(address=b"", pub_key=sk.pub_key(), power=10) for sk in sks
+        ],
+    )
+    st = make_genesis_state(doc)
+    return sks, st
+
+
+def sign_commit(sks, state: State, block, parts, height, round_=0, ts_base=1_700_000_100):
+    """Build a valid precommit commit for `block` signed by state's current
+    validators (they will be last_validators at height+1)."""
+    vset = state.validators
+    block_id = BlockID(hash=block.hash(), part_set_header=parts.header())
+    vs = VoteSet(CHAIN_ID, height, round_, PRECOMMIT_TYPE, vset)
+    by_addr = {sk.pub_key().address(): sk for sk in sks}
+    for idx, val in enumerate(vset.validators):
+        sk = by_addr[val.address]
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=Timestamp(seconds=ts_base + height),
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        sig = sk.sign(vote.sign_bytes(CHAIN_ID))
+        vs.add_vote(Vote(**{**vote.__dict__, "signature": sig}))
+    return vs.make_commit(), block_id
+
+
+def build_executor():
+    app = KVStoreApplication()
+    proxy = LocalClient(app)
+    store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    ex = BlockExecutor(store, proxy, block_store=block_store)
+    return ex, store, block_store, app
+
+
+class TestChainExecution:
+    def test_three_block_chain(self):
+        sks, state = make_genesis()
+        ex, sstore, bstore, app = build_executor()
+        sstore.save(state)
+
+        commit = None
+        for height in range(1, 4):
+            proposer = state.validators.get_proposer()
+            block, parts = ex.create_proposal_block(height, state, commit, proposer.address)
+            # give the block a tx
+            block.data.txs = [b"k%d=v%d" % (height, height)]
+            block.header = type(block.header)(**{**block.header.__dict__})
+            block.fill_header()
+            # refresh data hash after adding txs
+            from dataclasses import replace as drep
+
+            block.header = drep(block.header, data_hash=block.data.hash())
+            parts = type(parts).from_data(block.encode())
+            block_id = BlockID(hash=block.hash(), part_set_header=parts.header())
+
+            new_state = ex.apply_block(state, block_id, block)
+            bstore.save_block(block, parts, sign_commit(sks, new_state, block, parts, height)[0])
+
+            commit, _ = sign_commit(sks, state, block, parts, height)
+            assert new_state.last_block_height == height
+            assert new_state.last_block_id == block_id
+            state = new_state
+
+        assert app._size == 3  # 3 txs delivered
+        assert state.app_hash  # app hash flowed back
+        # results hash of a single OK tx is stable and lands in next header
+        assert state.last_results_hash
+
+    def test_apply_block_rejects_wrong_height(self):
+        sks, state = make_genesis()
+        ex, sstore, _, _ = build_executor()
+        sstore.save(state)
+        proposer = state.validators.get_proposer()
+        block, parts = ex.create_proposal_block(5, state, None, proposer.address)
+        block_id = BlockID(hash=block.hash(), part_set_header=parts.header())
+        from tendermint_tpu.state.execution import InvalidBlockError
+
+        with pytest.raises(InvalidBlockError):
+            ex.apply_block(state, block_id, block)
+
+    def test_validator_update_via_endblock(self):
+        """EndBlock validator updates flow into next_validators (n+2 rule)."""
+        from tendermint_tpu.abci.application import BaseApplication
+
+        new_sk = ed25519.gen_priv_key(bytes([42]) * 32)
+
+        class ValApp(KVStoreApplication):
+            def end_block(self, req):
+                resp = super().end_block(req)
+                if req.height == 1:
+                    resp.validator_updates = [
+                        abci.ValidatorUpdate(
+                            pub_key=pubkey_to_proto(new_sk.pub_key()), power=7
+                        )
+                    ]
+                return resp
+
+        sks, state = make_genesis()
+        sstore = StateStore(MemDB())
+        sstore.save(state)
+        ex = BlockExecutor(sstore, LocalClient(ValApp()))
+        proposer = state.validators.get_proposer()
+        block, parts = ex.create_proposal_block(1, state, None, proposer.address)
+        block_id = BlockID(hash=block.hash(), part_set_header=parts.header())
+        ns = ex.apply_block(state, block_id, block)
+        assert ns.next_validators.has_address(new_sk.pub_key().address())
+        assert not ns.validators.has_address(new_sk.pub_key().address())
+        assert ns.last_height_validators_changed == 3  # height+1+1
+
+
+class TestStateStore:
+    def test_save_load_roundtrip(self):
+        _, state = make_genesis()
+        store = StateStore(MemDB())
+        store.save(state)
+        loaded = store.load()
+        assert loaded.chain_id == state.chain_id
+        assert loaded.validators.hash() == state.validators.hash()
+        assert loaded.next_validators.hash() == state.next_validators.hash()
+        assert loaded.consensus_params == state.consensus_params
+
+    def test_load_validators_checkpoint_walkback(self):
+        _, state = make_genesis()
+        store = StateStore(MemDB())
+        store.save(state)
+        v1 = store.load_validators(1)
+        assert v1.hash() == state.validators.hash()
+        v2 = store.load_validators(2)
+        assert v2.hash() == state.next_validators.hash()
+
+
+class TestMedianTime:
+    def test_weighted_median(self):
+        sks, state = make_genesis()
+        ex, sstore, _, _ = build_executor()
+        sstore.save(state)
+        proposer = state.validators.get_proposer()
+        block, parts = ex.create_proposal_block(1, state, None, proposer.address)
+        commit, _ = sign_commit(sks, state, block, parts, 1, ts_base=500)
+        med = median_time(commit, state.validators)
+        assert med.seconds == 501  # all voted with seconds=500+height
