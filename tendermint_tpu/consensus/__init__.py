@@ -1,0 +1,12 @@
+"""tendermint_tpu.consensus — the BFT consensus engine (reference
+internal/consensus/, L7)."""
+
+from .state import (  # noqa: F401
+    BlockPartMessage,
+    ConsensusState,
+    ProposalMessage,
+    VoteMessage,
+)
+from .ticker import TimeoutInfo, TimeoutTicker  # noqa: F401
+from .types import HeightVoteSet, RoundState  # noqa: F401
+from .wal import WAL, WALMessage  # noqa: F401

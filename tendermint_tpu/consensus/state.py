@@ -1,0 +1,876 @@
+"""The Tendermint BFT consensus state machine.
+
+Reference parity: internal/consensus/state.go (2400 LoC). One
+receive-routine thread owns all round state (state.go:757 receiveRoutine);
+peer messages, internal messages and timeouts arrive on a queue; every
+message is WAL-logged before processing; the node's own votes are
+WAL-synced before broadcast (the double-sign-safety invariant).
+
+Step flow (types/round_state.go:20-28):
+  NewHeight → NewRound → Propose → Prevote → PrevoteWait → Precommit →
+  PrecommitWait → Commit → (NewHeight...)
+
+The message handlers mirror state.go's enterX functions with their exact
+guard conditions; vote accumulation uses types.VoteSet (per-vote verify)
+and the finalize path applies blocks through state.BlockExecutor, whose
+LastCommit verification runs on the device batch engine.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ..libs.service import BaseService
+from ..types import (
+    BlockID,
+    Commit,
+    Timestamp,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from ..types.block import Block
+from ..types.part_set import Part, PartSet
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..types.vote_set import ErrVoteConflictingVotes, ErrVoteNonDeterministicSignature
+from ..state import State
+from ..state.execution import BlockExecutor
+from .ticker import TimeoutInfo, TimeoutTicker
+from .types import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+    RoundState,
+)
+from .wal import WAL, WALMessage
+
+
+def _now_ts() -> Timestamp:
+    t = _time.time()
+    sec = int(t)
+    return Timestamp(seconds=sec, nanos=int((t - sec) * 1e9))
+
+
+def _ts_le(a: Timestamp, b: Timestamp) -> bool:
+    return (a.seconds, a.nanos) <= (b.seconds, b.nanos)
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+class ConsensusState(BaseService):
+    """state.go:81-200 State."""
+
+    def __init__(
+        self,
+        config,  # ConsensusConfig
+        state: State,
+        block_exec: BlockExecutor,
+        block_store,
+        mempool=None,
+        evpool=None,
+        event_bus=None,
+        wal: Optional[WAL] = None,
+        priv_validator=None,
+    ):
+        super().__init__("ConsensusState")
+        self._cfg = config
+        self._block_exec = block_exec
+        self._block_store = block_store
+        self._mempool = mempool
+        self._evpool = evpool
+        self._event_bus = event_bus
+        self._wal = wal
+        self._priv_validator = priv_validator
+        self._priv_validator_pub_key = (
+            priv_validator.get_pub_key() if priv_validator else None
+        )
+
+        self.rs = RoundState()
+        self._state = state  # committed chain state
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self._internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self._ticker = TimeoutTicker(self._tock)
+        self._thread: Optional[threading.Thread] = None
+        self._done_first_block = threading.Event()
+        self._height_events: List[Callable] = []  # test hooks per committed height
+
+        # byzantine-test overrides (common_test.go decideProposal/doPrevote)
+        self.decide_proposal_override: Optional[Callable] = None
+        self.do_prevote_override: Optional[Callable] = None
+
+        # Broadcast seam: the consensus reactor registers here to gossip the
+        # node's own proposals/parts/votes (reactor.go's peer routines read
+        # these off the internal message flow).
+        self.broadcast_hooks: List[Callable] = []
+
+        self._update_to_state(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def on_start(self) -> None:
+        if self._wal is not None:
+            self._wal.start()
+            self._replay_wal()
+        self._thread = threading.Thread(target=self._receive_routine, daemon=True)
+        self._thread.start()
+        # start the height's round 0 after commit-timeout from start_time
+        self._schedule_round_0()
+
+    def on_stop(self) -> None:
+        self._ticker.stop()
+        self._queue.put(("quit", None))
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._wal is not None:
+            self._wal.stop()
+
+    # ------------------------------------------------------------------
+    # external inputs
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self._queue.put((ProposalMessage(proposal), peer_id))
+
+    def add_block_part(self, height: int, round_: int, part: Part, peer_id: str = "") -> None:
+        self._queue.put((BlockPartMessage(height, round_, part), peer_id))
+
+    def add_vote_msg(self, vote: Vote, peer_id: str = "") -> None:
+        self._queue.put((VoteMessage(vote), peer_id))
+
+    def _send_internal(self, msg) -> None:
+        self._internal_queue.put((msg, ""))
+        for hook in self.broadcast_hooks:
+            try:
+                hook(msg)
+            except Exception:  # noqa: BLE001 — gossip must not break consensus
+                pass
+
+    def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if self._state.last_block_height >= height:
+                return
+            _time.sleep(0.02)
+        raise TimeoutError(
+            f"height {height} not reached; at {self._state.last_block_height}"
+        )
+
+    @property
+    def committed_state(self) -> State:
+        return self._state
+
+    # ------------------------------------------------------------------
+    # the receive routine (state.go:757-850)
+
+    def _receive_routine(self) -> None:
+        while True:
+            # internal queue drains first (own proposal/votes)
+            try:
+                msg, peer_id = self._internal_queue.get_nowait()
+            except queue.Empty:
+                try:
+                    msg, peer_id = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+            if msg == "quit":
+                return
+            if isinstance(msg, TimeoutInfo):
+                self._wal_write(WALMessage(timeout=(
+                    int(msg.duration * 1000), msg.height, msg.round, msg.step)))
+                self._handle_timeout(msg)
+            else:
+                self._wal_write_msg(msg, peer_id)
+                try:
+                    self._handle_msg(msg, peer_id)
+                except Exception as e:  # noqa: BLE001 — a bad peer message must not kill consensus
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _wal_write(self, rec: WALMessage) -> None:
+        if self._wal is not None:
+            self._wal.write(rec)
+
+    def _wal_write_msg(self, msg, peer_id: str) -> None:
+        if self._wal is None:
+            return
+        if isinstance(msg, ProposalMessage):
+            rec = WALMessage(msg_kind="proposal", msg_payload=msg.proposal.encode(), peer_id=peer_id)
+        elif isinstance(msg, BlockPartMessage):
+            from ..wire.proto import ProtoWriter
+
+            w = ProtoWriter()
+            w.write_varint(1, msg.height)
+            w.write_varint(2, msg.round)
+            w.write_message(3, msg.part.encode(), always=True)
+            rec = WALMessage(msg_kind="block_part", msg_payload=w.bytes(), peer_id=peer_id)
+        elif isinstance(msg, VoteMessage):
+            rec = WALMessage(msg_kind="vote", msg_payload=msg.vote.encode(), peer_id=peer_id)
+        else:
+            return
+        if peer_id == "":
+            self._wal.write_sync(rec)  # own messages are synced (state.go:780)
+        else:
+            self._wal.write(rec)
+
+    def _handle_msg(self, msg, peer_id: str) -> None:
+        """state.go:849-920."""
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            added = self._add_proposal_block_part(msg, peer_id)
+            if added and self.rs.proposal_block_parts is not None and \
+                    self.rs.proposal_block_parts.is_complete():
+                pass  # handled inside _add_proposal_block_part
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+        else:
+            raise ValueError(f"unknown msg type {type(msg)}")
+
+    def _tock(self, ti: TimeoutInfo) -> None:
+        """Ticker callback → queue (state.go timeoutRoutine → tockChan)."""
+        self._queue.put((ti, ""))
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:923-1005."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return  # stale
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            if self._event_bus:
+                self._event_bus.publish_timeout_propose(rs.round_state_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            if self._event_bus:
+                self._event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            if self._event_bus:
+                self._event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ValueError(f"invalid timeout step {ti.step}")
+
+    # ------------------------------------------------------------------
+    # state transitions
+
+    def _update_to_state(self, state: State) -> None:
+        """state.go:624-722 updateToState."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState() expected state height of {rs.height} but found {state.last_block_height}"
+            )
+        validators = state.validators
+        if state.last_block_height == 0:
+            last_precommits = None
+        else:
+            if rs.votes is not None and rs.commit_round > -1:
+                precommits = rs.votes.precommits(rs.commit_round)
+                if precommits is None or not precommits.has_two_thirds_majority():
+                    last_precommits = None
+                else:
+                    last_precommits = precommits
+            else:
+                last_precommits = None
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        if rs.commit_time:
+            rs.start_time = rs.commit_time + self._cfg.commit_timeout()
+        else:
+            rs.start_time = _time.time() + self._cfg.commit_timeout()
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self._state = state
+
+    def _schedule_round_0(self) -> None:
+        sleep = max(self.rs.start_time - _time.time(), 0.0)
+        self._ticker.schedule_timeout(
+            TimeoutInfo(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
+        )
+
+    def _new_step_event(self) -> None:
+        if self._event_bus is not None:
+            self._event_bus.publish_new_round_step(self.rs.round_state_event())
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:1008-1088."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != STEP_NEW_HEIGHT
+        ):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round's votes
+        rs.triggered_timeout_precommit = False
+        if self._event_bus is not None:
+            self._event_bus.publish_new_round(rs.round_state_event())
+        wait_for_txs = (
+            self._cfg.create_empty_blocks_interval_ms > 0
+            and not self._cfg.create_empty_blocks
+            and round_ == 0
+        )
+        if wait_for_txs:
+            self._ticker.schedule_timeout(
+                TimeoutInfo(
+                    self._cfg.create_empty_blocks_interval_ms / 1000.0,
+                    height, round_, STEP_NEW_ROUND,
+                )
+            )
+            return
+        self._enter_propose(height, round_)
+
+    def _is_proposer(self) -> bool:
+        if self._priv_validator_pub_key is None:
+            return False
+        proposer = self.rs.validators.get_proposer()
+        return proposer is not None and proposer.address == self._priv_validator_pub_key.address()
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """state.go:1090-1159."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PROPOSE
+        ):
+            return
+        rs.round = round_
+        rs.step = STEP_PROPOSE
+        self._new_step_event()
+        self._ticker.schedule_timeout(
+            TimeoutInfo(self._cfg.propose_timeout(round_), height, round_, STEP_PROPOSE)
+        )
+        if self._priv_validator is not None and self._is_proposer():
+            if self.decide_proposal_override is not None:
+                self.decide_proposal_override(self, height, round_)
+            else:
+                self._decide_proposal(height, round_)
+        # if the proposal is already complete (e.g. we are the proposer or
+        # received parts earlier), advance
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1161-1226 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit = None
+            if height == self._state.initial_height:
+                commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+            elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+                commit = rs.last_commit.make_commit()
+            else:
+                return  # no commit for the previous block: cannot propose
+            proposer_addr = self._priv_validator_pub_key.address()
+            block, block_parts = self._block_exec.create_proposal_block(
+                height, self._state, commit, proposer_addr
+            )
+        block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header())
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=block_id,
+            timestamp=_now_ts(),
+        )
+        try:
+            sig = self._priv_validator.sign_proposal(self._state.chain_id, proposal)
+        except ValueError:
+            return
+        proposal = Proposal(**{**proposal.__dict__, "signature": sig})
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total()):
+            self._send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
+
+    def _is_proposal_complete(self) -> bool:
+        """state.go:1228-1243."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1268-1296."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PREVOTE
+        ):
+            return
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step_event()
+        if self.do_prevote_override is not None:
+            self.do_prevote_override(self, height, round_)
+        else:
+            self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1298-1336 defaultDoPrevote."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(),
+                                rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self._block_exec.validate_block(self._state, rs.proposal_block)
+        except ValueError:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        self._sign_add_vote(
+            PREVOTE_TYPE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """state.go:1338-1362."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError("enter_prevote_wait without +2/3 prevotes")
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step_event()
+        self._ticker.schedule_timeout(
+            TimeoutInfo(self._cfg.prevote_timeout(round_), height, round_, STEP_PREVOTE_WAIT)
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1364-1462."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PRECOMMIT
+        ):
+            return
+        rs.round = round_
+        rs.step = STEP_PRECOMMIT
+        self._new_step_event()
+        prevotes = rs.votes.prevotes(round_)
+        block_id, ok = (prevotes.two_thirds_majority() if prevotes else (BlockID(), False))
+        if not ok:
+            # no polka: precommit nil
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+        if self._event_bus is not None:
+            self._event_bus.publish_polka(rs.round_state_event())
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise RuntimeError(f"POLRound {pol_round} < {round_}")
+        if block_id.is_zero():
+            # +2/3 prevoted nil: unlock and precommit nil
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self._event_bus is not None:
+                    self._event_bus.publish_relock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            # relock
+            rs.locked_round = round_
+            if self._event_bus is not None:
+                self._event_bus.publish_relock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header)
+            return
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            try:
+                self._block_exec.validate_block(self._state, rs.proposal_block)
+            except ValueError as e:
+                raise RuntimeError(f"+2/3 prevoted an invalid block: {e}") from e
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self._event_bus is not None:
+                self._event_bus.publish_lock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header)
+            return
+        # +2/3 prevotes for a block we don't have: unlock, fetch, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            block_id.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.new_from_header(block_id.part_set_header)
+        self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """state.go:1464-1491."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError("enter_precommit_wait without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step_event()
+        self._ticker.schedule_timeout(
+            TimeoutInfo(self._cfg.precommit_timeout(round_), height, round_, STEP_PRECOMMIT_WAIT)
+        )
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1518-1579."""
+        rs = self.rs
+        if rs.height != height or rs.step >= STEP_COMMIT:
+            return
+        rs.round = rs.round  # unchanged by commit
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time = _time.time()
+        self._new_step_event()
+        precommits = rs.votes.precommits(commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok:
+            raise RuntimeError("RunActionCommit without +2/3 precommits")
+        if rs.locked_block is not None and rs.locked_block_parts.has_header(
+            block_id.part_set_header
+        ):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        elif rs.proposal_block is None or not rs.proposal_block_parts.has_header(
+            block_id.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.new_from_header(block_id.part_set_header)
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """state.go:1581-1607."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError("try_finalize_commit at wrong height")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id.is_zero():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return  # don't have the block yet
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """state.go:1609-1700."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok or not block_parts.has_header(block_id.part_set_header):
+            raise RuntimeError("finalize_commit preconditions violated")
+        if block.hash() != block_id.hash:
+            raise RuntimeError("cannot finalize: proposal block does not hash to commit hash")
+        self._block_exec.validate_block(self._state, block)
+
+        # Save to block store before applying (state.go:1640-1652)
+        if self._block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            self._block_store.save_block(block, block_parts, seen_commit)
+
+        if self._wal is not None:
+            self._wal.write_sync(WALMessage(end_height=height))
+
+        state_copy = self._state.copy()
+        new_state = self._block_exec.apply_block(state_copy, block_id, block)
+
+        # NewHeight: updateToState + schedule round 0
+        self._update_to_state(new_state)
+        self._done_first_block.set()
+        self._schedule_round_0()
+
+    # ------------------------------------------------------------------
+    # proposals / parts / votes
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """state.go:1753-1804 defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ValueError("error invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self._state.chain_id), proposal.signature
+        ):
+            raise ValueError("error invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.new_from_header(
+                proposal.block_id.part_set_header
+            )
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
+        """state.go:1806-1895."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if added and rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            rs.proposal_block = Block.decode(data)
+            if self._event_bus is not None:
+                self._event_bus.publish_complete_proposal(rs.round_state_event())
+            prevotes = rs.votes.prevotes(rs.round)
+            block_id, has_23 = (
+                prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+            )
+            if has_23 and not block_id.is_zero() and rs.valid_round < rs.round:
+                if rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+            if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+            elif rs.step == STEP_COMMIT:
+                self._try_finalize_commit(rs.height)
+        return added
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:1959-2005."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ErrVoteNonDeterministicSignature:
+            return False
+        except ErrVoteConflictingVotes as e:
+            # evidence: our own double-sign would be fatal; peers' recorded
+            if (
+                self._priv_validator_pub_key is not None
+                and vote.validator_address == self._priv_validator_pub_key.address()
+            ):
+                return False
+            if self._evpool is not None:
+                from ..types.evidence import DuplicateVoteEvidence
+
+                try:
+                    ev = DuplicateVoteEvidence.new(
+                        e.vote_a, e.vote_b, self._state.last_block_time,
+                        self._state.validators,
+                    )
+                    self._evpool.add_evidence(ev)
+                except ValueError:
+                    pass
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2007-2180."""
+        rs = self.rs
+        # A precommit for the previous height (catchup for commit-timeout)
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added and self._event_bus is not None:
+                self._event_bus.publish_vote(vote)
+            return added
+        if vote.height != rs.height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self._event_bus is not None:
+            self._event_bus.publish_vote(vote)
+
+        if vote.type == PREVOTE_TYPE:
+            prevotes = rs.votes.prevotes(vote.round)
+            # valid-block tracking (state.go:2085-2130)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok and not block_id.is_zero() and rs.valid_round < vote.round and vote.round == rs.round:
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                        block_id.part_set_header
+                    ):
+                        rs.proposal_block_parts = PartSet.new_from_header(
+                            block_id.part_set_header
+                        )
+                if self._event_bus is not None:
+                    self._event_bus.publish_valid_block(rs.round_state_event())
+            # step transitions (state.go:2132-2160)
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(rs.height, vote.round)
+            elif rs.round == vote.round and rs.step >= STEP_PREVOTE:
+                block_id2, ok2 = prevotes.two_thirds_majority()
+                if ok2 and (self._is_proposal_complete() or block_id2.is_zero()):
+                    self._enter_precommit(rs.height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(rs.height, vote.round)
+            elif rs.proposal is not None and rs.proposal.pol_round >= 0 and rs.proposal.pol_round == vote.round:
+                if self._is_proposal_complete():
+                    self._enter_prevote(rs.height, rs.round)
+        elif vote.type == PRECOMMIT_TYPE:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(rs.height, vote.round)
+                self._enter_precommit(rs.height, vote.round)
+                if not block_id.is_zero():
+                    self._enter_commit(rs.height, vote.round)
+                    if self._cfg.skip_timeout_commit and precommits.has_all():
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(rs.height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(rs.height, vote.round)
+                self._enter_precommit_wait(rs.height, vote.round)
+        return added
+
+    def _sign_vote(self, vote_type: int, hash_: bytes, header) -> Optional[Vote]:
+        """state.go:2182-2230 signVote."""
+        if self._priv_validator is None or self._priv_validator_pub_key is None:
+            return None
+        addr = self._priv_validator_pub_key.address()
+        idx, val = self.rs.validators.get_by_address(addr)
+        if val is None:
+            return None  # not a validator
+        block_id = BlockID(hash=hash_, part_set_header=header) if hash_ else BlockID()
+        vote = Vote(
+            type=vote_type,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=block_id,
+            timestamp=self._vote_time(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        try:
+            sig = self._priv_validator.sign_vote(self._state.chain_id, vote)
+        except ValueError:
+            return None
+        return Vote(**{**vote.__dict__, "signature": sig})
+
+    def _vote_time(self) -> Timestamp:
+        """state.go voteTime: max(now, lastBlockTime + 1ns-ish)."""
+        now = _now_ts()
+        lbt = self._state.last_block_time
+        min_time = Timestamp(seconds=lbt.seconds, nanos=lbt.nanos + 1)
+        if min_time.nanos >= 10**9:
+            min_time = Timestamp(seconds=min_time.seconds + 1, nanos=min_time.nanos - 10**9)
+        if _ts_le(now, min_time):
+            return min_time
+        return now
+
+    def _sign_add_vote(self, vote_type: int, hash_: bytes, header) -> Optional[Vote]:
+        vote = self._sign_vote(vote_type, hash_, header)
+        if vote is not None:
+            self._send_internal(VoteMessage(vote))
+        return vote
+
+    # ------------------------------------------------------------------
+    # WAL replay (replay.go:96-160 catchupReplay)
+
+    def _replay_wal(self) -> None:
+        if self._wal is None:
+            return
+        tail = self._wal.search_for_end_height(self._state.last_block_height)
+        if tail is None:
+            return
+        for rec in tail:
+            if rec.end_height is not None:
+                continue
+            if rec.timeout is not None:
+                continue  # timeouts are rescheduled naturally
+            try:
+                if rec.msg_kind == "proposal":
+                    self._set_proposal(Proposal.decode(rec.msg_payload))
+                elif rec.msg_kind == "block_part":
+                    from ..wire.proto import decode_message, field_bytes, field_int
+
+                    f = decode_message(rec.msg_payload)
+                    self._add_proposal_block_part(
+                        BlockPartMessage(
+                            height=field_int(f, 1),
+                            round=field_int(f, 2),
+                            part=Part.decode(field_bytes(f, 3)),
+                        ),
+                        rec.peer_id,
+                    )
+                elif rec.msg_kind == "vote":
+                    self._try_add_vote(Vote.decode(rec.msg_payload), rec.peer_id)
+            except (ValueError, RuntimeError):
+                continue

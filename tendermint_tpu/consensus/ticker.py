@@ -1,0 +1,65 @@
+"""Timeout ticker — the consensus timer.
+
+Reference parity: internal/consensus/ticker.go — one active timeout at a
+time, scheduled timeouts for earlier (height, round, step) are ignored,
+newer ones replace the pending timer (timeoutRoutine:80-130). Fired
+timeouts are delivered through a callback into the receive loop's queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float  # seconds
+    height: int
+    round: int
+    step: int
+
+    def hrs(self):
+        return (self.height, self.round, self.step)
+
+
+class TimeoutTicker:
+    """ticker.go:17-60."""
+
+    def __init__(self, on_timeout: Callable[[TimeoutInfo], None]):
+        self._on_timeout = on_timeout
+        self._mtx = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._pending: Optional[TimeoutInfo] = None
+        self._stopped = False
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        """timeoutRoutine: ignore stale, replace pending with newer."""
+        with self._mtx:
+            if self._stopped:
+                return
+            if self._pending is not None and ti.hrs() < self._pending.hrs():
+                return  # stale relative to what's already scheduled
+            if self._timer is not None:
+                self._timer.cancel()
+            self._pending = ti
+            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        with self._mtx:
+            if self._stopped or self._pending is not ti:
+                return
+            self._pending = None
+            self._timer = None
+        self._on_timeout(ti)
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._pending = None

@@ -1,0 +1,171 @@
+"""Consensus internal types: round steps, RoundState, HeightVoteSet.
+
+Reference parity: internal/consensus/types/{round_state.go,
+height_vote_set.go}.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..types import BlockID, Commit, Timestamp, ValidatorSet, Vote, VoteSet
+from ..types.block import Block
+from ..types.part_set import PartSet
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, is_vote_type_valid
+
+# RoundStepType (round_state.go:20-28)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight",
+    STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose",
+    STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait",
+    STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait",
+    STEP_COMMIT: "Commit",
+}
+
+
+class ErrGotVoteFromUnwantedRound(ValueError):
+    pass
+
+
+class HeightVoteSet:
+    """height_vote_set.go:40-200: all vote sets for a height, rounds
+    0..round, plus up to 2 catchup rounds per peer."""
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self._chain_id = chain_id
+        self._mtx = threading.RLock()
+        self.reset(height, val_set)
+
+    def reset(self, height: int, val_set: ValidatorSet) -> None:
+        with self._mtx:
+            self._height = height
+            self._val_set = val_set
+            self._round_vote_sets: Dict[int, Tuple[VoteSet, VoteSet]] = {}
+            self._peer_catchup_rounds: Dict[str, List[int]] = {}
+            self._add_round(0)
+            self._round = 0
+
+    def height(self) -> int:
+        return self._height
+
+    def round(self) -> int:
+        return self._round
+
+    def set_round(self, round_: int) -> None:
+        with self._mtx:
+            new_round = self._round - 1
+            if self._round != 0 and round_ < new_round:
+                raise ValueError("set_round() must increment round")
+            for r in range(max(new_round, 0), round_ + 1):
+                if r not in self._round_vote_sets:
+                    self._add_round(r)
+            self._round = round_
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._round_vote_sets:
+            raise ValueError("add_round() for an existing round")
+        prevotes = VoteSet(self._chain_id, self._height, round_, PREVOTE_TYPE, self._val_set)
+        precommits = VoteSet(self._chain_id, self._height, round_, PRECOMMIT_TYPE, self._val_set)
+        self._round_vote_sets[round_] = (prevotes, precommits)
+
+    def add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """height_vote_set.go:116-136. Returns added; raises on invalid."""
+        with self._mtx:
+            if not is_vote_type_valid(vote.type):
+                return False
+            vs = self._get_vote_set(vote.round, vote.type)
+            if vs is None:
+                rndz = self._peer_catchup_rounds.get(peer_id, [])
+                if len(rndz) < 2:
+                    self._add_round(vote.round)
+                    vs = self._get_vote_set(vote.round, vote.type)
+                    self._peer_catchup_rounds[peer_id] = rndz + [vote.round]
+                else:
+                    raise ErrGotVoteFromUnwantedRound(
+                        "peer has sent a vote that does not match our round for more than one round"
+                    )
+            return vs.add_vote(vote)
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        with self._mtx:
+            return self._get_vote_set(round_, PREVOTE_TYPE)
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        with self._mtx:
+            return self._get_vote_set(round_, PRECOMMIT_TYPE)
+
+    def pol_info(self) -> Tuple[int, BlockID]:
+        """height_vote_set.go:152-163: last round with a prevote maj23."""
+        with self._mtx:
+            for r in range(self._round, -1, -1):
+                rvs = self._get_vote_set(r, PREVOTE_TYPE)
+                if rvs is not None:
+                    block_id, ok = rvs.two_thirds_majority()
+                    if ok:
+                        return r, block_id
+            return -1, BlockID()
+
+    def _get_vote_set(self, round_: int, vote_type: int) -> Optional[VoteSet]:
+        rvs = self._round_vote_sets.get(round_)
+        if rvs is None:
+            return None
+        return rvs[0] if vote_type == PREVOTE_TYPE else rvs[1]
+
+    def set_peer_maj23(self, round_: int, vote_type: int, peer_id: str, block_id: BlockID) -> None:
+        """height_vote_set.go:184-202."""
+        with self._mtx:
+            if not is_vote_type_valid(vote_type):
+                raise ValueError(f"SetPeerMaj23: invalid vote type {vote_type}")
+            vs = self._get_vote_set(round_, vote_type)
+            if vs is None:
+                return
+            vs.set_peer_maj23(peer_id, block_id)
+
+
+@dataclass
+class RoundState:
+    """round_state.go:30-80 — the full consensus round state."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time: float = 0.0
+    commit_time: float = 0.0
+
+    validators: Optional[ValidatorSet] = None
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[PartSet] = None
+    votes: Optional[HeightVoteSet] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    triggered_timeout_precommit: bool = False
+
+    def round_state_event(self) -> dict:
+        return {
+            "height": self.height,
+            "round": self.round,
+            "step": STEP_NAMES.get(self.step, str(self.step)),
+        }

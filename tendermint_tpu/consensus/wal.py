@@ -1,0 +1,166 @@
+"""Consensus write-ahead log.
+
+Reference parity: internal/consensus/wal.go — every message and timeout is
+written before processing (state.go:757+); the node's own votes/proposals
+use write_sync (wal.go:196). Framing is CRC32C + length + proto-ish body
+(wal.go encodeFrame), max message 1MB (wal.go:25); a decode error on
+replay truncates (crash-tolerant tail).
+
+Message envelope (self-defined wire, node-local on-disk format):
+  1 time(Timestamp)  2 end_height(varint)  3 msg_info{1 kind(varint),
+  2 payload(bytes), 3 peer_id(string)}  4 timeout{1 duration_ms, 2 height,
+  3 round, 4 step}
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import BinaryIO, Iterator, Optional, Tuple
+
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+
+MAX_MSG_SIZE = 1 << 20  # 1MB (wal.go:25)
+
+
+@dataclass
+class WALMessage:
+    """Decoded WAL record."""
+
+    end_height: Optional[int] = None
+    msg_kind: Optional[str] = None  # "proposal" | "block_part" | "vote" | "event_rs"
+    msg_payload: bytes = b""
+    peer_id: str = ""
+    timeout: Optional[Tuple[int, int, int, int]] = None  # (dur_ms, h, r, step)
+
+
+_KINDS = {"event_rs": 1, "proposal": 2, "block_part": 3, "vote": 4}
+_KINDS_BY_NUM = {v: k for k, v in _KINDS.items()}
+
+
+def _encode_record(msg: WALMessage) -> bytes:
+    w = ProtoWriter()
+    if msg.end_height is not None:
+        w.write_varint(2, msg.end_height, always=True)
+    elif msg.timeout is not None:
+        t = ProtoWriter()
+        t.write_varint(1, msg.timeout[0])
+        t.write_varint(2, msg.timeout[1])
+        t.write_varint(3, msg.timeout[2])
+        t.write_varint(4, msg.timeout[3])
+        w.write_message(4, t.bytes(), always=True)
+    else:
+        m = ProtoWriter()
+        m.write_varint(1, _KINDS[msg.msg_kind])
+        m.write_bytes(2, msg.msg_payload)
+        m.write_string(3, msg.peer_id)
+        w.write_message(3, m.bytes(), always=True)
+    return w.bytes()
+
+
+def _decode_record(data: bytes) -> WALMessage:
+    f = decode_message(data)
+    if 2 in f:
+        return WALMessage(end_height=to_signed64(field_int(f, 2)))
+    if 4 in f:
+        t = decode_message(field_bytes(f, 4))
+        return WALMessage(
+            timeout=(field_int(t, 1), field_int(t, 2), field_int(t, 3), field_int(t, 4))
+        )
+    m = decode_message(field_bytes(f, 3))
+    return WALMessage(
+        msg_kind=_KINDS_BY_NUM[field_int(m, 1)],
+        msg_payload=field_bytes(m, 2),
+        peer_id=field_bytes(m, 3).decode(),
+    )
+
+
+class WAL:
+    """wal.go:58-220 BaseWAL (single-file variant of the autofile group;
+    size rotation is delegated to height-based truncation on restart)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh: Optional[BinaryIO] = None
+        self._mtx = threading.Lock()
+
+    def start(self) -> None:
+        exists = os.path.exists(self._path) and os.path.getsize(self._path) > 0
+        self._fh = open(self._path, "ab")
+        if not exists:
+            self.write(WALMessage(end_height=0))  # wal.go OnStart:118-124
+
+    def stop(self) -> None:
+        with self._mtx:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+
+    # -- writes ---------------------------------------------------------
+
+    def write(self, msg: WALMessage) -> None:
+        body = _encode_record(msg)
+        if len(body) > MAX_MSG_SIZE:
+            raise ValueError(f"msg is too big: {len(body)} bytes, max: {MAX_MSG_SIZE}")
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        frame = struct.pack(">II", crc, len(body)) + body
+        with self._mtx:
+            if self._fh is not None:
+                self._fh.write(frame)
+
+    def write_sync(self, msg: WALMessage) -> None:
+        """wal.go:196-210: fsync before the process acts on its own
+        proposal/vote — the crash-recovery invariant."""
+        self.write(msg)
+        try:
+            self.flush_and_sync()
+        except (OSError, ValueError):
+            pass  # closed during shutdown
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    # -- reads ----------------------------------------------------------
+
+    def iter_messages(self) -> Iterator[WALMessage]:
+        """Decode from the start; stop at corruption (crash-torn tail)."""
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as fh:
+            while True:
+                head = fh.read(8)
+                if len(head) < 8:
+                    return
+                crc, length = struct.unpack(">II", head)
+                if length > MAX_MSG_SIZE:
+                    return
+                body = fh.read(length)
+                if len(body) < length:
+                    return
+                if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                    return
+                try:
+                    yield _decode_record(body)
+                except (ValueError, KeyError):
+                    return
+
+    def search_for_end_height(self, height: int) -> Optional[list]:
+        """wal.go:226-280: find EndHeightMessage(height) and return the
+        messages after it (what must be replayed for height+1)."""
+        found = False
+        tail: list = []
+        for msg in self.iter_messages():
+            if found:
+                tail.append(msg)
+            elif msg.end_height == height:
+                found = True
+        return tail if found else None

@@ -1,0 +1,129 @@
+"""EventBus — typed event publication façade over libs.pubsub.
+
+Reference parity: internal/eventbus/event_bus.go. Every block/tx/vote/
+round-step event flows through here to RPC subscriptions and the indexer.
+ABCI events emitted by the app are merged into the pubsub event map so
+queries like `app.key='x' AND tm.event='Tx'` work.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..libs.pubsub import Query, Server, Subscription
+from ..libs.service import BaseService
+from ..types import events as tme
+
+
+def _merge_abci_events(base: Dict[str, List[str]], abci_events) -> None:
+    """events.go: app events index as "<type>.<attr_key>"."""
+    for ev in abci_events or []:
+        if not ev.type:
+            continue
+        for attr in ev.attributes:
+            if not attr.key:
+                continue
+            base.setdefault(f"{ev.type}.{attr.key}", []).append(attr.value)
+
+
+class EventBus(BaseService):
+    def __init__(self):
+        super().__init__("EventBus")
+        self._pubsub = Server()
+
+    # -- subscriptions --------------------------------------------------
+
+    def subscribe(self, subscriber: str, query: str, capacity: int = 100) -> Subscription:
+        return self._pubsub.subscribe(subscriber, Query(query), capacity)
+
+    def unsubscribe(self, subscriber: str, query: str) -> None:
+        self._pubsub.unsubscribe(subscriber, Query(query))
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        self._pubsub.unsubscribe_all(subscriber)
+
+    def num_clients(self) -> int:
+        return self._pubsub.num_clients()
+
+    def num_client_subscriptions(self, subscriber: str) -> int:
+        return self._pubsub.num_client_subscriptions(subscriber)
+
+    # -- publishers (event_bus.go:100-290) -------------------------------
+
+    def _publish(self, event_type: str, data: object, extra: Optional[Dict[str, List[str]]] = None,
+                 abci_events=None) -> None:
+        events: Dict[str, List[str]] = {tme.EVENT_TYPE_KEY: [event_type]}
+        if extra:
+            for k, v in extra.items():
+                events.setdefault(k, []).extend(v)
+        _merge_abci_events(events, abci_events)
+        self._pubsub.publish(data, events)
+
+    def publish_new_block(self, block, block_id, abci_responses=None) -> None:
+        abci_events = []
+        if abci_responses is not None:
+            from ..abci import types as abci
+
+            bb = abci.dec_response_payload("begin_block", abci_responses.begin_block)
+            eb = abci.dec_response_payload("end_block", abci_responses.end_block)
+            abci_events = list(bb.events) + list(eb.events)
+        self._publish(
+            tme.EventNewBlock,
+            {"block": block, "block_id": block_id},
+            extra={tme.BLOCK_HEIGHT_KEY: [str(block.header.height)]},
+            abci_events=abci_events,
+        )
+
+    def publish_new_block_header(self, header) -> None:
+        self._publish(tme.EventNewBlockHeader, {"header": header})
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result_raw: bytes) -> None:
+        from ..abci import types as abci
+        from ..types.tx import tx_hash
+
+        result = abci.dec_response_payload("deliver_tx", result_raw)
+        self._publish(
+            tme.EventTx,
+            {"height": height, "index": index, "tx": tx, "result": result},
+            extra={
+                tme.TX_HASH_KEY: [tx_hash(tx).hex().upper()],
+                tme.TX_HEIGHT_KEY: [str(height)],
+            },
+            abci_events=result.events,
+        )
+
+    def publish_validator_set_updates(self, updates) -> None:
+        self._publish(tme.EventValidatorSetUpdates, {"validator_updates": updates})
+
+    def publish_vote(self, vote) -> None:
+        self._publish(tme.EventVote, {"vote": vote})
+
+    def publish_new_evidence(self, evidence, height: int) -> None:
+        self._publish(tme.EventNewEvidence, {"evidence": evidence, "height": height})
+
+    def publish_new_round_step(self, rs) -> None:
+        self._publish(tme.EventNewRoundStep, rs)
+
+    def publish_new_round(self, rs) -> None:
+        self._publish(tme.EventNewRound, rs)
+
+    def publish_complete_proposal(self, rs) -> None:
+        self._publish(tme.EventCompleteProposal, rs)
+
+    def publish_polka(self, rs) -> None:
+        self._publish(tme.EventPolka, rs)
+
+    def publish_lock(self, rs) -> None:
+        self._publish(tme.EventLock, rs)
+
+    def publish_relock(self, rs) -> None:
+        self._publish(tme.EventRelock, rs)
+
+    def publish_valid_block(self, rs) -> None:
+        self._publish(tme.EventValidBlock, rs)
+
+    def publish_timeout_propose(self, rs) -> None:
+        self._publish(tme.EventTimeoutPropose, rs)
+
+    def publish_timeout_wait(self, rs) -> None:
+        self._publish(tme.EventTimeoutWait, rs)

@@ -1,0 +1,169 @@
+"""Pubsub with query filtering.
+
+Reference parity: libs/pubsub/pubsub.go (Server with per-subscriber
+buffered channels) + libs/pubsub/query (the event query language:
+`tm.event='NewBlock' AND tx.height>5`). The query grammar here covers the
+operators the reference's PEG grammar defines: =, <, <=, >, >=, CONTAINS,
+EXISTS, AND (the reference has no OR — parity).
+"""
+
+from __future__ import annotations
+
+import queue
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Message:
+    data: object
+    events: Dict[str, List[str]] = field(default_factory=dict)
+
+
+class Query:
+    """Parsed event query (libs/pubsub/query/query.go)."""
+
+    _COND_RE = re.compile(
+        r"\s*([\w.\-/]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*"
+        r"('(?:[^']*)'|\"(?:[^\"]*)\"|[\w.\-+]+)?\s*",
+    )
+
+    def __init__(self, s: str):
+        self._source = s
+        self.conditions: List[Tuple[str, str, Optional[str]]] = []
+        if not s.strip():
+            return
+        for part in re.split(r"\bAND\b", s):
+            part = part.strip()
+            if not part:
+                continue
+            m = self._COND_RE.fullmatch(part)
+            if not m:
+                raise ValueError(f"invalid query condition {part!r}")
+            key, op, val = m.group(1), m.group(2), m.group(3)
+            if op != "EXISTS":
+                if val is None:
+                    raise ValueError(f"operator {op} needs a value in {part!r}")
+                if val[0] in "'\"":
+                    val = val[1:-1]
+            self.conditions.append((key, op, val))
+
+    def matches(self, events: Dict[str, List[str]]) -> bool:
+        for key, op, want in self.conditions:
+            values = events.get(key)
+            if values is None:
+                return False
+            if op == "EXISTS":
+                continue
+            if not any(self._match_one(op, got, want) for got in values):
+                return False
+        return True
+
+    @staticmethod
+    def _match_one(op: str, got: str, want: str) -> bool:
+        if op == "=":
+            return got == want
+        if op == "CONTAINS":
+            return want in got
+        try:
+            g, w = float(got), float(want)
+        except ValueError:
+            return False
+        return {"<": g < w, "<=": g <= w, ">": g > w, ">=": g >= w}[op]
+
+    def __str__(self) -> str:
+        return self._source
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and self._source == other._source
+
+    def __hash__(self) -> int:
+        return hash(self._source)
+
+
+ALL = Query("")  # matches everything (query.Empty)
+
+
+class Subscription:
+    def __init__(self, q: Query, capacity: int = 100):
+        self.query = q
+        self._q: "queue.Queue[Message]" = queue.Queue(maxsize=capacity if capacity else 0)
+        self.canceled = threading.Event()
+        self.cancel_reason: str = ""
+
+    def put(self, msg: Message, block: bool) -> bool:
+        try:
+            self._q.put(msg, block=block, timeout=None if block else 0)
+            return True
+        except queue.Full:
+            return False
+
+    def next(self, timeout: Optional[float] = None) -> Message:
+        return self._q.get(timeout=timeout)
+
+    def try_next(self) -> Optional[Message]:
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def cancel(self, reason: str = "") -> None:
+        self.cancel_reason = reason
+        self.canceled.set()
+
+
+class Server:
+    """libs/pubsub/pubsub.go:104 Server."""
+
+    def __init__(self):
+        self._subs: Dict[Tuple[str, str], Subscription] = {}
+        self._mtx = threading.RLock()
+
+    def subscribe(
+        self, subscriber: str, q: Query, capacity: int = 100
+    ) -> Subscription:
+        with self._mtx:
+            key = (subscriber, str(q))
+            if key in self._subs:
+                raise ValueError(f"already subscribed: {key}")
+            sub = Subscription(q, capacity)
+            self._subs[key] = sub
+            return sub
+
+    def unsubscribe(self, subscriber: str, q: Query) -> None:
+        with self._mtx:
+            key = (subscriber, str(q))
+            sub = self._subs.pop(key, None)
+            if sub is None:
+                raise KeyError(f"not subscribed: {key}")
+            sub.cancel("unsubscribed")
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._mtx:
+            keys = [k for k in self._subs if k[0] == subscriber]
+            if not keys:
+                raise KeyError(f"not subscribed: {subscriber}")
+            for k in keys:
+                self._subs.pop(k).cancel("unsubscribed")
+
+    def publish(self, data: object, events: Optional[Dict[str, List[str]]] = None) -> None:
+        events = events or {}
+        msg = Message(data=data, events=events)
+        with self._mtx:
+            subs = list(self._subs.items())
+        for (name, _), sub in subs:
+            if sub.query.matches(events):
+                if not sub.put(msg, block=False):
+                    # slow subscriber: cancel like the reference's
+                    # ErrOutOfCapacity eviction
+                    sub.cancel("out of capacity")
+
+    def num_clients(self) -> int:
+        with self._mtx:
+            return len({k[0] for k in self._subs})
+
+    def num_client_subscriptions(self, subscriber: str) -> int:
+        with self._mtx:
+            return sum(1 for k in self._subs if k[0] == subscriber)

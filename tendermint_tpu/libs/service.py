@@ -1,0 +1,80 @@
+"""Service lifecycle — the BaseService pattern every component embeds.
+
+Reference parity: libs/service/service.go — Start/Stop/Reset with
+on_start/on_stop hooks, idempotence errors, and is_running checks
+(embedded by consensus state, reactors, mempool, etc., e.g.
+internal/consensus/state.go:81).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AlreadyStartedError(RuntimeError):
+    pass
+
+
+class AlreadyStoppedError(RuntimeError):
+    pass
+
+
+class BaseService:
+    def __init__(self, name: str = ""):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self._svc_mtx = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        with self._svc_mtx:
+            if self._started:
+                raise AlreadyStartedError(f"{self._name} already started")
+            if self._stopped:
+                raise AlreadyStoppedError(f"{self._name} already stopped")
+            self.on_start()
+            self._started = True
+
+    def stop(self) -> None:
+        with self._svc_mtx:
+            if not self._started or self._stopped:
+                return
+            self._stopped = True
+            self._quit.set()
+            self.on_stop()
+
+    def reset(self) -> None:
+        with self._svc_mtx:
+            if not self._stopped:
+                raise RuntimeError(f"cannot reset running service {self._name}")
+            self._started = False
+            self._stopped = False
+            self._quit = threading.Event()
+            self.on_reset()
+
+    # -- hooks ----------------------------------------------------------
+
+    def on_start(self) -> None: ...
+
+    def on_stop(self) -> None: ...
+
+    def on_reset(self) -> None: ...
+
+    # -- state ----------------------------------------------------------
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    def wait(self, timeout: float | None = None) -> None:
+        self._quit.wait(timeout)
+
+    @property
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    @property
+    def name(self) -> str:
+        return self._name

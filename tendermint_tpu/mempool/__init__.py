@@ -1,0 +1,268 @@
+"""Priority mempool.
+
+Reference parity: internal/mempool/ — TxMempool (mempool.go:31): CheckTx
+via ABCI with priority/sender from the response, priority ordering for
+block building (ReapMaxBytesMaxGas, mempool.go:344), FIFO order for
+gossip, LRU cache of seen txs (cache.go), post-commit Update with recheck
+(mempool.go:430).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..abci import types as abci
+from ..types.tx import tx_key
+
+
+class TxCache:
+    """LRU cache of tx keys (internal/mempool/cache.go)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._mtx = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present (mempool has seen it)."""
+        k = tx_key(tx)
+        with self._mtx:
+            if k in self._map:
+                self._map.move_to_end(k)
+                return False
+            self._map[k] = None
+            if self._size and len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._mtx:
+            self._map.pop(tx_key(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        with self._mtx:
+            return tx_key(tx) in self._map
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+
+@dataclass(order=True)
+class _WrappedTx:
+    sort_key: tuple = field(compare=True)
+    tx: bytes = field(compare=False, default=b"")
+    key: bytes = field(compare=False, default=b"")
+    priority: int = field(compare=False, default=0)
+    sender: str = field(compare=False, default="")
+    gas_wanted: int = field(compare=False, default=0)
+    height: int = field(compare=False, default=0)
+    seq: int = field(compare=False, default=0)
+    removed: bool = field(compare=False, default=False)
+
+
+class TxMempool:
+    """internal/mempool/mempool.go:31-520 (synchronous variant: CheckTx
+    calls the ABCI mempool connection inline; the reactor broadcasts from
+    the FIFO list)."""
+
+    def __init__(
+        self,
+        proxy_app,  # mempool-connection ABCI client
+        config=None,
+        height: int = 0,
+    ):
+        from ..config import MempoolConfig
+
+        self._cfg = config or MempoolConfig()
+        self._proxy = proxy_app
+        self._height = height
+        self._mtx = threading.RLock()
+        self._cache = TxCache(self._cfg.cache_size)
+        self._tx_by_key: Dict[bytes, _WrappedTx] = {}
+        self._fifo: List[_WrappedTx] = []  # gossip & FIFO order
+        self._seq = itertools.count()
+        self._size_bytes = 0
+        self._pre_check: Optional[Callable] = None
+        self._post_check: Optional[Callable] = None
+        self._notify_available: Optional[Callable] = None
+
+    # -- config hooks ---------------------------------------------------
+
+    def set_pre_check(self, fn: Callable) -> None:
+        self._pre_check = fn
+
+    def set_post_check(self, fn: Callable) -> None:
+        self._post_check = fn
+
+    def set_notify_available(self, fn: Callable) -> None:
+        """Called once when the mempool transitions empty -> non-empty
+        (consensus's txsAvailable channel)."""
+        self._notify_available = fn
+
+    # -- core -----------------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._tx_by_key)
+
+    def size_bytes(self) -> int:
+        with self._mtx:
+            return self._size_bytes
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def check_tx(self, tx: bytes, callback: Optional[Callable] = None, sender: str = "") -> abci.ResponseCheckTx:
+        """mempool.go:230-342."""
+        if len(tx) > self._cfg.max_tx_bytes:
+            raise ValueError(
+                f"tx size {len(tx)} exceeds max {self._cfg.max_tx_bytes}"
+            )
+        if self._pre_check is not None:
+            self._pre_check(tx)
+        if not self._cache.push(tx):
+            # seen before: reject as duplicate (mempool.go:270-287)
+            raise DuplicateTxError(tx_key(tx))
+        res = self._proxy.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        if res.is_ok():
+            with self._mtx:
+                if len(self._tx_by_key) >= self._cfg.size or (
+                    self._size_bytes + len(tx) > self._cfg.max_txs_bytes
+                ):
+                    self._cache.remove(tx)
+                    raise MempoolFullError(len(self._tx_by_key))
+                was_empty = not self._tx_by_key
+                wtx = _WrappedTx(
+                    sort_key=(-res.priority, next(self._seq)),
+                    tx=tx,
+                    key=tx_key(tx),
+                    priority=res.priority,
+                    sender=res.sender or sender,
+                    gas_wanted=res.gas_wanted,
+                    height=self._height,
+                )
+                self._tx_by_key[wtx.key] = wtx
+                self._fifo.append(wtx)
+                self._size_bytes += len(tx)
+            if was_empty and self._notify_available is not None:
+                self._notify_available()
+        else:
+            if not self._cfg.keep_invalid_txs_in_cache:
+                self._cache.remove(tx)
+        if callback is not None:
+            callback(res)
+        return res
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """mempool.go:344-402: highest priority first, FIFO within equal
+        priority, respecting byte/gas budgets."""
+        with self._mtx:
+            ordered = sorted(self._tx_by_key.values())
+            out: List[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for wtx in ordered:
+                sz = len(wtx.tx) + 6  # framing overhead like ComputeProtoSizeForTxs
+                if max_bytes > -1 and total_bytes + sz > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + wtx.gas_wanted > max_gas:
+                    break
+                total_bytes += sz
+                total_gas += wtx.gas_wanted
+                out.append(wtx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._mtx:
+            ordered = sorted(self._tx_by_key.values())
+            if n < 0:
+                n = len(ordered)
+            return [w.tx for w in ordered[:n]]
+
+    def txs_fifo(self) -> List[bytes]:
+        """Gossip order (the clist walk in the reference's reactor)."""
+        with self._mtx:
+            return [w.tx for w in self._fifo if not w.removed]
+
+    # -- consensus integration ------------------------------------------
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def flush_app_conn(self) -> None:
+        if hasattr(self._proxy, "flush"):
+            self._proxy.flush()
+
+    def update(
+        self,
+        height: int,
+        txs: List[bytes],
+        deliver_tx_responses: List[abci.ResponseDeliverTx],
+        pre_check: Optional[Callable] = None,
+        post_check: Optional[Callable] = None,
+    ) -> None:
+        """mempool.go:430-500. Caller must hold the lock."""
+        self._height = height
+        if pre_check is not None:
+            self._pre_check = pre_check
+        if post_check is not None:
+            self._post_check = post_check
+        for tx, res in zip(txs, deliver_tx_responses):
+            if res.is_ok():
+                self._cache.push(tx)  # committed: keep in cache forever-ish
+            elif not self._cfg.keep_invalid_txs_in_cache:
+                self._cache.remove(tx)
+            self._remove_tx(tx_key(tx))
+        if self._cfg.recheck and self._tx_by_key:
+            self._recheck_txs()
+
+    def _remove_tx(self, key: bytes) -> None:
+        wtx = self._tx_by_key.pop(key, None)
+        if wtx is not None:
+            wtx.removed = True
+            self._size_bytes -= len(wtx.tx)
+        self._fifo = [w for w in self._fifo if not w.removed]
+
+    def _recheck_txs(self) -> None:
+        """mempool.go:580-620: re-CheckTx all remaining txs."""
+        for wtx in list(self._tx_by_key.values()):
+            res = self._proxy.check_tx(
+                abci.RequestCheckTx(tx=wtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            )
+            ok = res.is_ok()
+            if ok and self._post_check is not None:
+                try:
+                    self._post_check(wtx.tx, res)
+                except ValueError:
+                    ok = False
+            if not ok:
+                self._remove_tx(wtx.key)
+                if not self._cfg.keep_invalid_txs_in_cache:
+                    self._cache.remove(wtx.tx)
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._tx_by_key.clear()
+            self._fifo.clear()
+            self._size_bytes = 0
+            self._cache.reset()
+
+
+class DuplicateTxError(ValueError):
+    def __init__(self, key: bytes):
+        super().__init__(f"tx already exists in cache: {key.hex()}")
+        self.key = key
+
+
+class MempoolFullError(RuntimeError):
+    def __init__(self, size: int):
+        super().__init__(f"mempool is full: {size} txs")

@@ -1,0 +1,223 @@
+"""MConnection — multiplexed, flow-controlled peer connection.
+
+Reference parity: internal/p2p/conn/connection.go:74 — per-channel send
+queues with priorities, packet framing (PacketPing/PacketPong/PacketMsg
+with msg chunking + EOF marker), ping/pong keepalive, flush throttling,
+sendRoutine/recvRoutine threads (connection.go:334,223).
+
+Packet wire form (proto oneof, conn/connection.go's Packet):
+  1 ping{} | 2 pong{} | 3 msg{1 channel_id, 2 eof(bool), 3 data}
+framed with a uvarint length prefix.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ...wire.proto import (
+    ProtoWriter,
+    decode_message,
+    field_bytes,
+    field_int,
+    marshal_delimited,
+    unmarshal_delimited,
+)
+
+MAX_PACKET_MSG_PAYLOAD_SIZE = 1400  # config default
+PING_INTERVAL = 10.0
+PONG_TIMEOUT = 45.0
+FLUSH_THROTTLE = 0.1
+
+
+@dataclass
+class ChannelDescriptor:
+    """conn/connection.go ChannelDescriptor / reactor channel specs."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 1024 * 1024
+
+
+def encode_packet_msg(channel_id: int, eof: bool, data: bytes) -> bytes:
+    m = ProtoWriter()
+    m.write_varint(1, channel_id)
+    m.write_varint(2, 1 if eof else 0)
+    m.write_bytes(3, data)
+    w = ProtoWriter()
+    w.write_message(3, m.bytes(), always=True)
+    return marshal_delimited(w.bytes())
+
+
+def encode_ping() -> bytes:
+    w = ProtoWriter()
+    w.write_message(1, b"", always=True)
+    return marshal_delimited(w.bytes())
+
+
+def encode_pong() -> bytes:
+    w = ProtoWriter()
+    w.write_message(2, b"", always=True)
+    return marshal_delimited(w.bytes())
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: "queue.Queue[bytes]" = queue.Queue(maxsize=desc.send_queue_capacity)
+        self.recving = b""
+        self.sending = b""
+
+    def is_send_pending(self) -> bool:
+        return bool(self.sending) or not self.send_queue.empty()
+
+    def next_packet_chunk(self) -> Optional[tuple]:
+        if not self.sending:
+            try:
+                self.sending = self.send_queue.get_nowait()
+            except queue.Empty:
+                return None
+        chunk = self.sending[:MAX_PACKET_MSG_PAYLOAD_SIZE]
+        self.sending = self.sending[MAX_PACKET_MSG_PAYLOAD_SIZE:]
+        eof = not self.sending
+        return (self.desc.id, eof, chunk)
+
+
+class MConnection:
+    """connection.go:74-520 (thread-per-direction variant)."""
+
+    def __init__(
+        self,
+        conn,  # read(n)/write(b)/close()
+        channel_descs: List[ChannelDescriptor],
+        on_receive: Callable[[int, bytes], None],
+        on_error: Callable[[Exception], None],
+    ):
+        self._conn = conn
+        self._channels: Dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channel_descs
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._send_signal = threading.Event()
+        self._quit = threading.Event()
+        self._last_pong = time.time()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for fn in (self._send_routine, self._recv_routine):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        if self._quit.is_set():
+            return
+        self._quit.set()
+        self._send_signal.set()
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+    def is_running(self) -> bool:
+        return not self._quit.is_set()
+
+    # -- sending --------------------------------------------------------
+
+    def send(self, channel_id: int, msg: bytes, block: bool = True) -> bool:
+        """connection.go Send: enqueue on the channel; False if full."""
+        ch = self._channels.get(channel_id)
+        if ch is None or self._quit.is_set():
+            return False
+        try:
+            ch.send_queue.put(msg, block=block, timeout=10 if block else None)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        return self.send(channel_id, msg, block=False)
+
+    def _send_routine(self) -> None:
+        last_ping = time.time()
+        try:
+            while not self._quit.is_set():
+                self._send_signal.wait(timeout=0.05)
+                self._send_signal.clear()
+                now = time.time()
+                if now - last_ping > PING_INTERVAL:
+                    self._conn.write(encode_ping())
+                    last_ping = now
+                # drain by priority: highest priority channel with pending data
+                wrote = True
+                while wrote and not self._quit.is_set():
+                    wrote = False
+                    pending = [
+                        ch for ch in self._channels.values() if ch.is_send_pending()
+                    ]
+                    if not pending:
+                        break
+                    pending.sort(key=lambda c: -c.desc.priority)
+                    chunk = pending[0].next_packet_chunk()
+                    if chunk is not None:
+                        self._conn.write(encode_packet_msg(*chunk))
+                        wrote = True
+        except (OSError, ConnectionError, ValueError) as e:
+            self._error(e)
+
+    # -- receiving ------------------------------------------------------
+
+    def _recv_routine(self) -> None:
+        buf = b""
+        try:
+            while not self._quit.is_set():
+                chunk = self._conn.read(65536)
+                if not chunk:
+                    raise ConnectionError("connection closed by peer")
+                buf += chunk
+                while True:
+                    try:
+                        msg, consumed = unmarshal_delimited(buf)
+                    except ValueError:
+                        break
+                    buf = buf[consumed:]
+                    self._handle_packet(msg)
+        except (OSError, ConnectionError, ValueError) as e:
+            self._error(e)
+
+    def _handle_packet(self, msg: bytes) -> None:
+        f = decode_message(msg)
+        if 1 in f:  # ping
+            self._conn.write(encode_pong())
+            return
+        if 2 in f:  # pong
+            self._last_pong = time.time()
+            return
+        if 3 in f:
+            pm = decode_message(f[3][-1][1])
+            channel_id = field_int(pm, 1)
+            eof = bool(field_int(pm, 2))
+            data = field_bytes(pm, 3)
+            ch = self._channels.get(channel_id)
+            if ch is None:
+                raise ValueError(f"unknown channel {channel_id}")
+            ch.recving += data
+            if len(ch.recving) > ch.desc.recv_message_capacity:
+                raise ValueError("recv message exceeds capacity")
+            if eof:
+                complete, ch.recving = ch.recving, b""
+                self._on_receive(channel_id, complete)
+            return
+        raise ValueError("unknown packet oneof")
+
+    def _error(self, e: Exception) -> None:
+        if not self._quit.is_set():
+            self.stop()
+            self._on_error(e)

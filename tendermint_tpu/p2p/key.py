@@ -1,0 +1,63 @@
+"""Node identity keys.
+
+Reference parity: types/node_key.go, types/node_id.go — NodeID is the hex
+of the ed25519 address (first 20 bytes of SHA256(pubkey)).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from dataclasses import dataclass
+
+from ..crypto import PrivKey, PubKey, ed25519
+
+
+def node_id_from_pubkey(pub: PubKey) -> str:
+    return pub.address().hex()
+
+
+def validate_node_id(node_id: str) -> None:
+    if len(node_id) != 40:
+        raise ValueError(f"invalid node ID length {len(node_id)}")
+    bytes.fromhex(node_id)  # raises on non-hex
+
+
+@dataclass
+class NodeKey:
+    priv_key: PrivKey
+
+    @property
+    def node_id(self) -> str:
+        return node_id_from_pubkey(self.priv_key.pub_key())
+
+    @property
+    def pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "NodeKey":
+        return cls(priv_key=ed25519.gen_priv_key(seed))
+
+    @classmethod
+    def load_or_generate(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            with open(path) as fh:
+                obj = json.load(fh)
+            return cls(priv_key=ed25519.PrivKey(base64.b64decode(obj["priv_key"]["value"])))
+        nk = cls.generate()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(
+                {
+                    "id": nk.node_id,
+                    "priv_key": {
+                        "type": ed25519.PRIV_KEY_NAME,
+                        "value": base64.b64encode(nk.priv_key.bytes()).decode(),
+                    },
+                },
+                fh,
+                indent=2,
+            )
+        return nk

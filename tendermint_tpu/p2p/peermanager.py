@@ -1,0 +1,198 @@
+"""PeerManager — peer lifecycle: dial, connect, evict, retry, score.
+
+Reference parity: internal/p2p/peermanager.go:27-60 — the state machine
+for candidate/connected/evicting peers, persistent peers with unconditional
+retries, exponential dial backoff, upgrade/eviction when above capacity,
+and a persisted address book.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..db import DB, MemDB
+
+MAX_PEER_SCORE = 100
+PERSISTENT_PEER_SCORE = MAX_PEER_SCORE
+
+
+@dataclass
+class PeerAddress:
+    node_id: str
+    address: str  # "host:port" (or memory node id)
+
+
+@dataclass
+class _PeerInfo:
+    node_id: str
+    addresses: List[str] = field(default_factory=list)
+    persistent: bool = False
+    last_dial_failure: float = 0.0
+    dial_failures: int = 0
+    mutable_score: int = 0
+
+    def score(self) -> int:
+        if self.persistent:
+            return PERSISTENT_PEER_SCORE
+        return max(min(self.mutable_score, MAX_PEER_SCORE - 1), -100)
+
+
+class PeerManager:
+    """peermanager.go:229-1404 (condensed state machine)."""
+
+    def __init__(
+        self,
+        self_id: str,
+        db: Optional[DB] = None,
+        max_connected: int = 16,
+        min_retry_time: float = 0.25,
+        max_retry_time: float = 30.0,
+    ):
+        self._self_id = self_id
+        self._db = db or MemDB()
+        self._max_connected = max_connected
+        self._min_retry = min_retry_time
+        self._max_retry = max_retry_time
+        self._mtx = threading.RLock()
+        self._peers: Dict[str, _PeerInfo] = {}
+        self._connected: Set[str] = set()
+        self._dialing: Set[str] = set()
+        self._evicting: Set[str] = set()
+        self._load()
+
+    # -- address book ----------------------------------------------------
+
+    def add_address(self, addr: PeerAddress, persistent: bool = False) -> bool:
+        """peermanager.go Add: returns True if new."""
+        if addr.node_id == self._self_id:
+            return False
+        with self._mtx:
+            info = self._peers.get(addr.node_id)
+            is_new = info is None
+            if info is None:
+                info = _PeerInfo(node_id=addr.node_id)
+                self._peers[addr.node_id] = info
+            if addr.address and addr.address not in info.addresses:
+                info.addresses.append(addr.address)
+            if persistent:
+                info.persistent = True
+            self._save(info)
+            return is_new
+
+    def addresses(self, node_id: str) -> List[str]:
+        with self._mtx:
+            info = self._peers.get(node_id)
+            return list(info.addresses) if info else []
+
+    def peers(self) -> List[str]:
+        with self._mtx:
+            return list(self._peers)
+
+    def connected_peers(self) -> List[str]:
+        with self._mtx:
+            return list(self._connected)
+
+    def num_connected(self) -> int:
+        with self._mtx:
+            return len(self._connected)
+
+    # -- dialing state machine -------------------------------------------
+
+    def dial_next(self) -> Optional[PeerAddress]:
+        """peermanager.go DialNext: best candidate ready for dialing."""
+        with self._mtx:
+            if len(self._connected) + len(self._dialing) >= self._max_connected:
+                return None
+            now = time.time()
+            candidates = []
+            for info in self._peers.values():
+                if info.node_id in self._connected or info.node_id in self._dialing:
+                    continue
+                if not info.addresses:
+                    continue
+                if info.dial_failures > 0:
+                    backoff = min(
+                        self._min_retry * (2 ** (info.dial_failures - 1)), self._max_retry
+                    )
+                    if not info.persistent and info.dial_failures > 8:
+                        continue  # give up on non-persistent peers
+                    if now - info.last_dial_failure < backoff:
+                        continue
+                candidates.append(info)
+            if not candidates:
+                return None
+            candidates.sort(key=lambda i: -i.score())
+            best = candidates[0]
+            self._dialing.add(best.node_id)
+            return PeerAddress(best.node_id, random.choice(best.addresses))
+
+    def dial_failed(self, node_id: str) -> None:
+        with self._mtx:
+            self._dialing.discard(node_id)
+            info = self._peers.get(node_id)
+            if info:
+                info.dial_failures += 1
+                info.last_dial_failure = time.time()
+
+    def dialed(self, node_id: str) -> bool:
+        """Outbound connect succeeded; False -> reject (e.g. full/dup)."""
+        with self._mtx:
+            self._dialing.discard(node_id)
+            if node_id in self._connected or node_id == self._self_id:
+                return False
+            if len(self._connected) >= self._max_connected:
+                return False
+            info = self._peers.setdefault(node_id, _PeerInfo(node_id=node_id))
+            info.dial_failures = 0
+            self._connected.add(node_id)
+            return True
+
+    def accepted(self, node_id: str) -> bool:
+        """Inbound connect; same admission rules (peermanager.go Accepted)."""
+        with self._mtx:
+            if node_id in self._connected or node_id == self._self_id:
+                return False
+            if len(self._connected) >= self._max_connected:
+                return False
+            self._peers.setdefault(node_id, _PeerInfo(node_id=node_id))
+            self._connected.add(node_id)
+            return True
+
+    def disconnected(self, node_id: str) -> None:
+        with self._mtx:
+            self._connected.discard(node_id)
+            self._evicting.discard(node_id)
+
+    def errored(self, node_id: str, err: Exception) -> None:
+        with self._mtx:
+            info = self._peers.get(node_id)
+            if info:
+                info.mutable_score -= 1
+
+    # -- persistence -----------------------------------------------------
+
+    def _save(self, info: _PeerInfo) -> None:
+        import json
+
+        self._db.set(
+            b"peer:" + info.node_id.encode(),
+            json.dumps(
+                {"addresses": info.addresses, "persistent": info.persistent}
+            ).encode(),
+        )
+
+    def _load(self) -> None:
+        import json
+
+        for k, v in self._db.iterator(b"peer:", b"peer;"):
+            node_id = k[len(b"peer:") :].decode()
+            obj = json.loads(v)
+            self._peers[node_id] = _PeerInfo(
+                node_id=node_id,
+                addresses=obj.get("addresses", []),
+                persistent=obj.get("persistent", False),
+            )

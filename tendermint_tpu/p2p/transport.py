@@ -1,0 +1,259 @@
+"""P2P transports: the Connection/Transport abstraction, TCP+MConn
+implementation, and the in-memory transport for tests.
+
+Reference parity: internal/p2p/transport.go (interfaces),
+transport_mconn.go (TCP + SecretConnection + MConnection),
+transport_memory.go (the "multi-node without a network" seam the
+reference's reactor tests build on, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import PrivKey, PubKey
+from .conn.mconnection import ChannelDescriptor, MConnection
+from .conn.secret_connection import SecretConnection
+from .key import node_id_from_pubkey
+
+
+@dataclass
+class Envelope:
+    """router.go:24-38 — a routed message."""
+
+    from_id: str = ""
+    to_id: str = ""
+    channel_id: int = 0
+    message: bytes = b""
+    broadcast: bool = False
+
+
+class Connection:
+    """transport.go Connection: handshaken, channel-multiplexed link."""
+
+    def __init__(self):
+        self.local_id: str = ""
+        self.remote_id: str = ""
+        self.remote_pubkey: Optional[PubKey] = None
+
+    def send(self, channel_id: int, msg: bytes) -> bool: ...
+
+    def receive(self, timeout: Optional[float] = None) -> Tuple[int, bytes]: ...
+
+    def close(self) -> None: ...
+
+
+class _SockStream:
+    """Adapt a socket to read/write/close."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def read(self, n: int) -> bytes:
+        try:
+            return self._sock.recv(n)
+        except OSError:
+            return b""
+
+    def write(self, b: bytes) -> None:
+        self._sock.sendall(b)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class MConnConnection(Connection):
+    """transport_mconn.go MConnConnection."""
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        local_priv: PrivKey,
+        channel_descs: List[ChannelDescriptor],
+    ):
+        super().__init__()
+        stream = _SockStream(sock)
+        sconn = SecretConnection(stream, local_priv)  # handshake happens here
+        self.remote_pubkey = sconn.remote_pubkey
+        self.remote_id = node_id_from_pubkey(sconn.remote_pubkey)
+        self.local_id = node_id_from_pubkey(local_priv.pub_key())
+        self._recv_q: "queue.Queue[Tuple[int, bytes]]" = queue.Queue(maxsize=1000)
+        self._err: Optional[Exception] = None
+        self._mconn = MConnection(
+            sconn,
+            channel_descs,
+            on_receive=lambda ch, msg: self._recv_q.put((ch, msg)),
+            on_error=self._on_error,
+        )
+        self._mconn.start()
+
+    def _on_error(self, e: Exception) -> None:
+        self._err = e
+        self._recv_q.put((-1, b""))  # wake receivers
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self._mconn.send(channel_id, msg)
+
+    def receive(self, timeout: Optional[float] = None) -> Tuple[int, bytes]:
+        ch, msg = self._recv_q.get(timeout=timeout)
+        if ch == -1:
+            raise ConnectionError(str(self._err) if self._err else "connection closed")
+        return ch, msg
+
+    def close(self) -> None:
+        self._mconn.stop()
+
+
+class MConnTransport:
+    """transport_mconn.go MConnTransport: TCP listener + dialer."""
+
+    def __init__(self, local_priv: PrivKey, channel_descs: List[ChannelDescriptor]):
+        self._priv = local_priv
+        self._descs = channel_descs
+        self._listener: Optional[socket.socket] = None
+        self._accept_q: "queue.Queue[MConnConnection]" = queue.Queue(maxsize=64)
+        self._closed = False
+        self.listen_addr: str = ""
+
+    def listen(self, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host or "127.0.0.1", int(port)))
+        self._listener.listen(32)
+        h, p = self._listener.getsockname()
+        self.listen_addr = f"{h}:{p}"
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handshake_accepted, args=(sock,), daemon=True
+            ).start()
+
+    def _handshake_accepted(self, sock: socket.socket) -> None:
+        try:
+            conn = MConnConnection(sock, self._priv, self._descs)
+            self._accept_q.put(conn)
+        except Exception:  # noqa: BLE001 — failed handshakes are dropped
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def accept(self, timeout: Optional[float] = None) -> MConnConnection:
+        return self._accept_q.get(timeout=timeout)
+
+    def dial(self, addr: str, timeout: float = 5.0) -> MConnConnection:
+        host, _, port = addr.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return MConnConnection(sock, self._priv, self._descs)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._listener is not None:
+            self._listener.close()
+
+
+# ---------------------------------------------------------------------------
+# In-memory transport (transport_memory.go)
+
+
+class _MemoryHub:
+    """A namespace of in-memory endpoints (MemoryNetwork)."""
+
+    def __init__(self):
+        self._endpoints: Dict[str, "MemoryTransport"] = {}
+        self._mtx = threading.Lock()
+
+    def register(self, node_id: str, t: "MemoryTransport") -> None:
+        with self._mtx:
+            self._endpoints[node_id] = t
+
+    def get(self, node_id: str) -> Optional["MemoryTransport"]:
+        with self._mtx:
+            return self._endpoints.get(node_id)
+
+    def remove(self, node_id: str) -> None:
+        with self._mtx:
+            self._endpoints.pop(node_id, None)
+
+
+class MemoryConnection(Connection):
+    def __init__(self, local_id: str, remote_id: str, remote_pubkey, send_q, recv_q):
+        super().__init__()
+        self.local_id = local_id
+        self.remote_id = remote_id
+        self.remote_pubkey = remote_pubkey
+        self._send_q = send_q
+        self._recv_q = recv_q
+        self._closed = threading.Event()
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        if self._closed.is_set():
+            return False
+        try:
+            self._send_q.put((channel_id, msg), timeout=5)
+            return True
+        except queue.Full:
+            return False
+
+    def receive(self, timeout: Optional[float] = None) -> Tuple[int, bytes]:
+        ch, msg = self._recv_q.get(timeout=timeout)
+        if ch == -1:
+            raise ConnectionError("connection closed")
+        return ch, msg
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            try:
+                self._send_q.put_nowait((-1, b""))
+            except queue.Full:
+                pass
+
+
+class MemoryTransport:
+    """transport_memory.go:345LoC — instant, reliable, in-process."""
+
+    def __init__(self, hub: _MemoryHub, node_id: str, pubkey):
+        self._hub = hub
+        self.node_id = node_id
+        self.pubkey = pubkey
+        self._accept_q: "queue.Queue[MemoryConnection]" = queue.Queue(maxsize=64)
+        hub.register(node_id, self)
+
+    def accept(self, timeout: Optional[float] = None) -> MemoryConnection:
+        return self._accept_q.get(timeout=timeout)
+
+    def dial(self, remote_id: str, timeout: float = 5.0) -> MemoryConnection:
+        remote = self._hub.get(remote_id)
+        if remote is None:
+            raise ConnectionError(f"no memory endpoint {remote_id}")
+        a_to_b: queue.Queue = queue.Queue(maxsize=1000)
+        b_to_a: queue.Queue = queue.Queue(maxsize=1000)
+        ours = MemoryConnection(self.node_id, remote_id, remote.pubkey, a_to_b, b_to_a)
+        theirs = MemoryConnection(remote_id, self.node_id, self.pubkey, b_to_a, a_to_b)
+        remote._accept_q.put(theirs)
+        return ours
+
+    def close(self) -> None:
+        self._hub.remove(self.node_id)
+
+
+def new_memory_network() -> _MemoryHub:
+    return _MemoryHub()

@@ -1,0 +1,247 @@
+"""Validator key management — file-based signer with double-sign guard.
+
+Reference parity: privval/file.go — FilePV (key file + last-sign-state
+file), CheckHRS monotonicity (file.go:95-137), same-HRS re-signing only
+for timestamp changes (file.go:280-320). The PrivValidator interface
+matches types/priv_validator.go:28-33.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..crypto import PrivKey, PubKey, ed25519
+from ..types import Timestamp, Vote
+from ..types.block import BlockID
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..wire import canonical as _canon
+from ..wire.proto import decode_message, field_bytes
+
+STEP_NONE = 0
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+
+def vote_to_step(vote_type: int) -> int:
+    if vote_type == PREVOTE_TYPE:
+        return STEP_PREVOTE
+    if vote_type == PRECOMMIT_TYPE:
+        return STEP_PRECOMMIT
+    raise ValueError(f"unknown vote type {vote_type}")
+
+
+class PrivValidator(abc.ABC):
+    """types/priv_validator.go:28-33."""
+
+    @abc.abstractmethod
+    def get_pub_key(self) -> PubKey: ...
+
+    @abc.abstractmethod
+    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+        """Returns the signature; callers attach it to the vote."""
+
+    @abc.abstractmethod
+    def sign_proposal(self, chain_id: str, proposal) -> bytes: ...
+
+
+@dataclass
+class FilePVLastSignState:
+    """privval/file.go:78-93."""
+
+    height: int = 0
+    round: int = 0
+    step: int = 0
+    signature: bytes = b""
+    sign_bytes: bytes = b""
+    file_path: str = ""
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """file.go:95-137: error on regression; True iff exact same HRS
+        with a signature already recorded (possible re-sign)."""
+        if self.height > height:
+            raise ValueError(f"height regression. Got {height}, last height {self.height}")
+        if self.height == height:
+            if self.round > round_:
+                raise ValueError(
+                    f"round regression at height {height}. Got {round_}, last round {self.round}"
+                )
+            if self.round == round_:
+                if self.step > step:
+                    raise ValueError(
+                        f"step regression at height {height} round {round_}. "
+                        f"Got {step}, last step {self.step}"
+                    )
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise ValueError("no sign_bytes found")
+                    if not self.signature:
+                        raise RuntimeError("signature is nil but sign_bytes is not")
+                    return True
+        return False
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        obj = {
+            "height": str(self.height),
+            "round": self.round,
+            "step": self.step,
+            "signature": base64.b64encode(self.signature).decode() if self.signature else None,
+            "signbytes": self.sign_bytes.hex().upper() if self.sign_bytes else None,
+        }
+        _atomic_write(self.file_path, json.dumps(obj, indent=2))
+
+    @classmethod
+    def load(cls, path: str) -> "FilePVLastSignState":
+        with open(path) as fh:
+            obj = json.load(fh)
+        return cls(
+            height=int(obj.get("height", "0")),
+            round=int(obj.get("round", 0)),
+            step=int(obj.get("step", 0)),
+            signature=base64.b64decode(obj["signature"]) if obj.get("signature") else b"",
+            sign_bytes=bytes.fromhex(obj["signbytes"]) if obj.get("signbytes") else b"",
+            file_path=path,
+        )
+
+
+class FilePV(PrivValidator):
+    """privval/file.go:139-420."""
+
+    def __init__(self, priv_key: PrivKey, key_file_path: str = "", state_file_path: str = ""):
+        self._priv_key = priv_key
+        self._key_file = key_file_path
+        self.last_sign_state = FilePVLastSignState(file_path=state_file_path)
+
+    # -- generation / persistence ---------------------------------------
+
+    @classmethod
+    def generate(cls, key_file: str = "", state_file: str = "", seed: Optional[bytes] = None) -> "FilePV":
+        return cls(ed25519.gen_priv_key(seed), key_file, state_file)
+
+    @classmethod
+    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+        if os.path.exists(key_file):
+            return cls.load(key_file, state_file)
+        pv = cls.generate(key_file, state_file)
+        pv.save()
+        return pv
+
+    @classmethod
+    def load(cls, key_file: str, state_file: str) -> "FilePV":
+        with open(key_file) as fh:
+            obj = json.load(fh)
+        priv = ed25519.PrivKey(base64.b64decode(obj["priv_key"]["value"]))
+        pv = cls(priv, key_file, state_file)
+        if os.path.exists(state_file):
+            pv.last_sign_state = FilePVLastSignState.load(state_file)
+        return pv
+
+    def save(self) -> None:
+        pk = self._priv_key.pub_key()
+        obj = {
+            "address": pk.address().hex().upper(),
+            "pub_key": {"type": ed25519.PUB_KEY_NAME, "value": base64.b64encode(pk.bytes()).decode()},
+            "priv_key": {
+                "type": ed25519.PRIV_KEY_NAME,
+                "value": base64.b64encode(self._priv_key.bytes()).decode(),
+            },
+        }
+        if self._key_file:
+            _atomic_write(self._key_file, json.dumps(obj, indent=2))
+        self.last_sign_state.save()
+
+    # -- PrivValidator ----------------------------------------------------
+
+    def get_pub_key(self) -> PubKey:
+        return self._priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+        """file.go:280-330 signVote with double-sign protection."""
+        height, round_, step = vote.height, vote.round, vote_to_step(vote.type)
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                return lss.signature
+            # only the timestamp may differ (file.go:307-316)
+            if _only_timestamp_differs_vote(lss.sign_bytes, sign_bytes):
+                return lss.signature
+            raise ValueError("conflicting data")
+        sig = self._priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        return sig
+
+    def sign_proposal(self, chain_id: str, proposal) -> bytes:
+        """file.go:335-370."""
+        height, round_ = proposal.height, proposal.round
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, STEP_PROPOSE)
+        sign_bytes = proposal.sign_bytes(chain_id)
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                return lss.signature
+            if _only_timestamp_differs_proposal(lss.sign_bytes, sign_bytes):
+                return lss.signature
+            raise ValueError("conflicting data")
+        sig = self._priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, STEP_PROPOSE, sign_bytes, sig)
+        return sig
+
+    def _save_signed(self, height: int, round_: int, step: int, sign_bytes: bytes, sig: bytes) -> None:
+        lss = self.last_sign_state
+        lss.height, lss.round, lss.step = height, round_, step
+        lss.signature = sig
+        lss.sign_bytes = sign_bytes
+        lss.save()
+
+
+def _strip_timestamp(sign_bytes: bytes, ts_field: int) -> bytes:
+    """Remove the timestamp field from delimited canonical sign bytes so
+    two encodings can be compared modulo timestamp (file.go
+    checkVotesOnlyDifferByTimestamp)."""
+    from ..wire.proto import ProtoWriter, encode_uvarint, unmarshal_delimited
+
+    msg, _ = unmarshal_delimited(sign_bytes)
+    fields = decode_message(msg)
+    w = ProtoWriter()
+    for num in sorted(fields):
+        if num == ts_field:
+            continue
+        for wt, val in fields[num]:
+            if wt == 0:
+                w.write_varint(num, val, always=True)
+            elif wt == 1:
+                w.write_sfixed64(num, val, always=True)
+            elif wt == 2:
+                w.write_bytes(num, val, always=True)
+    return w.bytes()
+
+
+def _only_timestamp_differs_vote(a: bytes, b: bytes) -> bool:
+    return _strip_timestamp(a, 5) == _strip_timestamp(b, 5)
+
+
+def _only_timestamp_differs_proposal(a: bytes, b: bytes) -> bool:
+    return _strip_timestamp(a, 6) == _strip_timestamp(b, 6)
+
+
+def _atomic_write(path: str, content: str) -> None:
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(content)
+        os.replace(tmp, path)
+    except BaseException:
+        os.unlink(tmp)
+        raise

@@ -1,0 +1,36 @@
+"""Event types and keys.
+
+Reference parity: types/events.go — event string constants and the
+composite keys (tm.event, tx.hash, tx.height) the indexer and RPC
+subscriptions filter on.
+"""
+
+from __future__ import annotations
+
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+BLOCK_HEIGHT_KEY = "block.height"
+
+EventNewBlock = "NewBlock"
+EventNewBlockHeader = "NewBlockHeader"
+EventNewEvidence = "NewEvidence"
+EventTx = "Tx"
+EventValidatorSetUpdates = "ValidatorSetUpdates"
+
+# consensus round events
+EventNewRound = "NewRound"
+EventNewRoundStep = "NewRoundStep"
+EventCompleteProposal = "CompleteProposal"
+EventPolka = "Polka"
+EventRelock = "Relock"
+EventLock = "Lock"
+EventUnlock = "Unlock"
+EventVote = "Vote"
+EventValidBlock = "ValidBlock"
+EventTimeoutPropose = "TimeoutPropose"
+EventTimeoutWait = "TimeoutWait"
+
+
+def query_for_event(event_type: str) -> str:
+    return f"{EVENT_TYPE_KEY}='{event_type}'"

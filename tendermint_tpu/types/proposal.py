@@ -1,0 +1,83 @@
+"""Proposal — a proposed block at (height, round) signed by the proposer.
+
+Reference parity: types/proposal.go. Sign bytes are the delimited proto
+CanonicalProposal (proposal.go ProposalSignBytes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..wire import canonical as _canon
+from ..wire.canonical import Timestamp
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+from .block import BlockID, MAX_SIGNATURE_SIZE
+
+
+@dataclass(frozen=True)
+class Proposal:
+    """types/proposal.go:21-34."""
+
+    type: int = _canon.SIGNED_MSG_TYPE_PROPOSAL
+    height: int = 0
+    round: int = 0
+    pol_round: int = -1  # -1 if no proof-of-lock
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return _canon.canonical_proposal_sign_bytes(
+            chain_id=chain_id,
+            height=self.height,
+            round_=self.round,
+            pol_round=self.pol_round,
+            block_id=self.block_id.canonical(),
+            timestamp=self.timestamp,
+        )
+
+    def validate_basic(self) -> None:
+        """proposal.go:65-96."""
+        if self.type != _canon.SIGNED_MSG_TYPE_PROPOSAL:
+            raise ValueError("invalid Type")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.pol_round < -1:
+            raise ValueError("negative POLRound (exception: -1)")
+        self.block_id.validate_basic()
+        if not self.block_id.is_complete():
+            raise ValueError(f"expected a complete, non-empty BlockID, got: {self.block_id}")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
+            raise ValueError("signature is too big")
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, self.type)
+        w.write_varint(2, self.height)
+        w.write_varint(3, self.round)
+        w.write_varint(4, self.pol_round)
+        w.write_message(5, self.block_id.encode(), always=True)
+        w.write_message(6, _canon.encode_timestamp(self.timestamp), always=True)
+        w.write_bytes(7, self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proposal":
+        f = decode_message(data)
+        ts = decode_message(field_bytes(f, 6))
+        return cls(
+            type=field_int(f, 1),
+            height=to_signed64(field_int(f, 2)),
+            round=to_signed32(field_int(f, 3)),
+            pol_round=to_signed32(field_int(f, 4)),
+            block_id=BlockID.decode(field_bytes(f, 5)),
+            timestamp=Timestamp(
+                seconds=to_signed64(field_int(ts, 1)), nanos=to_signed32(field_int(ts, 2))
+            ),
+            signature=field_bytes(f, 7),
+        )
