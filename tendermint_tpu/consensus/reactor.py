@@ -1,0 +1,128 @@
+"""Consensus reactor — gossips consensus messages over p2p channels.
+
+Reference parity: internal/consensus/reactor.go — channels State (0x20),
+Data (0x21), Vote (0x22), VoteSetBits (0x23) with the reference's channel
+priorities (reactor.go:32-73). The node's own proposals/parts/votes flow
+out through the ConsensusState broadcast seam; incoming envelopes are
+decoded and fed into the state machine's queues.
+
+Round-1 scope note: this reactor broadcasts and relays within a connected
+mesh (NewRoundStep/HasVote bookkeeping and the per-peer catchup gossip
+routines of reactor.go:503-797 land with blocksync integration).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..p2p.conn.mconnection import ChannelDescriptor
+from ..p2p.router import Router
+from ..types.part_set import Part
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int
+from .state import BlockPartMessage, ConsensusState, ProposalMessage, VoteMessage
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+STATE_DESC = ChannelDescriptor(id=STATE_CHANNEL, priority=8, send_queue_capacity=64)
+DATA_DESC = ChannelDescriptor(id=DATA_CHANNEL, priority=12, send_queue_capacity=64)
+VOTE_DESC = ChannelDescriptor(id=VOTE_CHANNEL, priority=10, send_queue_capacity=64)
+VOTE_SET_BITS_DESC = ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=5)
+
+ALL_DESCS = [STATE_DESC, DATA_DESC, VOTE_DESC, VOTE_SET_BITS_DESC]
+
+
+def _encode_block_part(height: int, round_: int, part: Part) -> bytes:
+    w = ProtoWriter()
+    w.write_varint(1, height)
+    w.write_varint(2, round_)
+    w.write_message(3, part.encode(), always=True)
+    return w.bytes()
+
+
+class ConsensusReactor:
+    """reactor.go:100-300 (mesh-broadcast variant)."""
+
+    def __init__(self, cs: ConsensusState, router: Router):
+        self._cs = cs
+        self._router = router
+        self._data_ch = router.open_channel(DATA_DESC)
+        self._vote_ch = router.open_channel(VOTE_DESC)
+        self._state_ch = router.open_channel(STATE_DESC)
+        self._vsb_ch = router.open_channel(VOTE_SET_BITS_DESC)
+        self._stopped = threading.Event()
+        self._threads = []
+        cs.broadcast_hooks.append(self._broadcast_own)
+
+    def start(self) -> None:
+        for ch, handler in (
+            (self._data_ch, self._handle_data),
+            (self._vote_ch, self._handle_vote),
+            (self._state_ch, self._handle_state),
+        ):
+            t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- outbound -------------------------------------------------------
+
+    def _broadcast_own(self, msg) -> None:
+        if isinstance(msg, ProposalMessage):
+            w = ProtoWriter()
+            w.write_message(1, msg.proposal.encode(), always=True)
+            self._data_ch.broadcast(w.bytes())
+        elif isinstance(msg, BlockPartMessage):
+            w = ProtoWriter()
+            w.write_message(2, _encode_block_part(msg.height, msg.round, msg.part), always=True)
+            self._data_ch.broadcast(w.bytes())
+        elif isinstance(msg, VoteMessage):
+            w = ProtoWriter()
+            w.write_message(1, msg.vote.encode(), always=True)
+            self._vote_ch.broadcast(w.bytes())
+
+    # -- inbound --------------------------------------------------------
+
+    def _process(self, ch, handler) -> None:
+        import queue as _q
+
+        while not self._stopped.is_set():
+            try:
+                env = ch.receive(timeout=0.5)
+            except _q.Empty:
+                continue
+            try:
+                handler(env)
+            except (ValueError, KeyError):
+                continue  # bad peer message: ignore (router would ban)
+
+    def _handle_data(self, env) -> None:
+        """reactor.go:1261+ channel processors (Data)."""
+        f = decode_message(env.message)
+        if 1 in f:
+            proposal = Proposal.decode(field_bytes(f, 1))
+            self._cs.set_proposal(proposal, peer_id=env.from_id)
+        elif 2 in f:
+            bp = decode_message(field_bytes(f, 2))
+            self._cs.add_block_part(
+                field_int(bp, 1),
+                field_int(bp, 2),
+                Part.decode(field_bytes(bp, 3)),
+                peer_id=env.from_id,
+            )
+
+    def _handle_vote(self, env) -> None:
+        f = decode_message(env.message)
+        if 1 in f:
+            vote = Vote.decode(field_bytes(f, 1))
+            self._cs.add_vote_msg(vote, peer_id=env.from_id)
+
+    def _handle_state(self, env) -> None:
+        pass  # NewRoundStep/HasVote bookkeeping (catchup gossip, later round)

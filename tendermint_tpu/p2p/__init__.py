@@ -1,0 +1,14 @@
+"""tendermint_tpu.p2p — the distributed communication backend (reference
+internal/p2p/, L9): router + peer manager + MConn transport over
+SecretConnection, plus the in-memory transport for tests."""
+
+from .conn.mconnection import ChannelDescriptor, MConnection  # noqa: F401
+from .conn.secret_connection import SecretConnection  # noqa: F401
+from .key import NodeKey, node_id_from_pubkey, validate_node_id  # noqa: F401
+from .peermanager import PeerAddress, PeerManager  # noqa: F401
+from .router import Channel, Envelope, PeerUpdate, Router  # noqa: F401
+from .transport import (  # noqa: F401
+    MConnTransport,
+    MemoryTransport,
+    new_memory_network,
+)

@@ -1,0 +1,1 @@
+"""Connection-level primitives: SecretConnection + MConnection."""

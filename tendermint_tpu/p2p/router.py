@@ -1,0 +1,212 @@
+"""Router — connects reactors to peers via typed channels of envelopes.
+
+Reference parity: internal/p2p/router.go:241 — reactors call open_channel
+and get a (send, receive) pair of queues; the router runs accept/dial
+loops against the transport, a receive thread per peer fanning envelopes
+into channels, and a send path routing envelopes (including broadcast) to
+per-peer connections.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .conn.mconnection import ChannelDescriptor
+from .peermanager import PeerAddress, PeerManager
+from .transport import Connection, Envelope
+
+
+@dataclass
+class PeerUpdate:
+    """peerupdates.go: status change delivered to reactors."""
+
+    node_id: str
+    status: str  # "up" | "down"
+
+
+class Channel:
+    """router.go:58-67 — a reactor's handle on one wire channel."""
+
+    def __init__(self, router: "Router", desc: ChannelDescriptor):
+        self._router = router
+        self.desc = desc
+        self.in_q: "queue.Queue[Envelope]" = queue.Queue(maxsize=1000)
+
+    def send(self, to_id: str, message: bytes) -> bool:
+        return self._router._route_out(
+            Envelope(to_id=to_id, channel_id=self.desc.id, message=message)
+        )
+
+    def broadcast(self, message: bytes) -> None:
+        self._router._route_out(
+            Envelope(channel_id=self.desc.id, message=message, broadcast=True)
+        )
+
+    def receive(self, timeout: Optional[float] = None) -> Envelope:
+        return self.in_q.get(timeout=timeout)
+
+    def try_receive(self) -> Optional[Envelope]:
+        try:
+            return self.in_q.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class Router:
+    """router.go:241-1000."""
+
+    def __init__(self, transport, peer_manager: PeerManager, node_id: str):
+        self._transport = transport
+        self._pm = peer_manager
+        self.node_id = node_id
+        self._channels: Dict[int, Channel] = {}
+        self._conns: Dict[str, Connection] = {}
+        self._mtx = threading.RLock()
+        self._stopped = threading.Event()
+        self._peer_update_subs: List["queue.Queue[PeerUpdate]"] = []
+        self._threads: List[threading.Thread] = []
+
+    # -- channels -------------------------------------------------------
+
+    def open_channel(self, desc: ChannelDescriptor) -> Channel:
+        with self._mtx:
+            if desc.id in self._channels:
+                raise ValueError(f"channel {desc.id} already open")
+            ch = Channel(self, desc)
+            self._channels[desc.id] = ch
+            return ch
+
+    def subscribe_peer_updates(self) -> "queue.Queue[PeerUpdate]":
+        q: "queue.Queue[PeerUpdate]" = queue.Queue(maxsize=100)
+        with self._mtx:
+            self._peer_update_subs.append(q)
+            # deliver current peers as "up" so late subscribers converge
+            for nid in self._conns:
+                q.put(PeerUpdate(nid, "up"))
+        return q
+
+    def _notify_peer_update(self, upd: PeerUpdate) -> None:
+        with self._mtx:
+            subs = list(self._peer_update_subs)
+        for q in subs:
+            try:
+                q.put_nowait(upd)
+            except queue.Full:
+                pass
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        for fn in (self._accept_loop, self._dial_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._mtx:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+        if hasattr(self._transport, "close"):
+            self._transport.close()
+
+    # -- connection admission -------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn = self._transport.accept(timeout=0.5)
+            except queue.Empty:
+                continue
+            except (OSError, ConnectionError):
+                return
+            self._admit(conn, inbound=True)
+
+    def _dial_loop(self) -> None:
+        while not self._stopped.is_set():
+            addr = self._pm.dial_next()
+            if addr is None:
+                time.sleep(0.1)
+                continue
+            try:
+                conn = self._transport.dial(addr.address)
+            except (OSError, ConnectionError, queue.Empty) as e:
+                self._pm.dial_failed(addr.node_id)
+                continue
+            if conn.remote_id != addr.node_id and addr.node_id:
+                # peer identity mismatch (router.go handshake check)
+                conn.close()
+                self._pm.dial_failed(addr.node_id)
+                continue
+            self._admit(conn, inbound=False)
+
+    def _admit(self, conn: Connection, inbound: bool) -> None:
+        nid = conn.remote_id
+        ok = self._pm.accepted(nid) if inbound else self._pm.dialed(nid)
+        if not ok:
+            conn.close()
+            return
+        with self._mtx:
+            self._conns[nid] = conn
+        t = threading.Thread(target=self._receive_peer, args=(conn,), daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._notify_peer_update(PeerUpdate(nid, "up"))
+
+    def _drop_peer(self, conn: Connection, err: Optional[Exception]) -> None:
+        nid = conn.remote_id
+        with self._mtx:
+            if self._conns.get(nid) is conn:
+                del self._conns[nid]
+        conn.close()
+        self._pm.disconnected(nid)
+        if err is not None:
+            self._pm.errored(nid, err)
+        self._notify_peer_update(PeerUpdate(nid, "down"))
+
+    # -- routing --------------------------------------------------------
+
+    def _receive_peer(self, conn: Connection) -> None:
+        """router.go:905-989 receivePeer."""
+        while not self._stopped.is_set():
+            try:
+                channel_id, msg = conn.receive(timeout=1.0)
+            except queue.Empty:
+                continue
+            except (ConnectionError, OSError, ValueError) as e:
+                self._drop_peer(conn, e)
+                return
+            ch = self._channels.get(channel_id)
+            if ch is None:
+                continue
+            env = Envelope(from_id=conn.remote_id, channel_id=channel_id, message=msg)
+            try:
+                ch.in_q.put(env, timeout=5)
+            except queue.Full:
+                pass  # drop under backpressure (router.go pqueue drop)
+
+    def _route_out(self, env: Envelope) -> bool:
+        with self._mtx:
+            if env.broadcast:
+                conns = list(self._conns.values())
+            else:
+                c = self._conns.get(env.to_id)
+                conns = [c] if c is not None else []
+        ok = bool(conns)
+        for c in conns:
+            try:
+                if not c.send(env.channel_id, env.message):
+                    ok = False
+            except (ConnectionError, OSError):
+                self._drop_peer(c, None)
+                ok = False
+        return ok
+
+    def connected(self) -> List[str]:
+        with self._mtx:
+            return list(self._conns)
