@@ -1,0 +1,281 @@
+"""Block sync — catch up by downloading committed blocks from peers.
+
+Reference parity: internal/blocksync/ — BlockPool (pool.go:69) with
+parallel per-height requesters and peer timeout/removal, and the reactor
+verify/apply loop (reactor.go:500-560): each block is verified with
+VerifyCommitLight against the NEXT block's LastCommit (the device batch
+path — BASELINE's pipelined sync workload), then applied via the
+BlockExecutor. Hands off to consensus when caught up (IsCaughtUp,
+pool.go:188).
+
+Wire (channel 0x40, proto oneof):
+  1 block_request{1 height} | 2 no_block_response{1 height}
+  | 3 block_response{1 block} | 4 status_request{} | 5 status_response{1 height, 2 base}
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..p2p.conn.mconnection import ChannelDescriptor
+from ..p2p.router import Router
+from ..types import BlockID
+from ..types.block import Block
+from ..types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+from ..types.validation import verify_commit_light
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+
+BLOCKSYNC_CHANNEL = 0x40
+BLOCKSYNC_DESC = ChannelDescriptor(
+    id=BLOCKSYNC_CHANNEL, priority=5, recv_message_capacity=12 * 1024 * 1024
+)
+
+_REQUEST_WINDOW = 16  # concurrent height requesters (pool.go requesters)
+_PEER_TIMEOUT = 15.0
+
+
+def _enc(kind: int, fields: Optional[dict] = None) -> bytes:
+    inner = ProtoWriter()
+    for num, val in sorted((fields or {}).items()):
+        if isinstance(val, bytes):
+            inner.write_bytes(num, val)
+        else:
+            inner.write_varint(num, val)
+    w = ProtoWriter()
+    w.write_message(kind, inner.bytes(), always=True)
+    return w.bytes()
+
+
+@dataclass
+class _PendingRequest:
+    height: int
+    peer_id: str = ""
+    block: Optional[Block] = None
+    requested_at: float = 0.0
+
+
+class BlockPool:
+    """pool.go:69-250 (condensed): window of in-flight height requests."""
+
+    def __init__(self, start_height: int):
+        self.height = start_height  # next height to apply
+        self._requests: Dict[int, _PendingRequest] = {}
+        self._peers: Dict[str, tuple] = {}  # peer_id -> (base, height)
+        self._mtx = threading.RLock()
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        with self._mtx:
+            self._peers[peer_id] = (base, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._peers.pop(peer_id, None)
+            for req in self._requests.values():
+                if req.peer_id == peer_id and req.block is None:
+                    req.peer_id = ""  # re-requestable
+
+    def max_peer_height(self) -> int:
+        with self._mtx:
+            return max((h for _, h in self._peers.values()), default=0)
+
+    def is_caught_up(self) -> bool:
+        """pool.go:188: caught up when at/above the best peer height."""
+        with self._mtx:
+            if not self._peers:
+                return False
+            return self.height >= self.max_peer_height()
+
+    def next_requests(self) -> Dict[int, str]:
+        """Heights to (re)request and the peer to ask."""
+        out: Dict[int, str] = {}
+        now = time.time()
+        with self._mtx:
+            peers = [
+                (pid, base, h) for pid, (base, h) in self._peers.items()
+            ]
+            if not peers:
+                return out
+            for height in range(self.height, self.height + _REQUEST_WINDOW):
+                if height > self.max_peer_height():
+                    break
+                req = self._requests.get(height)
+                if req is not None and req.block is not None:
+                    continue
+                if req is not None and req.peer_id and now - req.requested_at < _PEER_TIMEOUT:
+                    continue
+                candidates = [pid for pid, base, h in peers if base <= height <= h]
+                if not candidates:
+                    continue
+                pid = candidates[height % len(candidates)]
+                self._requests[height] = _PendingRequest(
+                    height=height, peer_id=pid, requested_at=now
+                )
+                out[height] = pid
+        return out
+
+    def add_block(self, peer_id: str, block: Block) -> bool:
+        with self._mtx:
+            h = block.header.height
+            req = self._requests.get(h)
+            if req is None:
+                if h < self.height:
+                    return False
+                self._requests[h] = _PendingRequest(height=h, peer_id=peer_id, block=block)
+                return True
+            if req.block is not None:
+                return False
+            req.peer_id = peer_id
+            req.block = block
+            return True
+
+    def peek_two_blocks(self):
+        """reactor.go:500-520: need (first, second) to verify first."""
+        with self._mtx:
+            first = self._requests.get(self.height)
+            second = self._requests.get(self.height + 1)
+            return (
+                first.block if first else None,
+                second.block if second else None,
+            )
+
+    def pop_first(self) -> None:
+        with self._mtx:
+            self._requests.pop(self.height, None)
+            self.height += 1
+
+    def redo_request(self, height: int) -> None:
+        """Invalid block: drop both candidate blocks and re-request."""
+        with self._mtx:
+            for h in (height, height + 1):
+                req = self._requests.pop(h, None)
+                if req is not None and req.peer_id:
+                    self._peers.pop(req.peer_id, None)
+
+
+class BlockSyncReactor:
+    """reactor.go (blocksync): serve + consume block requests."""
+
+    def __init__(
+        self,
+        router: Router,
+        block_store,
+        block_exec,
+        initial_state,
+        on_caught_up=None,
+    ):
+        self._router = router
+        self._ch = router.open_channel(BLOCKSYNC_DESC)
+        self._store = block_store
+        self._block_exec = block_exec
+        self._state = initial_state
+        self._on_caught_up = on_caught_up
+        self._pool = BlockPool(initial_state.last_block_height + 1)
+        self._stopped = threading.Event()
+        self._threads = []
+
+    @property
+    def pool(self) -> BlockPool:
+        return self._pool
+
+    @property
+    def state(self):
+        return self._state
+
+    def start(self) -> None:
+        for fn in (self._recv_loop, self._request_loop, self._apply_loop, self._status_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- loops ----------------------------------------------------------
+
+    def _status_loop(self) -> None:
+        while not self._stopped.is_set():
+            self._ch.broadcast(_enc(4))  # status_request
+            self._ch.broadcast(
+                _enc(5, {1: self._store.height(), 2: self._store.base()})
+            )
+            time.sleep(1.0)
+
+    def _request_loop(self) -> None:
+        while not self._stopped.is_set():
+            for height, peer_id in self._pool.next_requests().items():
+                self._ch.send(peer_id, _enc(1, {1: height}))
+            time.sleep(0.05)
+
+    def _recv_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                env = self._ch.receive(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(env)
+            except (ValueError, KeyError):
+                continue
+
+    def _handle(self, env) -> None:
+        f = decode_message(env.message)
+        if 1 in f:  # block_request
+            req = decode_message(field_bytes(f, 1))
+            height = to_signed64(field_int(req, 1))
+            block = self._store.load_block(height)
+            if block is not None:
+                self._ch.send(env.from_id, _enc(3, {1: block.encode()}))
+            else:
+                self._ch.send(env.from_id, _enc(2, {1: height}))
+        elif 3 in f:  # block_response
+            resp = decode_message(field_bytes(f, 3))
+            block = Block.decode(field_bytes(resp, 1))
+            self._pool.add_block(env.from_id, block)
+        elif 4 in f:  # status_request
+            self._ch.send(
+                env.from_id, _enc(5, {1: self._store.height(), 2: self._store.base()})
+            )
+        elif 5 in f:  # status_response
+            resp = decode_message(field_bytes(f, 5))
+            self._pool.set_peer_range(
+                env.from_id,
+                to_signed64(field_int(resp, 2)),
+                to_signed64(field_int(resp, 1)),
+            )
+
+    def _apply_loop(self) -> None:
+        """reactor.go:500-560: verify first with second's LastCommit, apply."""
+        caught_up_reported = False
+        while not self._stopped.is_set():
+            first, second = self._pool.peek_two_blocks()
+            if first is None or second is None:
+                if (
+                    not caught_up_reported
+                    and self._pool.is_caught_up()
+                    and self._on_caught_up is not None
+                ):
+                    caught_up_reported = True
+                    self._on_caught_up(self._state)
+                time.sleep(0.05)
+                continue
+            parts = PartSet.from_data(first.encode(), BLOCK_PART_SIZE_BYTES)
+            first_id = BlockID(hash=first.hash(), part_set_header=parts.header())
+            try:
+                # VerifyCommitLight on the device engine (reactor.go:533)
+                verify_commit_light(
+                    self._state.chain_id,
+                    self._state.validators,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )
+            except (ValueError, RuntimeError):
+                self._pool.redo_request(first.header.height)
+                continue
+            self._store.save_block(first, parts, second.last_commit)
+            self._state = self._block_exec.apply_block(self._state, first_id, first)
+            self._pool.pop_first()

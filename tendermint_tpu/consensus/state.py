@@ -190,7 +190,7 @@ class ConsensusState(BaseService):
     # the receive routine (state.go:757-850)
 
     def _receive_routine(self) -> None:
-        while True:
+        while not self._quit.is_set():
             # internal queue drains first (own proposal/votes)
             try:
                 msg, peer_id = self._internal_queue.get_nowait()

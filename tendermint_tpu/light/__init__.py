@@ -1,0 +1,17 @@
+"""tendermint_tpu.light — light client (reference light/, L12)."""
+
+from .verifier import (  # noqa: F401
+    DEFAULT_TRUST_LEVEL,
+    ErrInvalidHeader,
+    ErrNotEnoughTrust,
+    ErrOldHeaderExpired,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from .client import Client, LightBlock, TrustOptions  # noqa: F401
+from .provider import Provider, NodeBackedProvider  # noqa: F401
+from .store import LightStore  # noqa: F401

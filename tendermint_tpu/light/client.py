@@ -1,0 +1,275 @@
+"""Light client — stateful header verification with bisection.
+
+Reference parity: light/client.go — trust options bootstrap (:370),
+VerifyLightBlockAtHeight (:406), sequential verification (:546), skipping
+verification with the 9/16 bisection pivot (:639, :44-45), backwards
+verification (:878), primary/witness management (:935-1035), and the
+divergence detector (detector.go) comparing the primary's headers against
+witnesses.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..types import Fraction
+from ..wire.canonical import Timestamp
+from . import verifier
+from .provider import ErrLightBlockNotFound, LightBlock, Provider
+from .store import LightStore
+
+DEFAULT_PRUNING_SIZE = 1000
+DEFAULT_MAX_CLOCK_DRIFT = 10.0  # seconds (light/client.go:56)
+
+# bisection pivot: 9/16 (light/client.go:44-45)
+_BISECT_NUM = 9
+_BISECT_DEN = 16
+
+
+@dataclass
+class TrustOptions:
+    """light/client.go TrustOptions: period + (height, hash) root of trust."""
+
+    period: float  # seconds
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period <= 0:
+            raise ValueError("trusting period must be greater than zero")
+        if self.height <= 0:
+            raise ValueError("trust option height must be greater than zero")
+        if len(self.hash) != 32:
+            raise ValueError(f"expected hash size to be 32 bytes, got {len(self.hash)}")
+
+
+class ErrLightClientAttack(RuntimeError):
+    """detector.go: divergence between primary and witness."""
+
+
+def _now_ts() -> Timestamp:
+    t = _time.time()
+    return Timestamp(seconds=int(t), nanos=int((t % 1) * 1e9))
+
+
+class Client:
+    """light/client.go:130-1100."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: List[Provider],
+        store: LightStore,
+        trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
+        max_clock_drift: float = DEFAULT_MAX_CLOCK_DRIFT,
+        sequential: bool = False,
+        pruning_size: int = DEFAULT_PRUNING_SIZE,
+    ):
+        trust_options.validate()
+        verifier.validate_trust_level(trust_level)
+        self._chain_id = chain_id
+        self._trusting_period = trust_options.period
+        self._trust_level = trust_level
+        self._max_clock_drift = max_clock_drift
+        self._primary = primary
+        self._witnesses = list(witnesses)
+        self._store = store
+        self._sequential = sequential
+        self._pruning_size = pruning_size
+        self._initialize(trust_options)
+
+    # -- bootstrap (client.go:370-404) -----------------------------------
+
+    def _initialize(self, opts: TrustOptions) -> None:
+        existing = self._store.latest_light_block()
+        if existing is not None:
+            return  # already bootstrapped (checkTrustedHeaderUsingOptions simplified)
+        lb = self._primary.light_block(opts.height)
+        if lb.hash() != opts.hash:
+            raise ValueError(
+                f"expected header's hash {opts.hash.hex()}, but got {lb.hash().hex()}"
+            )
+        lb.signed_header.validate_basic(self._chain_id)
+        if lb.signed_header.header.validators_hash != lb.validators.hash():
+            raise ValueError("expected header's validators to match those supplied")
+        # verify the commit against its own validator set (1/1 trust at root)
+        from ..types.validation import verify_commit_light
+
+        verify_commit_light(
+            self._chain_id,
+            lb.validators,
+            lb.signed_header.commit.block_id,
+            lb.height,
+            lb.signed_header.commit,
+        )
+        self._store.save_light_block(lb)
+
+    # -- public API -------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> Optional[LightBlock]:
+        if height == 0:
+            return self._store.latest_light_block()
+        return self._store.light_block(height)
+
+    def update(self, now: Optional[Timestamp] = None) -> Optional[LightBlock]:
+        """client.go Update: verify the primary's latest header."""
+        latest = self._primary.light_block(0)
+        trusted = self._store.latest_light_block()
+        if trusted is not None and latest.height <= trusted.height:
+            return None
+        return self.verify_light_block_at_height(latest.height, now)
+
+    def verify_light_block_at_height(
+        self, height: int, now: Optional[Timestamp] = None
+    ) -> LightBlock:
+        """client.go:406-487."""
+        if height <= 0:
+            raise ValueError("height must be positive")
+        now = now or _now_ts()
+        existing = self._store.light_block(height)
+        if existing is not None:
+            return existing
+        latest_trusted = self._store.latest_light_block()
+        if latest_trusted is None:
+            raise RuntimeError("no trusted state — client not initialized")
+        if height < latest_trusted.height:
+            return self._backwards(latest_trusted, height, now)
+        new_block = self._light_block_from_primary(height)
+        self._verify_light_block(new_block, now)
+        return new_block
+
+    # -- verification strategies -----------------------------------------
+
+    def _verify_light_block(self, new_block: LightBlock, now: Timestamp) -> None:
+        closest = self._store.light_block_before(new_block.height) or \
+            self._store.latest_light_block()
+        if self._sequential:
+            self._verify_sequential(closest, new_block, now)
+        else:
+            self._verify_skipping_against_witnesses(closest, new_block, now)
+        self._store.save_light_block(new_block)
+        self._store.prune(self._pruning_size)
+
+    def _verify_sequential(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> None:
+        """client.go:546-637: fetch and verify every intermediate header."""
+        current = trusted
+        for h in range(trusted.height + 1, new_block.height + 1):
+            if h == new_block.height:
+                interim = new_block
+            else:
+                interim = self._light_block_from_primary(h)
+            verifier.verify_adjacent(
+                current.signed_header,
+                interim.signed_header,
+                interim.validators,
+                self._trusting_period,
+                now,
+                self._max_clock_drift,
+            )
+            self._store.save_light_block(interim)
+            current = interim
+
+    def _verify_skipping(
+        self, source: Provider, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> List[LightBlock]:
+        """client.go:639-720 verifySkipping: bisection with 9/16 pivot."""
+        blocks_to_verify = [new_block]
+        depth = 0
+        verified = [trusted]
+        current = trusted
+        while True:
+            target = blocks_to_verify[depth]
+            try:
+                verifier.verify(
+                    current.signed_header,
+                    current.validators,
+                    target.signed_header,
+                    target.validators,
+                    self._trusting_period,
+                    now,
+                    self._max_clock_drift,
+                    self._trust_level,
+                )
+                verified.append(target)
+                if depth == 0:
+                    return verified
+                current = target
+                depth -= 1
+            except verifier.ErrNotEnoughTrust:
+                # bisect: pivot at 9/16 between current and target
+                pivot = (
+                    current.height
+                    + (target.height - current.height) * _BISECT_NUM // _BISECT_DEN
+                )
+                if pivot <= current.height:
+                    pivot = current.height + 1
+                if pivot >= target.height:
+                    raise
+                interim = self._light_block_from(source, pivot)
+                blocks_to_verify.append(interim)
+                depth += 1
+
+    def _verify_skipping_against_witnesses(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> None:
+        """client.go:722-780 + detector.go: verify against the primary,
+        then cross-check the final header with every witness."""
+        self._verify_skipping(self._primary, trusted, new_block, now)
+        self._detect_divergence(new_block, now)
+
+    def _detect_divergence(self, new_block: LightBlock, now: Timestamp) -> None:
+        """detector.go:40-120 (comparison phase; evidence construction is
+        handled by the evidence pool when running in a full node)."""
+        for i, witness in enumerate(self._witnesses):
+            try:
+                w_block = witness.light_block(new_block.height)
+            except (ErrLightBlockNotFound, ConnectionError):
+                continue  # witness doesn't have it (yet) — tolerated
+            if w_block.hash() != new_block.hash():
+                raise ErrLightClientAttack(
+                    f"witness #{i} has a different header "
+                    f"{w_block.hash().hex()} != {new_block.hash().hex()} "
+                    f"at height {new_block.height}"
+                )
+
+    def _backwards(
+        self, trusted: LightBlock, height: int, now: Timestamp
+    ) -> LightBlock:
+        """client.go:878-933: hash-linked walk to an older header."""
+        current = trusted
+        for h in range(trusted.height - 1, height - 1, -1):
+            interim = self._light_block_from_primary(h)
+            verifier.verify_backwards(interim.signed_header, current.signed_header)
+            self._store.save_light_block(interim)
+            current = interim
+        return current
+
+    # -- provider plumbing (client.go:935-1035) ---------------------------
+
+    def _light_block_from_primary(self, height: int) -> LightBlock:
+        try:
+            lb = self._primary.light_block(height)
+        except (ErrLightBlockNotFound, ConnectionError):
+            # primary failed: promote a witness (client.go findNewPrimary)
+            for i, w in enumerate(self._witnesses):
+                try:
+                    lb = w.light_block(height)
+                except (ErrLightBlockNotFound, ConnectionError):
+                    continue
+                self._witnesses.pop(i)
+                self._witnesses.append(self._primary)
+                self._primary = w
+                return lb
+            raise
+        return lb
+
+    def _light_block_from(self, source: Provider, height: int) -> LightBlock:
+        if source is self._primary:
+            return self._light_block_from_primary(height)
+        return source.light_block(height)

@@ -1,0 +1,70 @@
+"""Light-block providers.
+
+Reference parity: light/provider/ — the Provider interface (LightBlock,
+ReportEvidence) and concrete implementations. The reference's primary
+implementation fetches over RPC (provider/http); here the equivalent
+node-backed provider reads another node's stores directly (the in-process
+analog used by tests and statesync) and the RPC-backed provider lands with
+the RPC client.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional
+
+from ..types import Commit, Header, SignedHeader, ValidatorSet
+
+
+@dataclass
+class LightBlock:
+    """types.LightBlock: SignedHeader + its validator set."""
+
+    signed_header: SignedHeader
+    validators: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.header.height
+
+    def hash(self) -> bytes:
+        return self.signed_header.header.hash()
+
+
+class ErrLightBlockNotFound(KeyError):
+    pass
+
+
+class Provider(abc.ABC):
+    @abc.abstractmethod
+    def light_block(self, height: int) -> LightBlock:
+        """Fetch the light block at height (0 = latest). Raises
+        ErrLightBlockNotFound when unavailable."""
+
+    def report_evidence(self, ev) -> None:  # noqa: B027 — optional hook
+        pass
+
+
+class NodeBackedProvider(Provider):
+    """Reads block store + state store of a (local) node."""
+
+    def __init__(self, block_store, state_store):
+        self._bs = block_store
+        self._ss = state_store
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self._bs.height()
+        meta = self._bs.load_block_meta(height)
+        commit = self._bs.load_block_commit(height)
+        if meta is None or commit is None:
+            raise ErrLightBlockNotFound(height)
+        try:
+            vals = self._ss.load_validators(height)
+        except KeyError as e:
+            raise ErrLightBlockNotFound(height) from e
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validators=vals,
+        )

@@ -1,0 +1,199 @@
+"""Light-client header verification — hot path #2.
+
+Reference parity: light/verifier.go — VerifyAdjacent (:103),
+VerifyNonAdjacent (:33), Verify (:152), VerifyBackwards (:201). The
+commit checks route through types.validation (VerifyCommitLight /
+VerifyCommitLightTrusting), i.e. through the device batch engine — the
+pipelined 1k-header sync workload of BASELINE config #5.
+"""
+
+from __future__ import annotations
+
+from ..types import Fraction, SignedHeader, ValidatorSet
+from ..types.validation import (
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from ..wire.canonical import Timestamp
+
+# light.DefaultTrustLevel (light/verifier.go:20)
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class ErrNotEnoughTrust(ValueError):
+    """verifier.go ErrNewValSetCantBeTrusted."""
+
+
+class ErrInvalidHeader(ValueError):
+    pass
+
+
+class ErrOldHeaderExpired(ValueError):
+    pass
+
+
+def _ts_add(ts: Timestamp, seconds: float) -> Timestamp:
+    total_ns = ts.seconds * 10**9 + ts.nanos + int(seconds * 1e9)
+    return Timestamp(seconds=total_ns // 10**9, nanos=total_ns % 10**9)
+
+
+def _ts_before(a: Timestamp, b: Timestamp) -> bool:
+    return (a.seconds, a.nanos) < (b.seconds, b.nanos)
+
+
+def header_expired(h: SignedHeader, trusting_period: float, now: Timestamp) -> bool:
+    """verifier.go HeaderExpired: expiration = header.Time + trustingPeriod."""
+    expiration = _ts_add(h.header.time, trusting_period)
+    return not _ts_before(now, expiration)
+
+
+def validate_trust_level(lvl: Fraction) -> None:
+    """verifier.go ValidateTrustLevel: must be in [1/3, 1]."""
+    if (
+        lvl.numerator * 3 < lvl.denominator
+        or lvl.numerator > lvl.denominator
+        or lvl.denominator == 0
+    ):
+        raise ValueError(f"trustLevel must be within [1/3, 1], given {lvl}")
+
+
+def verify_new_header_and_vals(
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusted_header: SignedHeader,
+    now: Timestamp,
+    max_clock_drift: float,
+) -> None:
+    """verifier.go:236-283 verifyNewHeaderAndVals."""
+    chain_id = trusted_header.header.chain_id
+    try:
+        untrusted_header.validate_basic(chain_id)
+    except ValueError as e:
+        raise ErrInvalidHeader(f"untrustedHeader.ValidateBasic failed: {e}") from e
+    if untrusted_header.header.height <= trusted_header.header.height:
+        raise ErrInvalidHeader(
+            f"expected new header height {untrusted_header.header.height} to be greater "
+            f"than one of old header {trusted_header.header.height}"
+        )
+    if not _ts_before(trusted_header.header.time, untrusted_header.header.time):
+        raise ErrInvalidHeader("expected new header time to be after old header time")
+    if not _ts_before(untrusted_header.header.time, _ts_add(now, max_clock_drift)):
+        raise ErrInvalidHeader(
+            "new header has a time from the future (max clock drift exceeded)"
+        )
+    if untrusted_header.header.validators_hash != untrusted_vals.hash():
+        raise ErrInvalidHeader(
+            f"expected new header validators ({untrusted_header.header.validators_hash.hex()}) "
+            f"to match those supplied ({untrusted_vals.hash().hex()})"
+        )
+
+
+def verify_adjacent(
+    trusted_header: SignedHeader,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+) -> None:
+    """verifier.go:103-150."""
+    if untrusted_header.header.height != trusted_header.header.height + 1:
+        raise ValueError("headers must be adjacent in height")
+    if header_expired(trusted_header, trusting_period, now):
+        raise ErrOldHeaderExpired(f"old header has expired at {now}")
+    verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
+    )
+    # valhash continuity (verifier.go:134-142)
+    if untrusted_header.header.validators_hash != trusted_header.header.next_validators_hash:
+        raise ErrInvalidHeader(
+            f"expected old header next validators ({trusted_header.header.next_validators_hash.hex()}) "
+            f"to match those from new header ({untrusted_header.header.validators_hash.hex()})"
+        )
+    # full commit verification on the device engine (verifier.go:143-148)
+    verify_commit_light(
+        trusted_header.header.chain_id,
+        untrusted_vals,
+        untrusted_header.commit.block_id,
+        untrusted_header.header.height,
+        untrusted_header.commit,
+    )
+
+
+def verify_non_adjacent(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+    trust_level: Fraction,
+) -> None:
+    """verifier.go:33-101."""
+    if untrusted_header.header.height == trusted_header.header.height + 1:
+        raise ValueError("headers must be non adjacent in height")
+    validate_trust_level(trust_level)
+    if header_expired(trusted_header, trusting_period, now):
+        raise ErrOldHeaderExpired(f"old header has expired at {now}")
+    verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
+    )
+    # trust-level check against the OLD validator set (verifier.go:67-80)
+    try:
+        verify_commit_light_trusting(
+            trusted_header.header.chain_id,
+            trusted_vals,
+            untrusted_header.commit,
+            trust_level,
+        )
+    except ValueError as e:
+        raise ErrNotEnoughTrust(str(e)) from e
+    # then the full +2/3 of the NEW set (verifier.go:82-88)
+    verify_commit_light(
+        trusted_header.header.chain_id,
+        untrusted_vals,
+        untrusted_header.commit.block_id,
+        untrusted_header.header.height,
+        untrusted_header.commit,
+    )
+
+
+def verify(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+    trust_level: Fraction,
+) -> None:
+    """verifier.go:152-176 Verify: dispatch adjacent/non-adjacent."""
+    if untrusted_header.header.height != trusted_header.header.height + 1:
+        verify_non_adjacent(
+            trusted_header, trusted_vals, untrusted_header, untrusted_vals,
+            trusting_period, now, max_clock_drift, trust_level,
+        )
+    else:
+        verify_adjacent(
+            trusted_header, untrusted_header, untrusted_vals,
+            trusting_period, now, max_clock_drift,
+        )
+
+
+def verify_backwards(untrusted_header, trusted_header) -> None:
+    """verifier.go:201-234: walk back by hash linkage."""
+    if header_expired(trusted_header, 0, trusted_header.header.time):
+        pass  # expiry handled by caller in backwards mode
+    if untrusted_header.header.chain_id != trusted_header.header.chain_id:
+        raise ErrInvalidHeader("header belongs to another chain")
+    if not _ts_before(untrusted_header.header.time, trusted_header.header.time):
+        raise ErrInvalidHeader(
+            "expected older header time to be before newer header time"
+        )
+    if trusted_header.header.last_block_id.hash != untrusted_header.header.hash():
+        raise ErrInvalidHeader(
+            f"older header hash {untrusted_header.header.hash().hex()} does not match "
+            f"trusted header's last block {trusted_header.header.last_block_id.hash.hex()}"
+        )
