@@ -1,0 +1,178 @@
+"""Handshake / replay — reconcile app state with chain state on boot.
+
+Reference parity: internal/consensus/replay.go — Handshaker (:203):
+ABCI Info → compare heights → InitChain for fresh chains → ReplayBlocks
+(:283) re-applies blocks from the store to the app until both are at the
+store height. The WAL catchup half lives in ConsensusState._replay_wal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..crypto.encoding import pubkey_from_proto
+from ..state import State
+from ..state.execution import (
+    BlockExecutor,
+    exec_block_on_proxy_app,
+    update_state,
+)
+from ..state.store import StateStore
+from ..types import BlockID, Validator, ValidatorSet
+from ..types.genesis import GenesisDoc
+from ..types.params import ConsensusParams
+from ..wire import canonical as _canon
+
+
+class HandshakeError(RuntimeError):
+    pass
+
+
+class Handshaker:
+    """replay.go:203-281."""
+
+    def __init__(
+        self,
+        state_store: StateStore,
+        state: State,
+        block_store,
+        gen_doc: GenesisDoc,
+        event_bus=None,
+    ):
+        self._state_store = state_store
+        self._state = state
+        self._block_store = block_store
+        self._gen_doc = gen_doc
+        self._event_bus = event_bus
+        self.n_blocks_replayed = 0
+
+    def handshake(self, proxy_app) -> State:
+        """replay.go:240-281: returns the post-handshake state."""
+        res = proxy_app.info(abci.RequestInfo(version="tendermint-tpu"))
+        app_height = res.last_block_height
+        app_hash = res.last_block_app_hash
+        if app_height < 0:
+            raise HandshakeError(f"got a negative last block height ({app_height})")
+        state = self.replay_blocks(self._state, proxy_app, app_height, app_hash)
+        return state
+
+    def replay_blocks(
+        self, state: State, proxy_app, app_height: int, app_hash: bytes
+    ) -> State:
+        """replay.go:283-430 (the height-case analysis)."""
+        store_height = self._block_store.height()
+        state_height = state.last_block_height
+
+        # 1. fresh chain: InitChain
+        if app_height == 0 and state_height == 0:
+            validators = [
+                abci.ValidatorUpdate(
+                    pub_key=_pubkey_proto(v.pub_key), power=v.voting_power
+                )
+                for v in (state.validators.validators if state.validators else [])
+            ]
+            params = state.consensus_params
+            req = abci.RequestInitChain(
+                time=self._gen_doc.genesis_time,
+                chain_id=self._gen_doc.chain_id,
+                consensus_params=params.encode(),
+                validators=validators,
+                app_state_bytes=(
+                    __import__("json").dumps(self._gen_doc.app_state).encode()
+                    if self._gen_doc.app_state is not None
+                    else b""
+                ),
+                initial_height=self._gen_doc.initial_height,
+            )
+            ic = proxy_app.init_chain(req)
+            # apply InitChain response (replay.go:300-340)
+            if state_height == 0:
+                app_hash = ic.app_hash or app_hash
+                if ic.validators:
+                    vals = [
+                        Validator.new(pubkey_from_proto(v.pub_key), v.power)
+                        for v in ic.validators
+                    ]
+                    state = replace_state_validators(state, ValidatorSet.new(vals))
+                elif not self._gen_doc.validators:
+                    raise HandshakeError(
+                        "validator set is nil in genesis and still empty after InitChain"
+                    )
+                if ic.consensus_params is not None:
+                    state = replace(
+                        state,
+                        consensus_params=ConsensusParams.decode(ic.consensus_params),
+                    )
+                state = replace(state, app_hash=app_hash)
+                self._state_store.save(state)
+
+        if store_height == 0:
+            return state
+
+        # sanity (replay.go:341-360)
+        if store_height < app_height:
+            raise HandshakeError(
+                f"app block height ({app_height}) is higher than store ({store_height})"
+            )
+        if store_height < state_height:
+            raise HandshakeError(
+                f"state height ({state_height}) is higher than store ({store_height})"
+            )
+
+        if store_height == state_height:
+            # tendermint is in sync; maybe replay a few blocks to the app
+            return self._replay_to_app(state, proxy_app, app_height, store_height)
+
+        if store_height == state_height + 1:
+            # saved the block but crashed before applying it
+            state = self._apply_stored_block(state, proxy_app, store_height, app_height)
+            return state
+
+        raise HandshakeError(
+            f"uncovered case: store {store_height}, state {state_height}, app {app_height}"
+        )
+
+    def _replay_to_app(
+        self, state: State, proxy_app, app_height: int, store_height: int
+    ) -> State:
+        """Replay finalized blocks the app hasn't seen (replay.go:430-500)."""
+        for height in range(app_height + 1, store_height + 1):
+            block = self._block_store.load_block(height)
+            if block is None:
+                raise HandshakeError(f"missing block at height {height} for replay")
+            exec_block_on_proxy_app(
+                proxy_app, block, self._state_store, state.initial_height
+            )
+            proxy_app.commit()
+            self.n_blocks_replayed += 1
+        return state
+
+    def _apply_stored_block(
+        self, state: State, proxy_app, store_height: int, app_height: int
+    ) -> State:
+        """store is one ahead of state: re-apply via a full BlockExecutor."""
+        # first catch the app up to state height
+        state = self._replay_to_app(state, proxy_app, app_height, state.last_block_height)
+        block = self._block_store.load_block(store_height)
+        meta = self._block_store.load_block_meta(store_height)
+        ex = BlockExecutor(self._state_store, proxy_app, block_store=self._block_store)
+        state = ex.apply_block(state, meta.block_id, block)
+        self.n_blocks_replayed += 1
+        return state
+
+
+def replace_state_validators(state: State, vals: ValidatorSet) -> State:
+    return replace(
+        state,
+        validators=vals,
+        next_validators=vals.copy_increment_proposer_priority(1),
+        last_validators=ValidatorSet(),
+    )
+
+
+def _pubkey_proto(pk) -> bytes:
+    from ..crypto.encoding import pubkey_to_proto
+
+    return pubkey_to_proto(pk)

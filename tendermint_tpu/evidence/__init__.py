@@ -1,0 +1,206 @@
+"""Evidence pool — gathers, verifies, stores and gossips misbehavior proofs.
+
+Reference parity: internal/evidence/ — Pool (pool.go:91-287): pending DB
+with expiry pruning, committed markers, ABCI conversion at block
+proposal; verify.go: DuplicateVoteEvidence (:202) checks both votes
+against the historical validator set; LightClientAttackEvidence (:159)
+uses VerifyCommitLightTrusting (the device batch path).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..db import DB, MemDB
+from ..types import Timestamp
+from ..types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+    decode_evidence,
+    encode_evidence,
+)
+from ..types.validation import verify_commit_light_trusting, Fraction
+
+_PREFIX_PENDING = b"\x00"
+_PREFIX_COMMITTED = b"\x01"
+
+
+def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
+    return prefix + struct.pack(">q", height) + ev_hash
+
+
+class EvidenceError(ValueError):
+    pass
+
+
+class Pool:
+    """internal/evidence/pool.go:91-400."""
+
+    def __init__(self, db: Optional[DB] = None, state_store=None, block_store=None):
+        self._db = db or MemDB()
+        self._state_store = state_store
+        self._block_store = block_store
+        self._mtx = threading.RLock()
+        self._state = None  # latest State; set via update()
+        self._broadcast_hooks: List = []  # evidence reactor attaches here
+
+    def set_state(self, state) -> None:
+        with self._mtx:
+            self._state = state
+
+    # -- adding ----------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """pool.go:137-180 AddEvidence."""
+        with self._mtx:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return
+            if self._state is not None:
+                self.verify(ev)
+            self._db.set(
+                _key(_PREFIX_PENDING, ev.height(), ev.hash()), encode_evidence(ev)
+            )
+        for hook in self._broadcast_hooks:
+            try:
+                hook(ev)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def on_broadcast(self, hook) -> None:
+        self._broadcast_hooks.append(hook)
+
+    def _is_pending(self, ev) -> bool:
+        return self._db.has(_key(_PREFIX_PENDING, ev.height(), ev.hash()))
+
+    def _is_committed(self, ev) -> bool:
+        return self._db.has(_key(_PREFIX_COMMITTED, ev.height(), ev.hash()))
+
+    # -- verification (verify.go) ----------------------------------------
+
+    def verify(self, ev) -> None:
+        """verify.go:24-100 verify: age window + type-specific checks."""
+        state = self._state
+        if state is None:
+            raise EvidenceError("evidence pool has no state")
+        height = state.last_block_height
+        ev_params = state.consensus_params.evidence
+        age_num_blocks = height - ev.height()
+        if age_num_blocks > ev_params.max_age_num_blocks:
+            raise EvidenceError(
+                f"evidence from height {ev.height()} is too old; "
+                f"min height is {height - ev_params.max_age_num_blocks}"
+            )
+        if isinstance(ev, DuplicateVoteEvidence):
+            self._verify_duplicate_vote(ev, state)
+        elif isinstance(ev, LightClientAttackEvidence):
+            self._verify_light_client_attack(ev, state)
+        else:
+            raise EvidenceError(f"unrecognized evidence type {type(ev)}")
+
+    def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence, state) -> None:
+        """verify.go:202-280 VerifyDuplicateVote."""
+        a, b = ev.vote_a, ev.vote_b
+        if a.height != b.height or a.round != b.round or a.type != b.type:
+            raise EvidenceError("votes are for different height/round/type")
+        if a.block_id == b.block_id:
+            raise EvidenceError("block IDs are the same — not a duplicate vote")
+        if a.validator_address != b.validator_address:
+            raise EvidenceError(
+                f"validator addresses do not match: "
+                f"{a.validator_address.hex()} vs {b.validator_address.hex()}"
+            )
+        if self._state_store is not None:
+            try:
+                val_set = self._state_store.load_validators(a.height)
+            except KeyError as e:
+                raise EvidenceError(str(e)) from e
+            _, val = val_set.get_by_address(a.validator_address)
+            if val is None:
+                raise EvidenceError(
+                    f"address {a.validator_address.hex()} was not a validator at height {a.height}"
+                )
+            if ev.validator_power != val.voting_power:
+                raise EvidenceError("validator power mismatch")
+            if ev.total_voting_power != val_set.total_voting_power():
+                raise EvidenceError("total voting power mismatch")
+            chain_id = self._state.chain_id
+            a.verify(chain_id, val.pub_key)
+            b.verify(chain_id, val.pub_key)
+
+    def _verify_light_client_attack(self, ev: LightClientAttackEvidence, state) -> None:
+        """verify.go:159-200: common validators must satisfy 1/3 trust on
+        the conflicting commit (device batch path)."""
+        if self._state_store is None:
+            return
+        try:
+            common_vals = self._state_store.load_validators(ev.common_height)
+        except KeyError as e:
+            raise EvidenceError(str(e)) from e
+        commit = ev.conflicting_block.commit()
+        verify_commit_light_trusting(
+            self._state.chain_id, common_vals, commit, Fraction(1, 3)
+        )
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceError("total voting power mismatch")
+
+    # -- for block production (pool.go PendingEvidence) -------------------
+
+    def pending_evidence(self, max_bytes: int) -> List:
+        out, _ = self._pending(max_bytes)
+        return out
+
+    def pending_evidence_bytes(self, max_bytes: int) -> List[bytes]:
+        _, raws = self._pending(max_bytes)
+        return raws
+
+    def _pending(self, max_bytes: int) -> Tuple[List, List[bytes]]:
+        evs, raws, total = [], [], 0
+        for _, raw in self._db.iterator(_PREFIX_PENDING, _PREFIX_COMMITTED):
+            if max_bytes >= 0 and total + len(raw) > max_bytes:
+                break
+            total += len(raw)
+            evs.append(decode_evidence(raw))
+            raws.append(raw)
+        return evs, raws
+
+    # -- post-commit (pool.go Update:220-287) -----------------------------
+
+    def update(self, state, block_evidence: List[bytes]) -> None:
+        with self._mtx:
+            self._state = state
+            for raw in block_evidence:
+                ev = decode_evidence(raw)
+                self._db.set(
+                    _key(_PREFIX_COMMITTED, ev.height(), ev.hash()), b"\x01"
+                )
+                self._db.delete(_key(_PREFIX_PENDING, ev.height(), ev.hash()))
+            self._prune_expired(state)
+
+    def check_evidence(self, state, block_evidence: List[bytes]) -> None:
+        """pool.go CheckEvidence: verify all evidence in a proposed block."""
+        with self._mtx:
+            prev = self._state
+            self._state = state
+            try:
+                seen = set()
+                for raw in block_evidence:
+                    ev = decode_evidence(raw)
+                    h = ev.hash()
+                    if h in seen:
+                        raise EvidenceError("duplicate evidence in block")
+                    seen.add(h)
+                    if not self._is_committed(ev):
+                        self.verify(ev)
+            finally:
+                self._state = prev if prev is not None else state
+
+    def _prune_expired(self, state) -> None:
+        params = state.consensus_params.evidence
+        min_height = state.last_block_height - params.max_age_num_blocks
+        for k, _ in list(self._db.iterator(_PREFIX_PENDING, _PREFIX_COMMITTED)):
+            height = struct.unpack(">q", k[1:9])[0]
+            if height < min_height:
+                self._db.delete(k)

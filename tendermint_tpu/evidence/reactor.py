@@ -1,0 +1,75 @@
+"""Evidence reactor — gossips evidence to peers.
+
+Reference parity: internal/evidence/reactor.go — channel 0x38,
+EvidenceList message; on receive, evidence is verified by the pool and
+relayed if fresh.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Set
+
+from ..p2p.conn.mconnection import ChannelDescriptor
+from ..p2p.router import Router
+from ..types.evidence import decode_evidence, encode_evidence
+from ..wire.proto import ProtoWriter, decode_message
+from . import EvidenceError, Pool
+
+EVIDENCE_CHANNEL = 0x38
+EVIDENCE_DESC = ChannelDescriptor(
+    id=EVIDENCE_CHANNEL, priority=6, recv_message_capacity=1024 * 1024
+)
+
+
+def encode_evidence_list(evs) -> bytes:
+    w = ProtoWriter()
+    for ev in evs:
+        w.write_message(1, encode_evidence(ev), always=True)
+    return w.bytes()
+
+
+def decode_evidence_list(data: bytes):
+    f = decode_message(data)
+    return [decode_evidence(raw) for _, raw in f.get(1, [])]
+
+
+class EvidenceReactor:
+    def __init__(self, pool: Pool, router: Router):
+        self._pool = pool
+        self._router = router
+        self._ch = router.open_channel(EVIDENCE_DESC)
+        self._stopped = threading.Event()
+        self._seen: Set[bytes] = set()
+        pool.on_broadcast(self._broadcast_evidence)
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._recv_loop, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _broadcast_evidence(self, ev) -> None:
+        self._ch.broadcast(encode_evidence_list([ev]))
+
+    def _recv_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                env = self._ch.receive(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                evs = decode_evidence_list(env.message)
+            except (ValueError, KeyError):
+                continue
+            for ev in evs:
+                h = ev.hash()
+                if h in self._seen:
+                    continue
+                self._seen.add(h)
+                try:
+                    self._pool.add_evidence(ev)
+                except (EvidenceError, ValueError):
+                    continue

@@ -1,0 +1,84 @@
+"""Mempool reactor — tx gossip.
+
+Reference parity: internal/mempool/reactor.go — channel 0x30, Txs message
+(batched), per-peer dedup via tx-seen tracking (internal/mempool/ids.go +
+the clist walk). Here: broadcast on local CheckTx success, relay
+first-seen txs from peers.
+
+Wire: Txs{1 txs(repeated bytes)}.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional, Set
+
+from ..p2p.conn.mconnection import ChannelDescriptor
+from ..p2p.router import Router
+from ..types.tx import tx_key
+from ..wire.proto import ProtoWriter, decode_message
+from . import DuplicateTxError, MempoolFullError, TxMempool
+
+MEMPOOL_CHANNEL = 0x30
+MEMPOOL_DESC = ChannelDescriptor(
+    id=MEMPOOL_CHANNEL, priority=5, recv_message_capacity=1024 * 1024
+)
+
+
+def encode_txs(txs) -> bytes:
+    w = ProtoWriter()
+    for tx in txs:
+        w.write_bytes(1, tx, always=True)
+    return w.bytes()
+
+
+def decode_txs(data: bytes):
+    f = decode_message(data)
+    return [raw for _, raw in f.get(1, [])]
+
+
+class MempoolReactor:
+    def __init__(self, mempool: TxMempool, router: Router, broadcast: bool = True):
+        self._mempool = mempool
+        self._router = router
+        self._broadcast = broadcast
+        self._ch = router.open_channel(MEMPOOL_DESC)
+        self._stopped = threading.Event()
+        self._seen_from_peers: Set[bytes] = set()
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._recv_loop, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- local entry: checked tx broadcast -------------------------------
+
+    def check_tx_and_broadcast(self, tx: bytes):
+        res = self._mempool.check_tx(tx)
+        if res.is_ok() and self._broadcast:
+            self._ch.broadcast(encode_txs([tx]))
+        return res
+
+    # -- peer gossip ------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                env = self._ch.receive(timeout=0.5)
+            except queue.Empty:
+                continue
+            for tx in decode_txs(env.message):
+                k = tx_key(tx)
+                if k in self._seen_from_peers:
+                    continue
+                self._seen_from_peers.add(k)
+                try:
+                    res = self._mempool.check_tx(tx, sender=env.from_id)
+                except (DuplicateTxError, MempoolFullError, ValueError):
+                    continue
+                if res.is_ok() and self._broadcast:
+                    # relay to the rest of the mesh (reactor.go broadcast walk)
+                    self._ch.broadcast(encode_txs([tx]))

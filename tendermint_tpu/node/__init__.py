@@ -1,0 +1,245 @@
+"""Node composition — wires every subsystem into a running node.
+
+Reference parity: node/node.go:122 makeNode + node/setup.go factories:
+DBs → stores → ABCI proxy (4 logical connections) → handshake/replay →
+mempool/evidence → consensus (+WAL, privval) → p2p router + reactors →
+RPC. Startup-mode selection (statesync → blocksync → consensus,
+node.go:217-247) is driven by config + peer state.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..abci import LocalClient, SocketClient
+from ..abci.application import Application
+from ..blocksync import BLOCKSYNC_DESC, BlockSyncReactor
+from ..config import Config, MODE_SEED, MODE_VALIDATOR
+from ..consensus import WAL, ConsensusState
+from ..consensus.reactor import ALL_DESCS as CONSENSUS_DESCS, ConsensusReactor
+from ..consensus.replay import Handshaker
+from ..db import MemDB, backend as db_backend
+from ..eventbus import EventBus
+from ..evidence import Pool as EvidencePool
+from ..evidence.reactor import EVIDENCE_DESC, EvidenceReactor
+from ..mempool import TxMempool
+from ..mempool.reactor import MEMPOOL_DESC, MempoolReactor
+from ..p2p import MConnTransport, MemoryTransport, NodeKey, PeerManager, Router
+from ..privval import FilePV
+from ..state import make_genesis_state
+from ..state.execution import BlockExecutor
+from ..state.store import StateStore
+from ..store import BlockStore
+from ..types.genesis import GenesisDoc
+
+ALL_CHANNEL_DESCS = CONSENSUS_DESCS + [BLOCKSYNC_DESC, MEMPOOL_DESC, EVIDENCE_DESC]
+
+
+@dataclass
+class Node:
+    """A fully wired node (node.go nodeImpl)."""
+
+    config: Config
+    genesis: GenesisDoc
+    node_key: NodeKey
+    event_bus: EventBus
+    state_store: StateStore
+    block_store: BlockStore
+    mempool: TxMempool
+    evidence_pool: EvidencePool
+    block_exec: BlockExecutor
+    consensus: ConsensusState
+    router: Optional[Router] = None
+    consensus_reactor: Optional[ConsensusReactor] = None
+    mempool_reactor: Optional[MempoolReactor] = None
+    evidence_reactor: Optional[EvidenceReactor] = None
+    blocksync_reactor: Optional[BlockSyncReactor] = None
+    rpc_server: object = None
+    proxy_app: object = None
+    _started: bool = False
+
+    def start(self) -> None:
+        """OnStart (node.go:490-560)."""
+        if self.router is not None:
+            self.router.start()
+        for r in (self.mempool_reactor, self.evidence_reactor, self.consensus_reactor):
+            if r is not None:
+                r.start()
+        self.consensus.start()
+        if self.rpc_server is not None:
+            self.rpc_server.start()
+        self._started = True
+
+    def stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.consensus.stop()
+        for r in (self.consensus_reactor, self.mempool_reactor, self.evidence_reactor, self.blocksync_reactor):
+            if r is not None:
+                r.stop()
+        if self.router is not None:
+            self.router.stop()
+
+    @property
+    def node_id(self) -> str:
+        return self.node_key.node_id
+
+    def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
+        self.consensus.wait_for_height(height, timeout)
+
+
+def make_node(
+    config: Config,
+    app: Optional[Application] = None,
+    genesis: Optional[GenesisDoc] = None,
+    priv_validator: Optional[FilePV] = None,
+    node_key: Optional[NodeKey] = None,
+    transport=None,
+    with_rpc: bool = False,
+) -> Node:
+    """node.go:122 makeNode. `app` in-process means the "local" ABCI client
+    (abci/client/local_client.go); otherwise config.proxy_app is dialed."""
+    home = config.base.home
+    if home:
+        config.ensure_dirs()
+
+    # genesis
+    if genesis is None:
+        genesis = GenesisDoc.from_file(config.base.genesis_path())
+    genesis.validate_and_complete()
+
+    # node key
+    if node_key is None:
+        if home:
+            node_key = NodeKey.load_or_generate(config.base.node_key_path())
+        else:
+            node_key = NodeKey.generate()
+
+    # DBs + stores (node.go initDBs)
+    def _db(name: str):
+        if config.base.db_backend in ("memdb", "mem") or not home:
+            return MemDB()
+        return db_backend(config.base.db_backend, config.base.db_path(name))
+
+    block_store = BlockStore(_db("blockstore"))
+    state_store = StateStore(_db("state"))
+
+    # state bootstrap
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(genesis)
+        state_store.save(state)
+
+    # ABCI clients (proxy.AppConns: one logical conn per use here)
+    if app is not None:
+        consensus_conn = LocalClient(app)
+        mempool_conn = LocalClient(app)
+        query_conn = LocalClient(app)
+    else:
+        consensus_conn = SocketClient(config.base.proxy_app)
+        mempool_conn = SocketClient(config.base.proxy_app)
+        query_conn = SocketClient(config.base.proxy_app)
+
+    event_bus = EventBus()
+
+    # handshake / replay (node.go:227)
+    handshaker = Handshaker(state_store, state, block_store, genesis, event_bus)
+    state = handshaker.handshake(consensus_conn)
+
+    # mempool + evidence
+    mempool = TxMempool(mempool_conn, config.mempool, height=state.last_block_height)
+    evidence_pool = EvidencePool(
+        MemDB() if not home else _db("evidence"),
+        state_store=state_store,
+        block_store=block_store,
+    )
+    evidence_pool.set_state(state)
+
+    block_exec = BlockExecutor(
+        state_store,
+        consensus_conn,
+        mempool=mempool,
+        evpool=evidence_pool,
+        block_store=block_store,
+        event_bus=event_bus,
+    )
+
+    # privval
+    if priv_validator is None and config.base.mode == MODE_VALIDATOR and home:
+        priv_validator = FilePV.load_or_generate(
+            config.priv_validator.key_path(home),
+            config.priv_validator.state_path(home),
+        )
+
+    wal = None
+    if home:
+        wal = WAL(config.consensus.wal_path(home))
+
+    consensus = ConsensusState(
+        config.consensus,
+        state,
+        block_exec,
+        block_store,
+        mempool=mempool,
+        evpool=evidence_pool,
+        event_bus=event_bus,
+        wal=wal,
+        priv_validator=priv_validator,
+    )
+
+    # p2p (node.go createTransport/createPeerManager/createRouter)
+    router = None
+    consensus_reactor = None
+    mempool_reactor = None
+    evidence_reactor = None
+    if transport is None and config.p2p.laddr and config.p2p.laddr != "none":
+        transport = MConnTransport(node_key.priv_key, ALL_CHANNEL_DESCS)
+        addr = config.p2p.laddr
+        for prefix in ("tcp://",):
+            if addr.startswith(prefix):
+                addr = addr[len(prefix):]
+        transport.listen(addr)
+    if transport is not None:
+        pm_db = MemDB() if not home else _db("peers")
+        peer_manager = PeerManager(
+            node_key.node_id, pm_db, max_connected=config.p2p.max_connections
+        )
+        router = Router(transport, peer_manager, node_key.node_id)
+        consensus_reactor = ConsensusReactor(consensus, router)
+        mempool_reactor = MempoolReactor(mempool, router, broadcast=config.mempool.broadcast)
+        evidence_reactor = EvidenceReactor(evidence_pool, router)
+        # persistent peers
+        from ..p2p import PeerAddress
+
+        for entry in filter(None, config.p2p.persistent_peers.split(",")):
+            nid, _, paddr = entry.partition("@")
+            peer_manager.add_address(PeerAddress(nid.strip(), paddr.strip()), persistent=True)
+
+    node = Node(
+        config=config,
+        genesis=genesis,
+        node_key=node_key,
+        event_bus=event_bus,
+        state_store=state_store,
+        block_store=block_store,
+        mempool=mempool,
+        evidence_pool=evidence_pool,
+        block_exec=block_exec,
+        consensus=consensus,
+        router=router,
+        consensus_reactor=consensus_reactor,
+        mempool_reactor=mempool_reactor,
+        evidence_reactor=evidence_reactor,
+        proxy_app=query_conn,
+    )
+    if with_rpc and config.rpc.laddr:
+        from ..rpc.server import RPCServer
+        from ..rpc.core import Environment
+
+        env = Environment(node)
+        node.rpc_server = RPCServer(config.rpc.laddr, env)
+    return node

@@ -1,0 +1,93 @@
+"""JSON-RPC HTTP client.
+
+Reference parity: rpc/client/http — the Client interface's method surface
+over HTTP JSON-RPC (plus the local in-process client, rpc/client/local).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+from typing import Optional
+
+from .core import Environment, RPCError
+
+
+class HTTPClient:
+    def __init__(self, base_url: str):
+        if not base_url.startswith("http"):
+            base_url = "http://" + base_url.replace("tcp://", "")
+        self._url = base_url.rstrip("/")
+        self._id = 0
+
+    def call(self, method: str, **params):
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        req = urllib.request.Request(
+            self._url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            obj = json.loads(resp.read())
+        if "error" in obj:
+            e = obj["error"]
+            raise RPCError(e.get("code", -1), e.get("message", ""), e.get("data", ""))
+        return obj["result"]
+
+    # -- convenience methods (rpc/client/interface.go) --------------------
+
+    def status(self):
+        return self.call("status")
+
+    def health(self):
+        return self.call("health")
+
+    def net_info(self):
+        return self.call("net_info")
+
+    def genesis(self):
+        return self.call("genesis")
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def abci_query(self, path: str, data: bytes, height: int = 0, prove: bool = False):
+        return self.call(
+            "abci_query", path=path, data=data.hex(), height=height, prove=prove
+        )
+
+    def block(self, height: Optional[int] = None):
+        return self.call("block", height=height) if height else self.call("block")
+
+    def block_results(self, height: Optional[int] = None):
+        return self.call("block_results", height=height) if height else self.call("block_results")
+
+    def commit(self, height: Optional[int] = None):
+        return self.call("commit", height=height) if height else self.call("commit")
+
+    def validators(self, height: Optional[int] = None):
+        return self.call("validators", height=height) if height else self.call("validators")
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync", tx=base64.b64encode(tx).decode())
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self.call("broadcast_tx_commit", tx=base64.b64encode(tx).decode())
+
+    def tx(self, tx_hash: bytes, prove: bool = False):
+        return self.call("tx", hash=tx_hash.hex(), prove=prove)
+
+    def unconfirmed_txs(self, limit: int = 30):
+        return self.call("unconfirmed_txs", limit=limit)
+
+
+class LocalRPCClient:
+    """rpc/client/local — direct Environment calls in-process."""
+
+    def __init__(self, env: Environment):
+        self._env = env
+
+    def __getattr__(self, name):
+        return getattr(self._env, name)

