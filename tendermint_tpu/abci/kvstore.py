@@ -23,13 +23,20 @@ PROTOCOL_VERSION = 1
 
 
 class KVStoreApplication(BaseApplication):
-    """abci/example/kvstore/kvstore.go."""
+    """abci/example/kvstore/kvstore.go (+ state-sync snapshot support,
+    abci/example/kvstore's snapshots extension)."""
 
-    def __init__(self, db: Optional[DB] = None):
+    SNAPSHOT_CHUNK_SIZE = 65536
+
+    def __init__(self, db: Optional[DB] = None, snapshot_interval: int = 0):
         self._db = db or MemDB()
         self._height = 0
         self._app_hash = b""
         self._size = 0
+        self._snapshot_interval = snapshot_interval
+        self._snapshots: dict = {}  # height -> (chunks: List[bytes], hash)
+        self._restore_buf: list = []
+        self._restoring: Optional[abci.Snapshot] = None
         self._restore()
 
     # -- state persistence ---------------------------------------------
@@ -86,7 +93,85 @@ class KVStoreApplication(BaseApplication):
 
     def commit(self) -> abci.ResponseCommit:
         self._persist()
+        if self._snapshot_interval and self._height > 0 and (
+            self._height % self._snapshot_interval == 0
+        ):
+            self._take_snapshot()
         return abci.ResponseCommit(data=self._compute_app_hash())
+
+    # -- state-sync snapshots -------------------------------------------
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+        import json as _json
+
+        items = {
+            k[len(b"kv:"):].decode("latin1"): v.decode("latin1")
+            for k, v in self._db.iterator(b"kv:", b"kv;")
+        }
+        blob = _json.dumps(
+            {"height": self._height, "size": self._size, "items": items},
+            sort_keys=True,
+        ).encode()
+        chunks = [
+            blob[i : i + self.SNAPSHOT_CHUNK_SIZE]
+            for i in range(0, max(len(blob), 1), self.SNAPSHOT_CHUNK_SIZE)
+        ] or [b""]
+        self._snapshots[self._height] = (chunks, hashlib.sha256(blob).digest())
+        # keep only the 3 newest snapshots
+        for h in sorted(self._snapshots)[:-3]:
+            del self._snapshots[h]
+
+    def list_snapshots(self) -> abci.ResponseListSnapshots:
+        return abci.ResponseListSnapshots(
+            snapshots=[
+                abci.Snapshot(
+                    height=h, format=1, chunks=len(chunks), hash=digest, metadata=b""
+                )
+                for h, (chunks, digest) in sorted(self._snapshots.items())
+            ]
+        )
+
+    def load_snapshot_chunk(self, req) -> abci.ResponseLoadSnapshotChunk:
+        entry = self._snapshots.get(req.height)
+        if entry is None or req.format != 1 or req.chunk >= len(entry[0]):
+            return abci.ResponseLoadSnapshotChunk(chunk=b"")
+        return abci.ResponseLoadSnapshotChunk(chunk=entry[0][req.chunk])
+
+    def offer_snapshot(self, req) -> abci.ResponseOfferSnapshot:
+        if req.snapshot is None or req.snapshot.format != 1:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
+        self._restoring = req.snapshot
+        self._restore_buf = []
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req) -> abci.ResponseApplySnapshotChunk:
+        import hashlib
+        import json as _json
+
+        if self._restoring is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_SNAPSHOT_CHUNK_ABORT
+            )
+        self._restore_buf.append(req.chunk)
+        if len(self._restore_buf) < self._restoring.chunks:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_SNAPSHOT_CHUNK_ACCEPT
+            )
+        blob = b"".join(self._restore_buf)
+        if hashlib.sha256(blob).digest() != self._restoring.hash:
+            self._restoring = None
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT
+            )
+        obj = _json.loads(blob)
+        for k, v in obj["items"].items():
+            self._db.set(b"kv:" + k.encode("latin1"), v.encode("latin1"))
+        self._height = obj["height"]
+        self._size = obj["size"]
+        self._persist()
+        self._restoring = None
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_SNAPSHOT_CHUNK_ACCEPT)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
         if req.path == "/key" or req.path == "":

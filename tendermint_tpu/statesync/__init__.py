@@ -1,0 +1,380 @@
+"""State sync — bootstrap a fresh node from an application snapshot.
+
+Reference parity: internal/statesync/ — the discovery/offer/chunk protocol
+(syncer.go:178 SyncAny, offerSnapshot:384, applyChunks:420, verifyApp:567),
+chunk queue (chunks.go), and the light-client-backed StateProvider
+(stateprovider.go:33) that supplies trusted AppHash/Commit/State; the
+p2p dispatcher (dispatcher.go) serves light blocks over a dedicated
+channel.
+
+Channels (reactor.go): snapshot 0x60, chunk 0x61, light-block 0x62.
+Wire oneofs:
+  snapshot ch: 1 snapshots_request{} | 2 snapshots_response{1 height,
+               2 format, 3 chunks, 4 hash, 5 metadata}
+  chunk ch:    1 chunk_request{1 height, 2 format, 3 index}
+               | 2 chunk_response{1 height, 2 format, 3 index, 4 chunk, 5 missing}
+  light ch:    1 light_block_request{1 height} | 2 light_block_response{1 lb}
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..abci import types as abci
+from ..light.provider import LightBlock
+from ..p2p.conn.mconnection import ChannelDescriptor
+from ..p2p.router import Router
+from ..state import State
+from ..types import Commit, Header, SignedHeader, ValidatorSet
+from ..types.block import BlockID
+from ..types.validation import verify_commit_light
+from ..version import BLOCK_PROTOCOL
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+LIGHT_BLOCK_CHANNEL = 0x62
+
+SNAPSHOT_DESC = ChannelDescriptor(id=SNAPSHOT_CHANNEL, priority=5)
+CHUNK_DESC = ChannelDescriptor(
+    id=CHUNK_CHANNEL, priority=3, recv_message_capacity=16 * 1024 * 1024
+)
+LIGHT_BLOCK_DESC = ChannelDescriptor(
+    id=LIGHT_BLOCK_CHANNEL, priority=5, recv_message_capacity=8 * 1024 * 1024
+)
+
+ALL_STATESYNC_DESCS = [SNAPSHOT_DESC, CHUNK_DESC, LIGHT_BLOCK_DESC]
+
+
+class SyncError(RuntimeError):
+    pass
+
+
+def _enc(kind: int, fields: Optional[dict] = None) -> bytes:
+    inner = ProtoWriter()
+    for num, val in sorted((fields or {}).items()):
+        if isinstance(val, bytes):
+            inner.write_bytes(num, val)
+        else:
+            inner.write_varint(num, val)
+    w = ProtoWriter()
+    w.write_message(kind, inner.bytes(), always=True)
+    return w.bytes()
+
+
+def _encode_light_block(lb: LightBlock) -> bytes:
+    sh = ProtoWriter()
+    sh.write_message(1, lb.signed_header.header.encode(), always=True)
+    sh.write_message(2, lb.signed_header.commit.encode(), always=True)
+    w = ProtoWriter()
+    w.write_message(1, sh.bytes(), always=True)
+    w.write_message(2, lb.validators.encode(), always=True)
+    return w.bytes()
+
+
+def _decode_light_block(raw: bytes) -> LightBlock:
+    f = decode_message(raw)
+    sh = decode_message(field_bytes(f, 1))
+    return LightBlock(
+        signed_header=SignedHeader(
+            header=Header.decode(field_bytes(sh, 1)),
+            commit=Commit.decode(field_bytes(sh, 2)),
+        ),
+        validators=ValidatorSet.decode(field_bytes(f, 2)),
+    )
+
+
+@dataclass
+class _SnapshotInfo:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes
+    peers: List[str] = field(default_factory=list)
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.hash)
+
+
+class StateSyncReactor:
+    """internal/statesync/reactor.go + syncer.go (server + client roles)."""
+
+    def __init__(
+        self,
+        router: Router,
+        query_conn,  # ABCI query/snapshot connection
+        state_store,
+        block_store,
+        chain_id: str,
+        serving: bool = True,
+    ):
+        self._router = router
+        self._conn = query_conn
+        self._state_store = state_store
+        self._block_store = block_store
+        self._chain_id = chain_id
+        self._serving = serving
+        self._snap_ch = router.open_channel(SNAPSHOT_DESC)
+        self._chunk_ch = router.open_channel(CHUNK_DESC)
+        self._lb_ch = router.open_channel(LIGHT_BLOCK_DESC)
+        self._stopped = threading.Event()
+        self._snapshots: Dict[tuple, _SnapshotInfo] = {}
+        self._chunks: Dict[Tuple[int, int, int], bytes] = {}
+        self._light_blocks: Dict[int, LightBlock] = {}
+        self._mtx = threading.Lock()
+
+    def start(self) -> None:
+        for ch, handler in (
+            (self._snap_ch, self._handle_snapshot_msg),
+            (self._chunk_ch, self._handle_chunk_msg),
+            (self._lb_ch, self._handle_light_block_msg),
+        ):
+            t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
+            t.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _process(self, ch, handler) -> None:
+        while not self._stopped.is_set():
+            try:
+                env = ch.receive(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                handler(env)
+            except (ValueError, KeyError):
+                continue
+
+    # -- server side ------------------------------------------------------
+
+    def _handle_snapshot_msg(self, env) -> None:
+        f = decode_message(env.message)
+        if 1 in f and self._serving:  # snapshots_request
+            res = self._conn.list_snapshots()
+            for s in res.snapshots[:10]:
+                self._snap_ch.send(
+                    env.from_id,
+                    _enc(2, {1: s.height, 2: s.format, 3: s.chunks, 4: s.hash, 5: s.metadata}),
+                )
+        elif 2 in f:  # snapshots_response
+            r = decode_message(field_bytes(f, 2))
+            info = _SnapshotInfo(
+                height=field_int(r, 1),
+                format=field_int(r, 2),
+                chunks=field_int(r, 3),
+                hash=field_bytes(r, 4),
+                metadata=field_bytes(r, 5),
+            )
+            with self._mtx:
+                existing = self._snapshots.setdefault(info.key(), info)
+                if env.from_id not in existing.peers:
+                    existing.peers.append(env.from_id)
+
+    def _handle_chunk_msg(self, env) -> None:
+        f = decode_message(env.message)
+        if 1 in f and self._serving:  # chunk_request
+            r = decode_message(field_bytes(f, 1))
+            res = self._conn.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=field_int(r, 1), format=field_int(r, 2), chunk=field_int(r, 3)
+                )
+            )
+            self._chunk_ch.send(
+                env.from_id,
+                _enc(2, {
+                    1: field_int(r, 1), 2: field_int(r, 2), 3: field_int(r, 3),
+                    4: res.chunk, 5: 0 if res.chunk else 1,
+                }),
+            )
+        elif 2 in f:  # chunk_response
+            r = decode_message(field_bytes(f, 2))
+            key = (field_int(r, 1), field_int(r, 2), field_int(r, 3))
+            with self._mtx:
+                self._chunks[key] = field_bytes(r, 4)
+
+    def _handle_light_block_msg(self, env) -> None:
+        f = decode_message(env.message)
+        if 1 in f and self._serving:  # light_block_request
+            r = decode_message(field_bytes(f, 1))
+            height = to_signed64(field_int(r, 1))
+            lb = self._load_local_light_block(height)
+            if lb is not None:
+                self._lb_ch.send(env.from_id, _enc(2, {1: _encode_light_block(lb)}))
+        elif 2 in f:  # light_block_response
+            r = decode_message(field_bytes(f, 2))
+            lb = _decode_light_block(field_bytes(r, 1))
+            with self._mtx:
+                self._light_blocks[lb.height] = lb
+
+    def _load_local_light_block(self, height: int) -> Optional[LightBlock]:
+        meta = self._block_store.load_block_meta(height)
+        commit = self._block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            return None
+        try:
+            vals = self._state_store.load_validators(height)
+        except KeyError:
+            return None
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validators=vals,
+        )
+
+    # -- client side: the sync (syncer.go:178 SyncAny) ---------------------
+
+    def _fetch_light_block(self, height: int, timeout: float = 10.0) -> LightBlock:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._mtx:
+                lb = self._light_blocks.get(height)
+            if lb is not None:
+                return lb
+            self._lb_ch.broadcast(_enc(1, {1: height}))
+            time.sleep(0.2)
+        raise SyncError(f"no light block at height {height}")
+
+    def sync_any(
+        self,
+        genesis_state: State,
+        trust_height: int,
+        trust_hash: bytes,
+        discovery_time: float = 5.0,
+        chunk_timeout: float = 15.0,
+    ) -> Tuple[State, Commit]:
+        """Discover a snapshot, restore it, verify the app, and build the
+        post-sync State with light-client-verified trust."""
+        # 1. verify the root of trust
+        root = self._fetch_light_block(trust_height)
+        if root.hash() != trust_hash:
+            raise SyncError(
+                f"trust hash mismatch at height {trust_height}: "
+                f"got {root.hash().hex()}, want {trust_hash.hex()}"
+            )
+        verify_commit_light(
+            self._chain_id, root.validators, root.signed_header.commit.block_id,
+            trust_height, root.signed_header.commit,
+        )
+
+        # 2. discover snapshots
+        deadline = time.time() + discovery_time
+        while time.time() < deadline:
+            self._snap_ch.broadcast(_enc(1))
+            with self._mtx:
+                if self._snapshots:
+                    break
+            time.sleep(0.2)
+        with self._mtx:
+            candidates = sorted(
+                self._snapshots.values(), key=lambda s: (-s.height, s.format)
+            )
+        if not candidates:
+            raise SyncError("no snapshots discovered")
+
+        for snap in candidates:
+            try:
+                return self._sync_one(genesis_state, snap, chunk_timeout)
+            except SyncError:
+                continue
+        raise SyncError("all discovered snapshots failed")
+
+    def _sync_one(self, genesis_state: State, snap: _SnapshotInfo, chunk_timeout: float):
+        # trusted app hash comes from the header at snapshot height + 1
+        header_next = self._fetch_light_block(snap.height + 1)
+        trusted_app_hash = header_next.signed_header.header.app_hash
+        snap_block = self._fetch_light_block(snap.height)
+        # verify both headers' commits (the device batch path)
+        for lb in (snap_block, header_next):
+            verify_commit_light(
+                self._chain_id, lb.validators, lb.signed_header.commit.block_id,
+                lb.height, lb.signed_header.commit,
+            )
+        if header_next.signed_header.header.last_block_id.hash != snap_block.hash():
+            raise SyncError("light block chain linkage broken")
+
+        # 3. offer to the app (syncer.go:384)
+        res = self._conn.offer_snapshot(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snap.height, format=snap.format, chunks=snap.chunks,
+                    hash=snap.hash, metadata=snap.metadata,
+                ),
+                app_hash=trusted_app_hash,
+            )
+        )
+        if res.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            raise SyncError(f"snapshot rejected by app: {res.result}")
+
+        # 4. fetch + apply chunks (chunks.go + syncer.go:420)
+        for index in range(snap.chunks):
+            chunk = self._fetch_chunk(snap, index, chunk_timeout)
+            ares = self._conn.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=index, chunk=chunk)
+            )
+            if ares.result not in (
+                abci.APPLY_SNAPSHOT_CHUNK_ACCEPT,
+                abci.APPLY_SNAPSHOT_CHUNK_RETRY,
+            ):
+                raise SyncError(f"chunk {index} rejected: {ares.result}")
+
+        # 5. verify the app took the snapshot (syncer.go:565 verifyApp)
+        info = self._conn.info(abci.RequestInfo())
+        if info.last_block_app_hash != trusted_app_hash:
+            raise SyncError(
+                f"appHash verification failed: expected {trusted_app_hash.hex()}, "
+                f"got {info.last_block_app_hash.hex()}"
+            )
+        if info.last_block_height != snap.height:
+            raise SyncError("app reported unexpected last block height")
+
+        # 6. build State (stateprovider.go State())
+        next_vals = self._fetch_light_block(snap.height + 1).validators
+        try:
+            nn_vals = self._fetch_light_block(snap.height + 2).validators
+        except SyncError:
+            nn_vals = next_vals
+        state = State(
+            version=genesis_state.version,
+            chain_id=self._chain_id,
+            initial_height=genesis_state.initial_height,
+            last_block_height=snap.height,
+            last_block_id=header_next.signed_header.header.last_block_id,
+            last_block_time=snap_block.signed_header.header.time,
+            validators=next_vals.copy(),
+            next_validators=nn_vals.copy(),
+            last_validators=snap_block.validators.copy(),
+            last_height_validators_changed=snap.height + 1,
+            consensus_params=genesis_state.consensus_params,
+            last_height_consensus_params_changed=genesis_state.initial_height,
+            last_results_hash=header_next.signed_header.header.last_results_hash,
+            app_hash=trusted_app_hash,
+        )
+        # bootstrap the stores (node.go statesync completion)
+        self._state_store.bootstrap(state)
+        self._block_store.save_signed_header(
+            snap_block.signed_header,
+            header_next.signed_header.header.last_block_id,
+        )
+        return state, snap_block.signed_header.commit
+
+    def _fetch_chunk(self, snap: _SnapshotInfo, index: int, timeout: float) -> bytes:
+        key = (snap.height, snap.format, index)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._mtx:
+                chunk = self._chunks.get(key)
+            if chunk is not None:
+                return chunk
+            for peer in snap.peers or [""]:
+                msg = _enc(1, {1: snap.height, 2: snap.format, 3: index})
+                if peer:
+                    self._chunk_ch.send(peer, msg)
+                else:
+                    self._chunk_ch.broadcast(msg)
+            time.sleep(0.2)
+        raise SyncError(f"timed out fetching chunk {index}")

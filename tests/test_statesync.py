@@ -1,0 +1,135 @@
+"""State sync: a fresh node bootstraps from an app snapshot served by a
+peer, with light-client-verified trust (SURVEY.md §7 stage 6)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.p2p import (
+    MemoryTransport,
+    NodeKey,
+    PeerAddress,
+    PeerManager,
+    Router,
+    new_memory_network,
+)
+from tendermint_tpu.state import make_genesis_state
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.statesync import StateSyncReactor, SyncError
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import Timestamp
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tests.test_consensus import FAST
+
+
+CHAIN_ID = "cs-chain"
+
+
+@pytest.fixture(scope="module")
+def snapshotting_chain():
+    """A 1-validator chain with snapshot_interval=2, run past height 6."""
+    from tendermint_tpu.config import ConsensusConfig
+    from tendermint_tpu.consensus import ConsensusState
+    from tendermint_tpu.eventbus import EventBus
+    from tendermint_tpu.mempool import TxMempool
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.state.execution import BlockExecutor
+
+    sk = ed25519.gen_priv_key(bytes([7]) * 32)
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Timestamp(seconds=1_700_000_000),
+        validators=[GenesisValidator(address=b"", pub_key=sk.pub_key(), power=10)],
+    )
+    state = make_genesis_state(doc)
+    app = KVStoreApplication(snapshot_interval=2)
+    proxy = LocalClient(app)
+    sstore = StateStore(MemDB())
+    sstore.save(state)
+    bstore = BlockStore(MemDB())
+    mp = TxMempool(LocalClient(app))
+    for i in range(3):
+        mp.check_tx(b"snap%d=v%d" % (i, i))
+    ex = BlockExecutor(sstore, proxy, mempool=mp, block_store=bstore)
+    cs = ConsensusState(FAST, state, ex, bstore, mempool=mp, priv_validator=FilePV(sk))
+    cs.start()
+    try:
+        cs.wait_for_height(7, timeout=60)
+    finally:
+        cs.stop()
+    return app, proxy, sstore, bstore, doc
+
+
+class TestStateSync:
+    def test_fresh_node_state_syncs(self, snapshotting_chain):
+        app, proxy, src_sstore, src_bstore, doc = snapshotting_chain
+        assert app._snapshots, "source app has no snapshots"
+
+        hub = new_memory_network()
+        keys = [NodeKey.generate(bytes([i + 40]) * 32) for i in range(2)]
+        routers = []
+        for i in range(2):
+            t = MemoryTransport(hub, keys[i].node_id, keys[i].pub_key)
+            pm = PeerManager(keys[i].node_id)
+            routers.append(Router(t, pm, keys[i].node_id))
+
+        server = StateSyncReactor(
+            routers[0], proxy, src_sstore, src_bstore, CHAIN_ID, serving=True
+        )
+
+        fresh_app = KVStoreApplication()
+        fresh_conn = LocalClient(fresh_app)
+        fresh_sstore = StateStore(MemDB())
+        fresh_bstore = BlockStore(MemDB())
+        client = StateSyncReactor(
+            routers[1], fresh_conn, fresh_sstore, fresh_bstore, CHAIN_ID, serving=False
+        )
+
+        routers[0]._pm.add_address(PeerAddress(keys[1].node_id, keys[1].node_id))
+        for r in routers:
+            r.start()
+        server.start()
+        client.start()
+        # wait for connectivity
+        deadline = time.time() + 5
+        while time.time() < deadline and not routers[1].connected():
+            time.sleep(0.05)
+
+        genesis_state = make_genesis_state(doc)
+        # choose a snapshot with light blocks available at h, h+1, h+2
+        usable = [h for h in app._snapshots if h + 2 <= src_bstore.height()]
+        assert usable, (app._snapshots.keys(), src_bstore.height())
+        snap_height = max(usable)
+        trust_block = server._load_local_light_block(snap_height)
+        try:
+            state, commit = client.sync_any(
+                genesis_state,
+                trust_height=snap_height,
+                trust_hash=trust_block.hash(),
+                discovery_time=10.0,
+            )
+        finally:
+            server.stop()
+            client.stop()
+            for r in routers:
+                r.stop()
+
+        assert state.last_block_height == snap_height
+        # trusted app hash came from the header at snap_height+1
+        next_meta = src_bstore.load_block_meta(snap_height + 1)
+        assert state.app_hash == next_meta.header.app_hash
+        # the fresh app restored the snapshot: data is queryable
+        from tendermint_tpu.abci import types as abci_t
+
+        q = fresh_conn.query(abci_t.RequestQuery(data=b"snap0", path="/key"))
+        assert q.value == b"v0"
+        info = fresh_conn.info(abci_t.RequestInfo())
+        assert info.last_block_height == snap_height
+        # stores were bootstrapped
+        assert fresh_bstore.load_block_meta(snap_height) is not None
+        assert fresh_sstore.load().last_block_height == snap_height
+        assert fresh_sstore.load_validators(snap_height + 1).hash() == state.validators.hash()
+        assert commit.height == snap_height
