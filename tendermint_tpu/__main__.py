@@ -1,0 +1,7 @@
+"""python -m tendermint_tpu — the CLI entry point (cmd/tendermint)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,296 @@
+"""Command-line interface.
+
+Reference parity: cmd/tendermint/ (main.go:16-50) — init, start,
+gen-validator, gen-node-key, show-node-id, show-validator, testnet,
+rollback, inspect, reset-unsafe, version. Built on argparse instead of
+cobra; `python -m tendermint_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import sys
+import time
+
+
+def _cfg(home: str):
+    from .config import Config, default_config
+
+    path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(path):
+        cfg = Config.load(path)
+        cfg.base.home = home
+        return cfg
+    return default_config(home)
+
+
+def cmd_version(args) -> int:
+    from .version import TM_VERSION, BLOCK_PROTOCOL, P2P_PROTOCOL
+
+    print(f"tendermint-tpu {TM_VERSION} (block protocol {BLOCK_PROTOCOL}, p2p {P2P_PROTOCOL})")
+    return 0
+
+
+def cmd_init(args) -> int:
+    """init [validator|full|seed] (cmd init.go)."""
+    from .config import default_config
+    from .privval import FilePV
+    from .p2p import NodeKey
+    from .types.genesis import GenesisDoc, GenesisValidator
+    from .wire.canonical import Timestamp
+
+    home = args.home
+    cfg = default_config(home)
+    cfg.base.mode = args.mode
+    cfg.ensure_dirs()
+
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator.key_path(home), cfg.priv_validator.state_path(home)
+    )
+    pv.save()
+    nk = NodeKey.load_or_generate(cfg.base.node_key_path())
+
+    gen_path = cfg.base.genesis_path()
+    if not os.path.exists(gen_path):
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=Timestamp(seconds=int(time.time())),
+            validators=(
+                [GenesisValidator(address=b"", pub_key=pv.get_pub_key(), power=10)]
+                if args.mode == "validator"
+                else []
+            ),
+        )
+        doc.validate_and_complete()
+        doc.save_as(gen_path)
+    cfg.save()
+    print(f"Initialized {args.mode} node in {home} (node id {nk.node_id})")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from .privval import FilePV
+
+    pv = FilePV.generate()
+    pk = pv.get_pub_key()
+    print(
+        json.dumps(
+            {
+                "address": pk.address().hex().upper(),
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(pk.bytes()).decode(),
+                },
+                "priv_key": {
+                    "type": "tendermint/PrivKeyEd25519",
+                    "value": base64.b64encode(pv._priv_key.bytes()).decode(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .p2p import NodeKey
+
+    nk = NodeKey.generate()
+    print(json.dumps({"id": nk.node_id}))
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .p2p import NodeKey
+
+    cfg = _cfg(args.home)
+    nk = NodeKey.load_or_generate(cfg.base.node_key_path())
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from .privval import FilePV
+
+    cfg = _cfg(args.home)
+    pv = FilePV.load(
+        cfg.priv_validator.key_path(args.home), cfg.priv_validator.state_path(args.home)
+    )
+    pk = pv.get_pub_key()
+    print(
+        json.dumps(
+            {"type": "tendermint/PubKeyEd25519", "value": base64.b64encode(pk.bytes()).decode()}
+        )
+    )
+    return 0
+
+
+def cmd_start(args) -> int:
+    """start (run_node.go): run a node until interrupted."""
+    from .node import make_node
+    from .abci import KVStoreApplication
+
+    cfg = _cfg(args.home)
+    app = None
+    if args.proxy_app == "kvstore" or cfg.base.proxy_app == "kvstore":
+        app = KVStoreApplication()
+    node = make_node(cfg, app=app, with_rpc=True)
+    node.start()
+    print(f"node {node.node_id} started; RPC at {cfg.rpc.laddr}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """testnet (testnet.go): generate config dirs for a localnet."""
+    from .config import default_config
+    from .privval import FilePV
+    from .p2p import NodeKey
+    from .types.genesis import GenesisDoc, GenesisValidator
+    from .wire.canonical import Timestamp
+
+    n = args.v
+    out = args.o
+    pvs, node_keys = [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = default_config(home)
+        cfg.ensure_dirs()
+        pv = FilePV.load_or_generate(
+            cfg.priv_validator.key_path(home), cfg.priv_validator.state_path(home)
+        )
+        pv.save()
+        pvs.append(pv)
+        node_keys.append(NodeKey.load_or_generate(cfg.base.node_key_path()))
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=Timestamp(seconds=int(time.time())),
+        validators=[
+            GenesisValidator(address=b"", pub_key=pv.get_pub_key(), power=1)
+            for pv in pvs
+        ],
+    )
+    doc.validate_and_complete()
+    peers = ",".join(
+        f"{nk.node_id}@127.0.0.1:{26656 + 10 * i}" for i, nk in enumerate(node_keys)
+    )
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = default_config(home)
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{26656 + 10 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{26657 + 10 * i}"
+        cfg.p2p.persistent_peers = peers
+        cfg.save()
+        doc.save_as(cfg.base.genesis_path())
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    from .db import backend as db_backend
+    from .state.rollback import rollback_state
+    from .state.store import StateStore
+    from .store import BlockStore
+
+    cfg = _cfg(args.home)
+    state_store = StateStore(db_backend("sqlite", cfg.base.db_path("state")))
+    block_store = BlockStore(db_backend("sqlite", cfg.base.db_path("blockstore")))
+    height, app_hash = rollback_state(state_store, block_store)
+    print(f"Rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from .db import backend as db_backend
+    from .inspect import Inspector
+    from .state.store import StateStore
+    from .store import BlockStore
+    from .types.genesis import GenesisDoc
+
+    cfg = _cfg(args.home)
+    genesis = GenesisDoc.from_file(cfg.base.genesis_path())
+    inspector = Inspector(
+        cfg,
+        genesis,
+        StateStore(db_backend("sqlite", cfg.base.db_path("state"))),
+        BlockStore(db_backend("sqlite", cfg.base.db_path("blockstore"))),
+    )
+    inspector.start()
+    print(f"inspect RPC at {inspector.listen_addr}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        inspector.stop()
+    return 0
+
+
+def cmd_reset_unsafe(args) -> int:
+    """unsafe-reset-all: wipe data, keep config + priv key state zeroed."""
+    data = os.path.join(args.home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    print(f"Removed all blockchain history in {data}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tendermint-tpu")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint-tpu"))
+    sub = p.add_subparsers(dest="command")
+
+    sub.add_parser("version")
+    sp = sub.add_parser("init")
+    sp.add_argument("mode", nargs="?", default="validator",
+                    choices=["validator", "full", "seed"])
+    sp.add_argument("--chain-id", default="")
+    sub.add_parser("gen-validator")
+    sub.add_parser("gen-node-key")
+    sub.add_parser("show-node-id")
+    sub.add_parser("show-validator")
+    sp = sub.add_parser("start")
+    sp.add_argument("--proxy-app", default="")
+    sp = sub.add_parser("testnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--o", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sub.add_parser("rollback")
+    sub.add_parser("inspect")
+    sub.add_parser("unsafe-reset-all")
+    return p
+
+
+COMMANDS = {
+    "version": cmd_version,
+    "init": cmd_init,
+    "gen-validator": cmd_gen_validator,
+    "gen-node-key": cmd_gen_node_key,
+    "show-node-id": cmd_show_node_id,
+    "show-validator": cmd_show_validator,
+    "start": cmd_start,
+    "testnet": cmd_testnet,
+    "rollback": cmd_rollback,
+    "inspect": cmd_inspect,
+    "unsafe-reset-all": cmd_reset_unsafe,
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().print_help()
+        return 1
+    return COMMANDS[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

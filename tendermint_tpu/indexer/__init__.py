@@ -1,0 +1,200 @@
+"""Event indexing — tx and block event indexes with query support.
+
+Reference parity: internal/state/indexer/ — the IndexerService consuming
+eventbus Tx/NewBlock subscriptions, the kv sink (sink/kv) keying events
+as "<type>.<attr>=<value>" -> heights/tx hashes, the null sink, and the
+query execution backing /tx_search and /block_search.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..db import DB, MemDB
+from ..libs.pubsub import Query
+from ..types import events as tme
+from ..types.tx import tx_hash
+
+
+class Sink:
+    """indexer.EventSink interface."""
+
+    def index_tx(self, height: int, index: int, tx: bytes, result, events: Dict[str, List[str]]) -> None: ...
+
+    def index_block(self, height: int, events: Dict[str, List[str]]) -> None: ...
+
+
+class NullSink(Sink):
+    def index_tx(self, *a, **k) -> None:
+        pass
+
+    def index_block(self, *a, **k) -> None:
+        pass
+
+
+class KVSink(Sink):
+    """sink/kv: hash -> tx record; event-kv -> matches."""
+
+    def __init__(self, db: Optional[DB] = None):
+        self._db = db or MemDB()
+        self._mtx = threading.Lock()
+
+    # -- writes ---------------------------------------------------------
+
+    def index_tx(self, height, index, tx, result, events) -> None:
+        h = tx_hash(tx)
+        record = {
+            "height": height,
+            "index": index,
+            "tx": tx.hex(),
+            "code": getattr(result, "code", 0),
+            "log": getattr(result, "log", ""),
+            "events": events,
+        }
+        with self._mtx:
+            self._db.set(b"tx/" + h, json.dumps(record).encode())
+            for key, values in events.items():
+                for v in values:
+                    self._db.set(
+                        b"txevt/" + _kv(key, v) + b"/" + struct.pack(">qi", height, index),
+                        h,
+                    )
+
+    def index_block(self, height, events) -> None:
+        with self._mtx:
+            self._db.set(b"blk/" + struct.pack(">q", height), json.dumps(events).encode())
+            for key, values in events.items():
+                for v in values:
+                    self._db.set(
+                        b"blkevt/" + _kv(key, v) + b"/" + struct.pack(">q", height), b"\x01"
+                    )
+
+    # -- reads ----------------------------------------------------------
+
+    def get_tx(self, h: bytes) -> Optional[dict]:
+        raw = self._db.get(b"tx/" + h)
+        return json.loads(raw) if raw is not None else None
+
+    def search_txs(self, query: str, limit: int = 100) -> List[dict]:
+        """tx_search: AND of =-conditions over indexed events; height
+        conditions are applied as a post-filter."""
+        q = Query(query)
+        candidate_hashes: Optional[set] = None
+        post_conditions = []
+        for key, op, val in q.conditions:
+            if op == "=" and key not in ("tx.height",):
+                hashes = {
+                    v
+                    for _, v in self._db.iterator(
+                        b"txevt/" + _kv(key, val) + b"/",
+                        b"txevt/" + _kv(key, val) + b"0",
+                    )
+                }
+                candidate_hashes = (
+                    hashes if candidate_hashes is None else candidate_hashes & hashes
+                )
+            else:
+                post_conditions.append((key, op, val))
+        out = []
+        if candidate_hashes is None:
+            # scan all txs
+            records = [
+                json.loads(v) for _, v in self._db.iterator(b"tx/", b"tx0")
+            ]
+        else:
+            records = [r for h in candidate_hashes if (r := self.get_tx(h)) is not None]
+        for rec in records:
+            events = dict(rec.get("events", {}))
+            events.setdefault("tx.height", [str(rec["height"])])
+            ok = True
+            for key, op, val in post_conditions:
+                vals = events.get(key)
+                if vals is None:
+                    ok = False
+                    break
+                if op != "EXISTS" and not any(
+                    Query._match_one(op, got, val) for got in vals
+                ):
+                    ok = False
+                    break
+            if ok:
+                out.append(rec)
+            if len(out) >= limit:
+                break
+        out.sort(key=lambda r: (r["height"], r["index"]))
+        return out
+
+    def search_blocks(self, query: str, limit: int = 100) -> List[int]:
+        q = Query(query)
+        candidate: Optional[set] = None
+        for key, op, val in q.conditions:
+            if op == "=":
+                hs = {
+                    struct.unpack(">q", k[-8:])[0]
+                    for k, _ in self._db.iterator(
+                        b"blkevt/" + _kv(key, val) + b"/",
+                        b"blkevt/" + _kv(key, val) + b"0",
+                    )
+                }
+                candidate = hs if candidate is None else candidate & hs
+        if candidate is None:
+            candidate = {
+                struct.unpack(">q", k[len(b"blk/"):])[0]
+                for k, _ in self._db.iterator(b"blk/", b"blk0")
+            }
+        return sorted(candidate)[:limit]
+
+
+def _kv(key: str, value: str) -> bytes:
+    return key.encode() + b"=" + value.encode()
+
+
+class IndexerService:
+    """indexer/service.go: subscribes to the eventbus and feeds sinks."""
+
+    def __init__(self, sinks: List[Sink], event_bus):
+        self._sinks = sinks
+        self._bus = event_bus
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        tx_sub = self._bus.subscribe("indexer", tme.query_for_event(tme.EventTx), capacity=1000)
+        blk_sub = self._bus.subscribe(
+            "indexer-blk", tme.query_for_event(tme.EventNewBlock), capacity=1000
+        )
+        for sub, fn in ((tx_sub, self._on_tx), (blk_sub, self._on_block)):
+            t = threading.Thread(target=self._pump, args=(sub, fn), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._bus.unsubscribe_all("indexer")
+            self._bus.unsubscribe_all("indexer-blk")
+        except KeyError:
+            pass
+
+    def _pump(self, sub, fn) -> None:
+        import queue as _q
+
+        while not self._stopped.is_set():
+            try:
+                msg = sub.next(timeout=0.5)
+            except _q.Empty:
+                continue
+            fn(msg)
+
+    def _on_tx(self, msg) -> None:
+        d = msg.data
+        for sink in self._sinks:
+            sink.index_tx(d["height"], d["index"], d["tx"], d["result"], msg.events)
+
+    def _on_block(self, msg) -> None:
+        d = msg.data
+        for sink in self._sinks:
+            sink.index_block(d["block"].header.height, msg.events)

@@ -1,0 +1,79 @@
+"""Inspect — read-only RPC over the data directories of a stopped node.
+
+Reference parity: internal/inspect/inspect.go — serves the store-backed
+subset of the RPC surface (status/block/commit/validators/...) without
+starting consensus or p2p, for post-mortem debugging.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..rpc.core import Environment
+from ..rpc.server import RPCServer
+
+
+class _StubConsensus:
+    """Just enough surface for the store-backed Environment methods."""
+
+    _priv_validator_pub_key = None
+
+    def __init__(self, state):
+        self._state = state
+        from ..consensus.types import RoundState
+
+        self.rs = RoundState()
+
+    @property
+    def committed_state(self):
+        return self._state
+
+
+class _InspectNode:
+    def __init__(self, config, genesis, state_store, block_store):
+        self.config = config
+        self.genesis = genesis
+        self.state_store = state_store
+        self.block_store = block_store
+        self.router = None
+        self.mempool = None
+        self.mempool_reactor = None
+        self.evidence_pool = None
+        self.proxy_app = None
+        state = state_store.load()
+        self.consensus = _StubConsensus(state)
+        self.node_key = None
+
+    @property
+    def node_id(self) -> str:
+        return ""
+
+
+# routes the inspect server exposes (inspect.go:60-90)
+INSPECT_ROUTES = [
+    "status", "health", "genesis", "block", "block_by_hash", "blockchain",
+    "commit", "block_results", "validators", "consensus_params",
+]
+
+
+class Inspector:
+    """inspect.go Inspector."""
+
+    def __init__(self, config, genesis, state_store, block_store, laddr: Optional[str] = None):
+        node = _InspectNode(config, genesis, state_store, block_store)
+        self._env = Environment(node)
+        self._server = RPCServer(laddr or config.rpc.laddr, self._env)
+
+    @property
+    def env(self) -> Environment:
+        return self._env
+
+    @property
+    def listen_addr(self) -> str:
+        return self._server.listen_addr
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()

@@ -1,0 +1,70 @@
+"""Rollback — revert chain state by one height (app-hash recovery).
+
+Reference parity: internal/state/rollback.go — rebuilds State at
+height-1 from the stores (validators/params checkpoints + block meta),
+leaving the block store intact so the block is re-applied on restart.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Tuple
+
+from ..types import BlockID
+from . import State
+from .store import StateStore
+
+
+def rollback_state(state_store: StateStore, block_store) -> Tuple[int, bytes]:
+    """rollback.go Rollback: returns (new_height, new_app_hash)."""
+    invalid_state = state_store.load()
+    if invalid_state is None:
+        raise RuntimeError("no state found")
+    height = block_store.height()
+
+    # the block at the current state height must exist to roll back from
+    if invalid_state.last_block_height != height:
+        raise RuntimeError(
+            f"statestore height ({invalid_state.last_block_height}) and "
+            f"blockstore height ({height}) mismatch; cannot rollback"
+        )
+    rollback_height = invalid_state.last_block_height
+    rollback_block = block_store.load_block_meta(rollback_height)
+    if rollback_block is None:
+        raise RuntimeError(f"block at height {rollback_height} not found")
+    prev_height = rollback_height - 1
+    if prev_height <= 0:
+        raise RuntimeError("cannot rollback to height <= 0")
+    prev_block = block_store.load_block_meta(prev_height)
+    if prev_block is None:
+        raise RuntimeError(f"block at height {prev_height} not found")
+
+    prev_validators = state_store.load_validators(prev_height)
+    curr_validators = state_store.load_validators(rollback_height)
+    next_validators = state_store.load_validators(rollback_height + 1)
+    prev_params = state_store.load_consensus_params(rollback_height)
+
+    # the rolled-back state believes `rollback_height - 1` was the last
+    # committed block (rollback.go:60-95)
+    new_state = State(
+        version=replace(
+            invalid_state.version, app=prev_params.version.app_version
+        ),
+        chain_id=invalid_state.chain_id,
+        initial_height=invalid_state.initial_height,
+        last_block_height=prev_height,
+        last_block_id=rollback_block.header.last_block_id,
+        last_block_time=prev_block.header.time,
+        next_validators=curr_validators,
+        validators=prev_validators,
+        last_validators=state_store.load_validators(max(prev_height - 1, 1))
+        if prev_height > 1
+        else prev_validators,
+        last_height_validators_changed=invalid_state.last_height_validators_changed,
+        consensus_params=prev_params,
+        last_height_consensus_params_changed=invalid_state.last_height_consensus_params_changed,
+        last_results_hash=prev_block.header.last_results_hash,
+        app_hash=rollback_block.header.app_hash,
+    )
+    state_store.save(new_state)
+    return new_state.last_block_height, new_state.app_hash

@@ -5,6 +5,7 @@ must match so artifacts (blocks, handshakes) are interoperable in shape.
 """
 
 TM_CORE_SEM_VER = "0.35.0-tpu"
+TM_VERSION = TM_CORE_SEM_VER
 ABCI_SEM_VER = "0.17.0"
 ABCI_VERSION = ABCI_SEM_VER
 

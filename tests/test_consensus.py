@@ -59,8 +59,8 @@ def make_node(sks, idx, wal_path=None, tx_source=None):
     if tx_source:
         for tx in tx_source:
             mp.check_tx(tx)
-    ex = BlockExecutor(sstore, proxy, mempool=mp, block_store=bstore)
     bus = EventBus()
+    ex = BlockExecutor(sstore, proxy, mempool=mp, block_store=bstore, event_bus=bus)
     wal = WAL(wal_path) if wal_path else None
     pv = FilePV(sks[idx]) if idx is not None else None
     cs = ConsensusState(
