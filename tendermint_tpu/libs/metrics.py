@@ -1,0 +1,188 @@
+"""Metrics — Prometheus-style counters/gauges/histograms.
+
+Reference parity: the go-kit metric sets wired in node/setup.go
+defaultMetricsProvider (internal/consensus/metrics.go:8+, p2p/mempool/
+state/proxy metric sets) and the Prometheus scrape endpoint from the
+instrumentation config. Text exposition format, stdlib HTTP server.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, typ: str):
+        self.name = name
+        self.help = help_
+        self.type = typ
+        self._values: Dict[Tuple, float] = {}
+        self._mtx = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple:
+        return tuple(sorted(labels.items()))
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
+        with self._mtx:
+            for key, val in self._values.items():
+                if key:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    out.append(f"{self.name}{{{lbl}}} {val}")
+                else:
+                    out.append(f"{self.name} {val}")
+        return out
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_, "counter")
+
+    def inc(self, delta: float = 1.0, **labels) -> None:
+        with self._mtx:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_, "gauge")
+
+    def set(self, value: float, **labels) -> None:
+        with self._mtx:
+            self._values[self._key(labels)] = value
+
+    def add(self, delta: float, **labels) -> None:
+        with self._mtx:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+
+class Histogram(_Metric):
+    """Prometheus histogram with fixed buckets."""
+
+    def __init__(self, name: str, help_: str = "", buckets=None):
+        super().__init__(name, help_, "histogram")
+        self.buckets = buckets or [0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10]
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+
+    def observe(self, value: float) -> None:
+        with self._mtx:
+            self._sum += value
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._mtx:
+            cumulative = 0
+            for i, b in enumerate(self.buckets):
+                cumulative += self._counts[i]
+                out.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
+            cumulative += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+            out.append(f"{self.name}_sum {self._sum}")
+            out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: List[_Metric] = []
+        self._mtx = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str = "") -> Counter:
+        m = Counter(f"{self.namespace}_{subsystem}_{name}", help_)
+        with self._mtx:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, subsystem: str, name: str, help_: str = "") -> Gauge:
+        m = Gauge(f"{self.namespace}_{subsystem}_{name}", help_)
+        with self._mtx:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, subsystem: str, name: str, help_: str = "", buckets=None) -> Histogram:
+        m = Histogram(f"{self.namespace}_{subsystem}_{name}", help_, buckets)
+        with self._mtx:
+            self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        with self._mtx:
+            lines: List[str] = []
+            for m in self._metrics:
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class ConsensusMetrics:
+    """internal/consensus/metrics.go:19+ — the consensus metric set."""
+
+    def __init__(self, registry: Registry):
+        self.height = registry.gauge("consensus", "height", "Height of the chain.")
+        self.rounds = registry.gauge("consensus", "rounds", "Round of the chain.")
+        self.validators = registry.gauge("consensus", "validators", "Number of validators.")
+        self.validators_power = registry.gauge(
+            "consensus", "validators_power", "Total power of all validators."
+        )
+        self.missing_validators = registry.gauge(
+            "consensus", "missing_validators", "Validators missing from the last commit."
+        )
+        self.byzantine_validators = registry.gauge(
+            "consensus", "byzantine_validators", "Validators that equivocated."
+        )
+        self.block_interval_seconds = registry.histogram(
+            "consensus", "block_interval_seconds", "Time between this and the last block."
+        )
+        self.num_txs = registry.gauge("consensus", "num_txs", "Txs in the latest block.")
+        self.total_txs = registry.counter("consensus", "total_txs", "Total txs committed.")
+        self.block_size_bytes = registry.gauge(
+            "consensus", "block_size_bytes", "Size of the latest block."
+        )
+
+
+class MetricsServer:
+    """The instrumentation scrape endpoint (config.instrumentation)."""
+
+    def __init__(self, registry: Registry, laddr: str):
+        addr = laddr.replace("tcp://", "")
+        host, _, port = addr.rpartition(":")
+
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: A003
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = reg.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+
+    @property
+    def listen_addr(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

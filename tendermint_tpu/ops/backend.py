@@ -36,6 +36,12 @@ BUCKETS = (128, 1024, 10240)
 # reference's batchVerifyThreshold (types/validation.go:12) at device scale.
 DEVICE_THRESHOLD = int(os.environ.get("TM_TPU_DEVICE_THRESHOLD", "64"))
 
+# Messages up to this size hash on-device (R||A||M padded buffers);
+# longer messages fall back to host hashlib for the challenge scalar.
+# 192 covers canonical vote sign-bytes (~120B + 50-char chain ids).
+DEVICE_HASH_MAX_MSG = int(os.environ.get("TM_TPU_DEVICE_HASH_MAX_MSG", "192"))
+HOST_HASH = bool(int(os.environ.get("TM_TPU_HOST_HASH", "0")))
+
 _L_BYTES = L.to_bytes(32, "little")
 
 
@@ -102,15 +108,61 @@ def prepare_batch(
     )
 
 
+def prepare_batch_device_hash(
+    entries: List[Tuple[bytes, bytes, bytes]], bucket: int
+) -> tuple:
+    """Device-hash argument prep: no host SHA-512 — messages ship as padded
+    R||A||M SHA blocks."""
+    from . import sha512 as _sha
+
+    n = len(entries)
+    pub = np.zeros((bucket, 32), dtype=np.uint8)
+    r_enc = np.zeros((bucket, 32), dtype=np.uint8)
+    s_enc = np.zeros((bucket, 32), dtype=np.uint8)
+    s_ok = np.zeros((bucket,), dtype=bool)
+    pub[n:, 0] = 1
+    r_enc[n:, 0] = 1
+    s_ok[n:] = True
+    msgs = []
+    for i, (pk, msg, sig) in enumerate(entries):
+        pub[i] = np.frombuffer(pk, dtype=np.uint8)
+        r_enc[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        s_enc[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+        s_ok[i] = int.from_bytes(sig[32:], "little") < L
+        msgs.append(sig[:32] + pk + msg)
+    msgs += [b"\x01" + bytes(31) + b"\x01" + bytes(31)] * (bucket - n)
+    hi, lo, counts = _sha.pad_messages(msgs, 64 + DEVICE_HASH_MAX_MSG)
+    a_sign = (pub[:, 31] >> 7).astype(np.int32)
+    r_sign = (r_enc[:, 31] >> 7).astype(np.int32)
+    return (
+        _pack_le_limbs(pub),
+        a_sign,
+        _pack_le_limbs(r_enc),
+        r_sign,
+        _bits_253(s_enc),
+        hi,
+        lo,
+        counts,
+        s_ok,
+    )
+
+
 def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """Run the device kernel over arbitrary batch size; returns (n,) bool."""
-    kern = ed25519_verify.jitted_verify()
+    device_hash = not HOST_HASH and all(
+        len(m) <= DEVICE_HASH_MAX_MSG for _, m, _ in entries
+    )
     out: List[np.ndarray] = []
     i = 0
     while i < len(entries):
         chunk = entries[i : i + BUCKETS[-1]]
         bucket = _bucket_for(len(chunk))
-        args = prepare_batch(chunk, bucket)
+        if device_hash:
+            kern = ed25519_verify.jitted_verify_device_hash()
+            args = prepare_batch_device_hash(chunk, bucket)
+        else:
+            kern = ed25519_verify.jitted_verify()
+            args = prepare_batch(chunk, bucket)
         res = np.asarray(kern(*args))[: len(chunk)]
         out.append(res)
         i += len(chunk)

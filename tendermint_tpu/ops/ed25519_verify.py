@@ -170,6 +170,26 @@ def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok):
     return ok_a & ok_r & s_ok & is_ident
 
 
+def verify_kernel_device_hash(
+    a_y, a_sign, r_y, r_sign, s_bits_t, blocks_hi, blocks_lo, n_blocks, s_ok
+):
+    """Fully-device path: the challenge k = SHA512(R||A||M) mod L is
+    computed on-chip (ops.sha512 + ops.sc) before the ladder — no host
+    hashing in the hot loop (SURVEY.md §7 hard-part #2 resolved on
+    device)."""
+    from . import sc, sha512 as _sha
+
+    digest = _sha.sha512_blocks(blocks_hi, blocks_lo, n_blocks)
+    k_limbs = sc.mod_l_from_bits(sc.digest_to_le_bits(digest))
+    k_bits_t = sc.limbs_to_bits(k_limbs, SCALAR_BITS)
+    return verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok)
+
+
 @functools.lru_cache(maxsize=None)
 def jitted_verify(donate: bool = False):
     return jax.jit(verify_kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_verify_device_hash():
+    return jax.jit(verify_kernel_device_hash)

@@ -1,0 +1,78 @@
+"""Scalar arithmetic mod L = 2^252 + 27742317777372353535851937790883648493.
+
+Device-side reduction of the 512-bit SHA-512 challenge digest to
+k = digest mod L, producing the ladder's bit array. Uses bitwise Horner
+(acc = 2*acc + bit, conditional subtract L) over the same 13-bit limb
+machinery as fe.py — 512 cheap vector steps, negligible next to the EC
+ladder.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto._edwards import L
+from . import fe
+
+L_LIMBS = jnp.asarray(fe.limbs_raw(L))
+
+
+def _cond_sub_l(x):
+    """x - L if x >= L else x (x < 2L, canonical-ish limbs)."""
+    d = x - L_LIMBS
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(fe.NLIMBS):
+        t = d[..., i] + c
+        c = t >> fe.RADIX
+        out.append(t & fe.MASK)
+    t = jnp.stack(out, axis=-1)
+    keep = (c < 0)[..., None]
+    return jnp.where(keep, x, t)
+
+
+def mod_l_from_bits(bits_t):
+    """bits_t: (NBITS, B) int32, MSB-last indexing (bit i = weight 2^i).
+    Returns k mod L as (B, 20) canonical limbs."""
+    nbits = bits_t.shape[0]
+    bsz = bits_t.shape[1]
+    acc0 = jnp.zeros((bsz, fe.NLIMBS), dtype=jnp.int32)
+
+    def body_fixed(i, acc):
+        bit = lax.dynamic_index_in_dim(bits_t, nbits - 1 - i, 0, keepdims=False)
+        doubled = acc + acc
+        doubled = jnp.concatenate(
+            [(doubled[..., :1] + bit[..., None]), doubled[..., 1:]], axis=-1
+        )
+        x = fe.carry(doubled)
+        return _cond_sub_l(x)
+
+    return lax.fori_loop(0, nbits, body_fixed, acc0)
+
+
+def limbs_to_bits(limbs, nbits: int):
+    """(B, 20) canonical limbs -> (nbits, B) int32 bit array (LSB-first)."""
+    shifts = jnp.arange(fe.RADIX, dtype=jnp.int32)
+    bits = (limbs[..., :, None] >> shifts) & 1  # (B, 20, 13)
+    flat = bits.reshape(bits.shape[:-2] + (fe.NLIMBS * fe.RADIX,))
+    return jnp.transpose(flat[..., :nbits])
+
+
+def digest_to_le_bits(digest):
+    """(B, 8, 2) uint32 SHA-512 digest words -> (512, B) int32 bits of the
+    little-endian 512-bit integer (RFC 8032 scalar interpretation)."""
+    hi = digest[..., 0]  # (B, 8) big-endian word halves
+    lo = digest[..., 1]
+    # bytes of each 64-bit word, big-endian: hi b0..b3, lo b0..b3
+    parts = []
+    for half in (hi, lo):
+        for shift in (24, 16, 8, 0):
+            parts.append(((half >> shift) & 0xFF).astype(jnp.int32))  # (B, 8)
+    # parts[p][:, w] = byte (8*w + p); LE integer byte index = 8*w + p
+    byte_mat = jnp.stack(parts, axis=-1)  # (B, 8, 8): [b, word, byte-in-word]
+    bytes_flat = byte_mat.reshape(byte_mat.shape[0], 64)  # (B, 64) LE order
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    bits = (bytes_flat[..., None] >> shifts) & 1  # (B, 64, 8) LSB-first
+    return jnp.transpose(bits.reshape(bits.shape[0], 512))

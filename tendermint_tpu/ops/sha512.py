@@ -1,0 +1,252 @@
+"""Batched SHA-512 on device (SURVEY.md §7 hard-part #2).
+
+The ed25519 challenge hash k = SHA512(R || A || M) runs on 32-bit lanes:
+each 64-bit word is an (hi, lo) uint32 pair; rotations and the Σ/σ
+schedules decompose into 32-bit shifts with cross-word carries; additions
+ripple the carry via an unsigned compare. Messages are host-padded into
+fixed NBLOCK buffers; a per-message block count selects the right digest
+state from the scanned per-block states (branchless variable length).
+
+Matches hashlib.sha512 bit-for-bit (differentially tested).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+# Round constants (FIPS 180-4) as (hi, lo) uint32 pairs.
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_K_HI = jnp.asarray([(k >> 32) & 0xFFFFFFFF for k in _K], dtype=jnp.uint32)
+_K_LO = jnp.asarray([k & 0xFFFFFFFF for k in _K], dtype=jnp.uint32)
+
+_IV = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    hi = ah + bh + carry
+    return hi, lo
+
+
+def _ror64(h, l, n: int):
+    n %= 64
+    if n == 0:
+        return h, l
+    if n < 32:
+        nh = (h >> n) | (l << (32 - n))
+        nl = (l >> n) | (h << (32 - n))
+        return nh, nl
+    if n == 32:
+        return l, h
+    m = n - 32
+    nh = (l >> m) | (h << (32 - m))
+    nl = (h >> m) | (l << (32 - m))
+    return nh, nl
+
+
+def _shr64(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _big_sigma0(h, l):
+    a = _ror64(h, l, 28)
+    b = _ror64(h, l, 34)
+    c = _ror64(h, l, 39)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _big_sigma1(h, l):
+    a = _ror64(h, l, 14)
+    b = _ror64(h, l, 18)
+    c = _ror64(h, l, 41)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _small_sigma0(h, l):
+    a = _ror64(h, l, 1)
+    b = _ror64(h, l, 8)
+    c = _shr64(h, l, 7)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _small_sigma1(h, l):
+    a = _ror64(h, l, 19)
+    b = _ror64(h, l, 61)
+    c = _shr64(h, l, 6)
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _compress_block(state, block_hi, block_lo):
+    """One SHA-512 compression: state (8,2)x(B,), block (B,16) hi/lo."""
+    # message schedule as a rolling 16-word window inside a fori_loop
+    w_hi = block_hi  # (B, 16)
+    w_lo = block_lo
+
+    hs = [state[i][0] for i in range(8)]
+    ls = [state[i][1] for i in range(8)]
+    a_h, b_h, c_h, d_h, e_h, f_h, g_h, h_h = hs
+    a_l, b_l, c_l, d_l, e_l, f_l, g_l, h_l = ls
+
+    def round_body(t, carry):
+        (a_h, a_l, b_h, b_l, c_h, c_l, d_h, d_l,
+         e_h, e_l, f_h, f_l, g_h, g_l, h_h, h_l, w_hi, w_lo) = carry
+        idx = t % 16
+        wt_h = lax.dynamic_index_in_dim(w_hi, idx, 1, keepdims=False)
+        wt_l = lax.dynamic_index_in_dim(w_lo, idx, 1, keepdims=False)
+
+        s1 = _big_sigma1(e_h, e_l)
+        ch_h = (e_h & f_h) ^ (~e_h & g_h)
+        ch_l = (e_l & f_l) ^ (~e_l & g_l)
+        kt_h = _K_HI[t]
+        kt_l = _K_LO[t]
+        t1 = _add64(h_h, h_l, *s1)
+        t1 = _add64(*t1, ch_h, ch_l)
+        t1 = _add64(*t1, jnp.broadcast_to(kt_h, h_h.shape), jnp.broadcast_to(kt_l, h_l.shape))
+        t1 = _add64(*t1, wt_h, wt_l)
+        s0 = _big_sigma0(a_h, a_l)
+        maj_h = (a_h & b_h) ^ (a_h & c_h) ^ (b_h & c_h)
+        maj_l = (a_l & b_l) ^ (a_l & c_l) ^ (b_l & c_l)
+        t2 = _add64(*s0, maj_h, maj_l)
+
+        new_e = _add64(d_h, d_l, *t1)
+        new_a = _add64(*t1, *t2)
+
+        # schedule update for w[t+16]: uses w[t], w[t+1], w[t+9], w[t+14]
+        w1_h = lax.dynamic_index_in_dim(w_hi, (t + 1) % 16, 1, keepdims=False)
+        w1_l = lax.dynamic_index_in_dim(w_lo, (t + 1) % 16, 1, keepdims=False)
+        w9_h = lax.dynamic_index_in_dim(w_hi, (t + 9) % 16, 1, keepdims=False)
+        w9_l = lax.dynamic_index_in_dim(w_lo, (t + 9) % 16, 1, keepdims=False)
+        w14_h = lax.dynamic_index_in_dim(w_hi, (t + 14) % 16, 1, keepdims=False)
+        w14_l = lax.dynamic_index_in_dim(w_lo, (t + 14) % 16, 1, keepdims=False)
+        nw = _add64(wt_h, wt_l, *_small_sigma0(w1_h, w1_l))
+        nw = _add64(*nw, w9_h, w9_l)
+        nw = _add64(*nw, *_small_sigma1(w14_h, w14_l))
+        w_hi = lax.dynamic_update_index_in_dim(w_hi, nw[0], idx, 1)
+        w_lo = lax.dynamic_update_index_in_dim(w_lo, nw[1], idx, 1)
+
+        return (new_a[0], new_a[1], a_h, a_l, b_h, b_l, c_h, c_l,
+                new_e[0], new_e[1], e_h, e_l, f_h, f_l, g_h, g_l, w_hi, w_lo)
+
+    carry = (a_h, a_l, b_h, b_l, c_h, c_l, d_h, d_l,
+             e_h, e_l, f_h, f_l, g_h, g_l, h_h, h_l, w_hi, w_lo)
+    carry = lax.fori_loop(0, 80, round_body, carry)
+    out_vals = carry[:16]
+    new_state = []
+    for i in range(8):
+        nh, nl = _add64(hs[i], ls[i], out_vals[2 * i], out_vals[2 * i + 1])
+        new_state.append((nh, nl))
+    return new_state
+
+
+def sha512_blocks(blocks_hi, blocks_lo, n_blocks):
+    """Batched SHA-512 over pre-padded messages.
+
+    blocks_hi/lo: (B, NBLOCK, 16) uint32 big-endian word halves.
+    n_blocks:     (B,) int32 actual block count per message (>= 1).
+    Returns (B, 8, 2) uint32 digest words (hi, lo).
+    """
+    bsz = blocks_hi.shape[0]
+    nblock = blocks_hi.shape[1]
+    state = [
+        (
+            jnp.full((bsz,), (iv >> 32) & 0xFFFFFFFF, dtype=jnp.uint32),
+            jnp.full((bsz,), iv & 0xFFFFFFFF, dtype=jnp.uint32),
+        )
+        for iv in _IV
+    ]
+    digest_h = jnp.stack([s[0] for s in state], axis=1)  # (B, 8)
+    digest_l = jnp.stack([s[1] for s in state], axis=1)
+
+    for blk in range(nblock):
+        new_state = _compress_block(
+            [(digest_h[:, i], digest_l[:, i]) for i in range(8)],
+            blocks_hi[:, blk],
+            blocks_lo[:, blk],
+        )
+        nh = jnp.stack([s[0] for s in new_state], axis=1)
+        nl = jnp.stack([s[1] for s in new_state], axis=1)
+        # only advance the state for messages that still have blocks left
+        active = (n_blocks > blk)[:, None]
+        digest_h = jnp.where(active, nh, digest_h)
+        digest_l = jnp.where(active, nl, digest_l)
+
+    return jnp.stack([digest_h, digest_l], axis=-1)  # (B, 8, 2)
+
+
+def pad_messages(msgs, max_len: int):
+    """Host-side padding: list of bytes -> (B, NBLOCK, 16) uint32 hi/lo +
+    (B,) block counts. max_len bounds the unpadded message length."""
+    nblock = (max_len + 17 + 127) // 128
+    bsz = len(msgs)
+    buf = np.zeros((bsz, nblock * 128), dtype=np.uint8)
+    counts = np.zeros((bsz,), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        if len(m) > max_len:
+            raise ValueError(f"message too long: {len(m)} > {max_len}")
+        total = len(m) + 17  # 0x80 + 16-byte length
+        blocks = (total + 127) // 128
+        counts[i] = blocks
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        buf[i, len(m)] = 0x80
+        bitlen = len(m) * 8
+        buf[i, blocks * 128 - 8 : blocks * 128] = np.frombuffer(
+            bitlen.to_bytes(8, "big"), dtype=np.uint8
+        )
+    words = buf.reshape(bsz, nblock, 16, 8)
+    hi = (
+        (words[..., 0].astype(np.uint32) << 24)
+        | (words[..., 1].astype(np.uint32) << 16)
+        | (words[..., 2].astype(np.uint32) << 8)
+        | words[..., 3].astype(np.uint32)
+    )
+    lo = (
+        (words[..., 4].astype(np.uint32) << 24)
+        | (words[..., 5].astype(np.uint32) << 16)
+        | (words[..., 6].astype(np.uint32) << 8)
+        | words[..., 7].astype(np.uint32)
+    )
+    return hi, lo, counts
+
+
+def digest_to_bytes(digest) -> np.ndarray:
+    """(B, 8, 2) uint32 -> (B, 64) uint8 big-endian digests (host)."""
+    d = np.asarray(digest)
+    bsz = d.shape[0]
+    out = np.zeros((bsz, 64), dtype=np.uint8)
+    for w in range(8):
+        for half, col in ((0, 0), (1, 4)):
+            v = d[:, w, half]
+            out[:, 8 * w + col + 0] = (v >> 24) & 0xFF
+            out[:, 8 * w + col + 1] = (v >> 16) & 0xFF
+            out[:, 8 * w + col + 2] = (v >> 8) & 0xFF
+            out[:, 8 * w + col + 3] = v & 0xFF
+    return out

@@ -1,0 +1,92 @@
+"""PEX — peer-exchange reactor.
+
+Reference parity: internal/p2p/pex/reactor.go — channel 0x00; periodically
+requests peer addresses from connected peers and feeds responses into the
+PeerManager's address book; answers requests with its own known peers.
+
+Wire: 1 pex_request{} | 2 pex_response{1 addresses(repeated msg{1 id, 2 addr})}
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..wire.proto import ProtoWriter, decode_message, field_bytes
+from .conn.mconnection import ChannelDescriptor
+from .peermanager import PeerAddress, PeerManager
+from .router import Router
+
+PEX_CHANNEL = 0x00
+PEX_DESC = ChannelDescriptor(id=PEX_CHANNEL, priority=1, send_queue_capacity=10)
+
+_REQUEST_INTERVAL = 5.0
+_MAX_ADDRESSES = 100
+
+
+def _encode_response(pairs) -> bytes:
+    w = ProtoWriter()
+    inner = ProtoWriter()
+    for node_id, addr in pairs:
+        e = ProtoWriter()
+        e.write_string(1, node_id)
+        e.write_string(2, addr)
+        inner.write_message(1, e.bytes(), always=True)
+    w.write_message(2, inner.bytes(), always=True)
+    return w.bytes()
+
+
+def _encode_request() -> bytes:
+    w = ProtoWriter()
+    w.write_message(1, b"", always=True)
+    return w.bytes()
+
+
+class PexReactor:
+    def __init__(self, router: Router, peer_manager: PeerManager):
+        self._router = router
+        self._pm = peer_manager
+        self._ch = router.open_channel(PEX_DESC)
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        for fn in (self._recv_loop, self._request_loop):
+            threading.Thread(target=fn, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _request_loop(self) -> None:
+        while not self._stopped.is_set():
+            self._ch.broadcast(_encode_request())
+            time.sleep(_REQUEST_INTERVAL)
+
+    def _recv_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                env = self._ch.receive(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                f = decode_message(env.message)
+            except ValueError:
+                continue
+            if 1 in f:  # request: answer with known addresses
+                pairs = []
+                for nid in self._pm.peers()[:_MAX_ADDRESSES]:
+                    for addr in self._pm.addresses(nid)[:1]:
+                        pairs.append((nid, addr))
+                self._ch.send(env.from_id, _encode_response(pairs))
+            elif 2 in f:  # response: absorb addresses
+                inner = decode_message(field_bytes(f, 2))
+                for _, raw in inner.get(1, []):
+                    e = decode_message(raw)
+                    nid = field_bytes(e, 1).decode()
+                    addr = field_bytes(e, 2).decode()
+                    if nid and addr:
+                        try:
+                            self._pm.add_address(PeerAddress(nid, addr))
+                        except ValueError:
+                            continue

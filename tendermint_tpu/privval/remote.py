@@ -1,0 +1,193 @@
+"""Remote signer — privval over a socket.
+
+Reference parity: privval/signer_client.go + signer_listener_endpoint.go +
+signer_dialer_endpoint.go and privval/grpc: the node listens (or dials),
+the signer process holds the key and answers PubKey/SignVote/SignProposal
+requests; privval/retry_signer_client.go wraps with retries.
+
+Wire (privval/types.pb.go Message oneof, uvarint-delimited):
+  1 pub_key_request{1 chain_id} | 2 pub_key_response{1 pub_key_bytes, 2 error}
+  3 sign_vote_request{1 vote, 2 chain_id} | 4 signed_vote_response{1 sig, 2 error}
+  5 sign_proposal_request{1 proposal, 2 chain_id}
+  | 6 signed_proposal_response{1 sig, 2 error} | 7 ping_request{} | 8 ping_response{}
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..crypto import PubKey, ed25519
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..wire.proto import (
+    ProtoWriter,
+    decode_message,
+    field_bytes,
+    marshal_delimited,
+    unmarshal_delimited,
+)
+from . import FilePV, PrivValidator
+
+
+class RemoteSignerError(RuntimeError):
+    pass
+
+
+def _msg(kind: int, fields: dict) -> bytes:
+    inner = ProtoWriter()
+    for num, val in sorted(fields.items()):
+        if isinstance(val, bytes):
+            inner.write_bytes(num, val)
+        elif isinstance(val, str):
+            inner.write_string(num, val)
+        else:
+            inner.write_varint(num, val)
+    w = ProtoWriter()
+    w.write_message(kind, inner.bytes(), always=True)
+    return marshal_delimited(w.bytes())
+
+
+def _read_msg(sock: socket.socket, buf: bytes):
+    while True:
+        try:
+            msg, consumed = unmarshal_delimited(buf)
+            return msg, buf[consumed:]
+        except ValueError:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("remote signer connection closed")
+            buf += chunk
+
+
+class SignerServer:
+    """The signer process side (tools/tm-signer-harness subject): holds a
+    FilePV and serves signing requests; dials the node's listen address
+    (SignerDialerEndpoint pattern)."""
+
+    def __init__(self, pv: FilePV, address: str):
+        self._pv = pv
+        self._address = address
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                host, _, port = self._address.replace("tcp://", "").rpartition(":")
+                sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=5)
+                sock.settimeout(1.0)
+                self._serve(sock)
+            except (OSError, ConnectionError):
+                time.sleep(0.2)
+
+    def _serve(self, sock: socket.socket) -> None:
+        buf = b""
+        while not self._stopped.is_set():
+            try:
+                msg, buf = _read_msg(sock, buf)
+            except socket.timeout:
+                continue
+            f = decode_message(msg)
+            if 1 in f:  # pub_key_request
+                pk = self._pv.get_pub_key()
+                sock.sendall(_msg(2, {1: pk.bytes()}))
+            elif 3 in f:  # sign_vote_request
+                r = decode_message(field_bytes(f, 3))
+                vote = Vote.decode(field_bytes(r, 1))
+                chain_id = field_bytes(r, 2).decode()
+                try:
+                    sig = self._pv.sign_vote(chain_id, vote)
+                    sock.sendall(_msg(4, {1: sig}))
+                except ValueError as e:
+                    sock.sendall(_msg(4, {2: str(e)}))
+            elif 5 in f:  # sign_proposal_request
+                r = decode_message(field_bytes(f, 5))
+                proposal = Proposal.decode(field_bytes(r, 1))
+                chain_id = field_bytes(r, 2).decode()
+                try:
+                    sig = self._pv.sign_proposal(chain_id, proposal)
+                    sock.sendall(_msg(6, {1: sig}))
+                except ValueError as e:
+                    sock.sendall(_msg(6, {2: str(e)}))
+            elif 7 in f:  # ping
+                sock.sendall(_msg(8, {}))
+
+
+class SignerClient(PrivValidator):
+    """The node side (SignerListenerEndpoint + SignerClient): listens for
+    the signer's dial-in, then forwards signing requests."""
+
+    def __init__(self, listen_addr: str, timeout: float = 10.0):
+        host, _, port = listen_addr.replace("tcp://", "").rpartition(":")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host or "127.0.0.1", int(port)))
+        self._listener.listen(1)
+        h, p = self._listener.getsockname()
+        self.listen_addr = f"tcp://{h}:{p}"
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._mtx = threading.Lock()
+
+    def _ensure_conn(self) -> socket.socket:
+        if self._sock is None:
+            self._listener.settimeout(self._timeout)
+            sock, _ = self._listener.accept()
+            sock.settimeout(self._timeout)
+            self._sock = sock
+        return self._sock
+
+    def _round_trip(self, request: bytes, want_field: int) -> bytes:
+        with self._mtx:
+            for attempt in range(2):
+                sock = self._ensure_conn()
+                try:
+                    sock.sendall(request)
+                    msg, self._buf = _read_msg(sock, self._buf)
+                    break
+                except (OSError, ConnectionError):
+                    self._sock = None
+                    self._buf = b""
+                    if attempt == 1:
+                        raise RemoteSignerError("remote signer unreachable")
+        f = decode_message(msg)
+        if want_field not in f:
+            raise RemoteSignerError(f"unexpected response {list(f)}")
+        r = decode_message(field_bytes(f, want_field))
+        err = field_bytes(r, 2)
+        if err:
+            raise ValueError(err.decode())
+        return field_bytes(r, 1)
+
+    def get_pub_key(self) -> PubKey:
+        raw = self._round_trip(_msg(1, {1: ""}), 2)
+        return ed25519.PubKey(raw)
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+        return self._round_trip(
+            _msg(3, {1: vote.encode(), 2: chain_id}), 4
+        )
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> bytes:
+        return self._round_trip(
+            _msg(5, {1: proposal.encode(), 2: chain_id}), 6
+        )
+
+    def ping(self) -> None:
+        self._round_trip(_msg(7, {}), 8)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+        self._listener.close()

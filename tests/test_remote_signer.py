@@ -1,0 +1,72 @@
+"""Remote signer conformance (privval socket + the signer-harness checks).
+
+Mirrors tools/tm-signer-harness: pubkey match, vote/proposal signing,
+double-sign rejection through the remote channel."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.remote import RemoteSignerError, SignerClient, SignerServer
+from tendermint_tpu.types import Timestamp, Vote
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+CHAIN = "remote-chain"
+
+
+@pytest.fixture
+def signer_pair():
+    pv = FilePV(ed25519.gen_priv_key(bytes([8]) * 32))
+    client = SignerClient("tcp://127.0.0.1:0", timeout=10.0)
+    server = SignerServer(pv, client.listen_addr)
+    server.start()
+    yield pv, client
+    server.stop()
+    client.close()
+
+
+def _vote(height, round_, t=PREVOTE_TYPE):
+    bid = BlockID(hash=b"\x01" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x01" * 32))
+    return Vote(
+        type=t,
+        height=height,
+        round=round_,
+        block_id=bid,
+        timestamp=Timestamp(seconds=100),
+        validator_address=b"\x02" * 20,
+        validator_index=0,
+    )
+
+
+class TestRemoteSigner:
+    def test_pubkey_and_signing(self, signer_pair):
+        pv, client = signer_pair
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+        v = _vote(5, 0)
+        sig = client.sign_vote(CHAIN, v)
+        assert pv.get_pub_key().verify_signature(v.sign_bytes(CHAIN), sig)
+        p = Proposal(
+            height=6, round=0, pol_round=-1,
+            block_id=_vote(6, 0).block_id, timestamp=Timestamp(seconds=120),
+        )
+        psig = client.sign_proposal(CHAIN, p)
+        assert pv.get_pub_key().verify_signature(p.sign_bytes(CHAIN), psig)
+        client.ping()
+
+    def test_double_sign_rejected_via_remote(self, signer_pair):
+        pv, client = signer_pair
+        v1 = _vote(7, 0)
+        client.sign_vote(CHAIN, v1)
+        # same HRS, different block -> conflicting data error over the wire
+        bid2 = BlockID(hash=b"\x03" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x03" * 32))
+        v2 = Vote(**{**v1.__dict__, "block_id": bid2})
+        with pytest.raises(ValueError, match="conflicting data"):
+            client.sign_vote(CHAIN, v2)
+        # height regression also rejected
+        with pytest.raises(ValueError):
+            client.sign_vote(CHAIN, _vote(6, 0))
