@@ -68,9 +68,60 @@ class ConsensusReactor:
             t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
             t.start()
             self._threads.append(t)
+        t = threading.Thread(target=self._gossip_routine, daemon=True)
+        t.start()
+        self._threads.append(t)
 
     def stop(self) -> None:
         self._stopped.set()
+
+    # -- catchup gossip (reactor.go:503 gossipDataRoutine + :715
+    # gossipVotesRoutine, mesh-rebroadcast variant): periodically re-send
+    # the current round's proposal/parts/votes and the last commit's
+    # precommits so peers that missed messages (disconnect, late join,
+    # round skew) converge; receivers dedup, so this is idempotent. --------
+
+    GOSSIP_INTERVAL = 0.3
+
+    def _gossip_routine(self) -> None:
+        import time as _t
+
+        while not self._stopped.is_set():
+            _t.sleep(self.GOSSIP_INTERVAL)
+            try:
+                self._gossip_once()
+            except Exception:  # noqa: BLE001 — gossip must never die
+                continue
+
+    def _gossip_once(self) -> None:
+        rs = self._cs.rs
+        if rs.proposal is not None:
+            w = ProtoWriter()
+            w.write_message(1, rs.proposal.encode(), always=True)
+            self._data_ch.broadcast(w.bytes())
+        parts = rs.proposal_block_parts
+        if parts is not None:
+            for i in range(parts.total()):
+                p = parts.get_part(i)
+                if p is not None:
+                    w = ProtoWriter()
+                    w.write_message(
+                        2, _encode_block_part(rs.height, rs.round, p), always=True
+                    )
+                    self._data_ch.broadcast(w.bytes())
+        votes = []
+        hvs = rs.votes
+        if hvs is not None:
+            for r in {max(rs.round - 1, 0), rs.round}:
+                for vs in (hvs.prevotes(r), hvs.precommits(r)):
+                    if vs is not None:
+                        votes.extend(v for v in vs.votes if v is not None)
+        if rs.last_commit is not None:
+            votes.extend(v for v in rs.last_commit.votes if v is not None)
+        for v in votes:
+            w = ProtoWriter()
+            w.write_message(1, v.encode(), always=True)
+            self._vote_ch.broadcast(w.bytes())
 
     # -- outbound -------------------------------------------------------
 

@@ -1,30 +1,107 @@
-"""sr25519 (Schnorr over ristretto255, schnorrkel flavor).
+"""sr25519 — Schnorr signatures over ristretto255 (schnorrkel flavor).
 
-Reference parity: crypto/sr25519/ — pubkey/privkey/batch verifier backed by
-curve25519-voi's schnorrkel implementation. Signing context is the
-schnorrkel default "substrate" context used by the reference
-(crypto/sr25519/signature.go).
-
-Status: key container + address/type plumbing are complete (enough for
-encoding, validator sets and config); sign/verify land with the
-ristretto255 + merlin transcript implementation (tracked in README
-roadmap). Verification raises rather than returning False so nothing can
-silently treat unimplemented crypto as an invalid-signature result.
+Reference parity: crypto/sr25519/ backed by curve25519-voi's schnorrkel:
+  - PrivKey is a 32-byte MiniSecretKey, expanded ExpandEd25519-style
+    (SHA-512, ed25519 clamping, divide-by-cofactor) to (scalar, nonce)
+  - signing context is "substrate" (crypto/sr25519/signature.go)
+  - transcript protocol: merlin "SigningContext" / "Schnorr-sig" framing
+  - signatures are R || s with the schnorrkel v1 marker bit (s[31] |= 0x80)
+  - verification: R == [s]B - [k]A with k = transcript challenge
+Batch verification is per-signature here (semantically identical to the
+RLC batch, which falls back per-sig on failure anyway — mirrors the
+ed25519 device-engine decision in ops/ed25519_verify.py).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+from typing import List, Optional, Tuple
 
+from . import BatchVerifier as _BatchVerifier
 from . import PrivKey as _PrivKey, PubKey as _PubKey, address_hash, register_key_type
+from . import _merlin, _ristretto as R
 
 KEY_TYPE = "sr25519"
 PUB_KEY_SIZE = 32
-PRIV_KEY_SIZE = 32
+PRIV_KEY_SIZE = 32  # MiniSecretKey
 SIGNATURE_SIZE = 64
 
 PUB_KEY_NAME = "tendermint/PubKeySr25519"
 PRIV_KEY_NAME = "tendermint/PrivKeySr25519"
+
+SIGNING_CTX = b"substrate"
+
+L = R.L
+
+
+def _expand_ed25519(mini: bytes) -> Tuple[int, bytes]:
+    """MiniSecretKey.ExpandEd25519: (scalar, nonce)."""
+    h = hashlib.sha512(mini).digest()
+    key = bytearray(h[:32])
+    key[0] &= 248
+    key[31] &= 63
+    key[31] |= 64
+    # divide by cofactor: right-shift the 256-bit LE integer by 3
+    scalar = int.from_bytes(bytes(key), "little") >> 3
+    return scalar % L, h[32:]
+
+
+def _signing_transcript(msg: bytes) -> "_merlin.Transcript":
+    t = _merlin.Transcript(b"SigningContext")
+    t.append_message(b"", SIGNING_CTX)
+    t.append_message(b"sign-bytes", msg)
+    return t
+
+
+def _challenge_scalar(t: "_merlin.Transcript", label: bytes) -> int:
+    return int.from_bytes(t.challenge_bytes(label, 64), "little") % L
+
+
+def sign(mini: bytes, msg: bytes) -> bytes:
+    scalar, nonce = _expand_ed25519(mini)
+    pub_pt = R.scalar_mult(scalar, R.BASE)
+    pub = R.encode(pub_pt)
+    t = _signing_transcript(msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    r = int.from_bytes(t.witness_bytes(b"signing", [nonce], 64), "little") % L
+    r_enc = R.encode(R.scalar_mult(r, R.BASE))
+    t.append_message(b"sign:R", r_enc)
+    k = _challenge_scalar(t, b"sign:c")
+    s = (k * scalar + r) % L
+    sig = bytearray(r_enc + s.to_bytes(32, "little"))
+    sig[63] |= 0x80  # schnorrkel v1 marker
+    return bytes(sig)
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    if len(sig) != SIGNATURE_SIZE or len(pub) != PUB_KEY_SIZE:
+        return False
+    if not (sig[63] & 0x80):
+        return False  # not a schnorrkel v1 signature
+    a_pt = R.decode(pub)
+    if a_pt is None:
+        return False
+    r_bytes = sig[:32]
+    r_pt = R.decode(r_bytes)
+    if r_pt is None:
+        return False
+    s_bytes = bytearray(sig[32:])
+    s_bytes[31] &= 0x7F
+    s = int.from_bytes(bytes(s_bytes), "little")
+    if s >= L:
+        return False
+    t = _signing_transcript(msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    t.append_message(b"sign:R", r_bytes)
+    k = _challenge_scalar(t, b"sign:c")
+    # R == [s]B - [k]A
+    sb = R.scalar_mult(s, R.BASE)
+    ka = R.scalar_mult(k, a_pt)
+    expected = R.add(sb, R.neg(ka))
+    return R.equals(expected, r_pt)
 
 
 class PubKey(_PubKey):
@@ -42,7 +119,7 @@ class PubKey(_PubKey):
         return self._bytes
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        raise NotImplementedError("sr25519 verification not yet implemented")
+        return verify(self._bytes, msg, sig)
 
     def type(self) -> str:
         return KEY_TYPE
@@ -57,16 +134,35 @@ class PrivKey(_PrivKey):
         self._bytes = bytes(data)
 
     def sign(self, msg: bytes) -> bytes:
-        raise NotImplementedError("sr25519 signing not yet implemented")
+        return sign(self._bytes, msg)
 
     def pub_key(self) -> PubKey:
-        raise NotImplementedError("sr25519 key derivation not yet implemented")
+        scalar, _ = _expand_ed25519(self._bytes)
+        return PubKey(R.encode(R.scalar_mult(scalar, R.BASE)))
 
     def bytes(self) -> bytes:
         return self._bytes
 
     def type(self) -> str:
         return KEY_TYPE
+
+
+class BatchVerifier(_BatchVerifier):
+    """crypto/sr25519/batch.go:13-19 semantics (per-sig evaluation)."""
+
+    def __init__(self):
+        self._entries: List[Tuple[bytes, bytes, bytes]] = []
+
+    def add(self, key, msg: bytes, sig: bytes) -> None:
+        if not isinstance(key, PubKey):
+            raise TypeError("pubkey is not sr25519")
+        if len(sig) != SIGNATURE_SIZE:
+            raise ValueError("invalid signature length")
+        self._entries.append((key.bytes(), msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        valid = [verify(p, m, s) for p, m, s in self._entries]
+        return all(valid) and len(valid) > 0, valid
 
 
 def gen_priv_key(seed: bytes | None = None) -> PrivKey:

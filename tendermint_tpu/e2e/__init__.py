@@ -1,0 +1,236 @@
+"""End-to-end test harness — manifest-driven in-process testnets.
+
+Reference parity: test/e2e/ — the runner pipeline (runner/main.go:45-130):
+setup → start → tx load → perturbations (kill/restart/disconnect) → wait →
+invariant tests (RPC-only, black-box) → benchmark. Manifests describe
+heterogeneous networks (validator/full nodes, sync modes); the reference
+uses docker-compose, this build runs nodes in-process (threads) which is
+the same seam its reactor tests use (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..abci import KVStoreApplication
+from ..config import Config, ConsensusConfig
+from ..crypto import ed25519
+from ..node import Node, make_node
+from ..p2p import NodeKey, PeerAddress
+from ..privval import FilePV
+from ..rpc import HTTPClient
+from ..types import Timestamp
+from ..types.genesis import GenesisDoc, GenesisValidator
+
+
+@dataclass
+class NodeManifest:
+    """test/e2e/pkg/manifest.go Node."""
+
+    name: str
+    mode: str = "validator"  # validator | full
+    power: int = 10
+    start_at: int = 0  # join later (block height)
+    perturb: List[str] = field(default_factory=list)  # kill | restart | disconnect
+
+
+@dataclass
+class Manifest:
+    """test/e2e/pkg/manifest.go Manifest (condensed)."""
+
+    chain_id: str = "e2e-chain"
+    nodes: List[NodeManifest] = field(default_factory=list)
+    initial_height: int = 1
+    load_tx_count: int = 20
+    wait_blocks: int = 4
+
+
+@dataclass
+class _RunningNode:
+    manifest: NodeManifest
+    node: Node
+    sk: object
+    node_key: NodeKey
+    rpc: Optional[HTTPClient] = None
+
+
+class Testnet:
+    """runner/main.go — orchestrates an in-process testnet."""
+
+    def __init__(self, manifest: Manifest, consensus_config: Optional[ConsensusConfig] = None):
+        self.manifest = manifest
+        self._ccfg = consensus_config or ConsensusConfig(
+            timeout_propose_ms=400, timeout_propose_delta_ms=100,
+            timeout_prevote_ms=200, timeout_prevote_delta_ms=100,
+            timeout_precommit_ms=200, timeout_precommit_delta_ms=100,
+            timeout_commit_ms=100, skip_timeout_commit=False,
+        )
+        self.nodes: Dict[str, _RunningNode] = {}
+        self._genesis_json: str = ""
+
+    # -- setup (runner: Setup) -------------------------------------------
+
+    def setup(self) -> None:
+        validators = [m for m in self.manifest.nodes if m.mode == "validator"]
+        sks = {
+            m.name: ed25519.gen_priv_key((m.name * 32).encode()[:32])
+            for m in self.manifest.nodes
+        }
+        doc = GenesisDoc(
+            chain_id=self.manifest.chain_id,
+            genesis_time=Timestamp(seconds=1_700_000_000),
+            initial_height=self.manifest.initial_height,
+            validators=[
+                GenesisValidator(address=b"", pub_key=sks[m.name].pub_key(), power=m.power)
+                for m in validators
+            ],
+        )
+        self._genesis_json = doc.to_json()
+        for i, m in enumerate(self.manifest.nodes):
+            self._build_node(i, m, sks[m.name])
+        # full mesh of persistent peers
+        for name, rn in self.nodes.items():
+            for other, orn in self.nodes.items():
+                if other != name and orn.node.router is not None and rn.node.router is not None:
+                    rn.node.router._pm.add_address(
+                        PeerAddress(
+                            orn.node_key.node_id,
+                            orn.node.router._transport.listen_addr,
+                        ),
+                        persistent=True,
+                    )
+
+    def _build_node(self, i: int, m: NodeManifest, sk) -> None:
+        cfg = Config()
+        cfg.base.home = ""
+        cfg.base.db_backend = "memdb"
+        cfg.base.moniker = m.name
+        cfg.consensus = self._ccfg
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        node = make_node(
+            cfg,
+            app=KVStoreApplication(),
+            genesis=GenesisDoc.from_json(self._genesis_json),
+            priv_validator=FilePV(sk) if m.mode == "validator" else None,
+            node_key=NodeKey.generate((f"nk-{m.name}" * 8).encode()[:32]),
+            with_rpc=True,
+        )
+        self.nodes[m.name] = _RunningNode(manifest=m, node=node, sk=sk, node_key=node.node_key)
+
+    # -- run (runner: Start/Load/Perturb/Wait) ----------------------------
+
+    def start(self) -> None:
+        for rn in self.nodes.values():
+            rn.node.start()
+            rn.rpc = HTTPClient(rn.node.rpc_server.listen_addr)
+
+    def load_transactions(self) -> List[bytes]:
+        """runner/load.go: submit load via RPC round-robin."""
+        txs = []
+        rns = list(self.nodes.values())
+        for i in range(self.manifest.load_tx_count):
+            tx = f"load-{i}=v{i}".encode()
+            rn = rns[i % len(rns)]
+            rn.rpc.broadcast_tx_sync(tx)
+            txs.append(tx)
+        return txs
+
+    def perturb(self) -> None:
+        """runner/perturb.go: apply manifest perturbations."""
+        for rn in list(self.nodes.values()):
+            for kind in rn.manifest.perturb:
+                if kind == "disconnect":
+                    # sever all connections; peer manager will redial
+                    with rn.node.router._mtx:
+                        conns = list(rn.node.router._conns.values())
+                    for c in conns:
+                        c.close()
+                elif kind == "kill":
+                    rn.node.stop()
+                elif kind == "restart":
+                    rn.node.stop()
+                    time.sleep(0.3)
+                    self._build_node(0, rn.manifest, rn.sk)
+                    new_rn = self.nodes[rn.manifest.name]
+                    for other, orn in self.nodes.items():
+                        if other != rn.manifest.name:
+                            new_rn.node.router._pm.add_address(
+                                PeerAddress(
+                                    orn.node_key.node_id,
+                                    orn.node.router._transport.listen_addr,
+                                ),
+                                persistent=True,
+                            )
+                    new_rn.node.start()
+                    new_rn.rpc = HTTPClient(new_rn.node.rpc_server.listen_addr)
+
+    def wait_for_height(self, height: int, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        live = [rn for rn in self.nodes.values() if "kill" not in rn.manifest.perturb]
+        for rn in live:
+            remaining = max(deadline - time.time(), 0.1)
+            rn.node.wait_for_height(height, timeout=remaining)
+
+    def stop(self) -> None:
+        for rn in self.nodes.values():
+            try:
+                rn.node.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- invariants (test/e2e/tests, RPC-only black box) -------------------
+
+    def check_invariants(self) -> None:
+        live = [
+            rn for rn in self.nodes.values() if "kill" not in rn.manifest.perturb
+        ]
+        heights = {}
+        for rn in live:
+            st = rn.rpc.status()
+            heights[rn.manifest.name] = int(st["sync_info"]["latest_block_height"])
+        min_h = min(heights.values())
+        # block_test.go: all nodes agree on every height up to min
+        reference_hashes = {}
+        for h in range(1, min_h + 1):
+            for rn in live:
+                blk = rn.rpc.block(h)
+                bh = blk["block_id"]["hash"]
+                if h in reference_hashes:
+                    assert reference_hashes[h] == bh, (
+                        f"height {h}: {rn.manifest.name} disagrees"
+                    )
+                else:
+                    reference_hashes[h] = bh
+        # validator_test.go: validator sets consistent
+        vals0 = live[0].rpc.validators(1)
+        for rn in live[1:]:
+            assert rn.rpc.validators(1) == vals0
+
+    def benchmark(self) -> dict:
+        """runner/benchmark.go:15-67: block interval stats."""
+        rn = next(iter(self.nodes.values()))
+        st = rn.rpc.status()
+        last = int(st["sync_info"]["latest_block_height"])
+        times = []
+        for h in range(1, last + 1):
+            blk = rn.rpc.block(h)
+            t = blk["block"]["header"]["time"]
+            times.append(t)
+        from tendermint_tpu.types.genesis import _time_from_rfc3339
+
+        secs = [
+            _time_from_rfc3339(t).seconds + _time_from_rfc3339(t).nanos / 1e9
+            for t in times
+        ]
+        intervals = [b - a for a, b in zip(secs, secs[1:])] or [0.0]
+        return {
+            "blocks": last,
+            "avg_interval": sum(intervals) / len(intervals),
+            "min_interval": min(intervals),
+            "max_interval": max(intervals),
+        }

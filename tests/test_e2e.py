@@ -1,0 +1,44 @@
+"""Manifest-driven e2e harness tests (test/e2e parity): mixed
+validator/full testnet with tx load, disconnect perturbation, invariant
+checks and the block-interval benchmark."""
+
+import pytest
+
+from tendermint_tpu.e2e import Manifest, NodeManifest, Testnet
+
+
+@pytest.mark.slow
+class TestE2E:
+    def test_testnet_with_load_and_perturbation(self):
+        manifest = Manifest(
+            chain_id="e2e-ci",
+            nodes=[
+                NodeManifest(name="val0"),
+                NodeManifest(name="val1"),
+                NodeManifest(name="val2", perturb=["disconnect"]),
+                NodeManifest(name="full0", mode="full"),
+            ],
+            load_tx_count=6,
+            wait_blocks=3,
+        )
+        net = Testnet(manifest)
+        net.setup()
+        net.start()
+        try:
+            net.wait_for_height(2, timeout=90)
+            txs = net.load_transactions()
+            net.perturb()
+            net.wait_for_height(5, timeout=120)
+            net.check_invariants()
+            bench = net.benchmark()
+            assert bench["blocks"] >= 5
+            # at least some load landed in blocks
+            rn = net.nodes["val0"]
+            found = 0
+            last = bench["blocks"]
+            for h in range(1, last + 1):
+                blk = rn.rpc.block(h)
+                found += len(blk["block"]["data"]["txs"])
+            assert found >= 1
+        finally:
+            net.stop()

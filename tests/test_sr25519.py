@@ -1,0 +1,83 @@
+"""sr25519 (schnorrkel/ristretto255) — reference crypto/sr25519 parity."""
+
+import pytest
+
+from tendermint_tpu.crypto import _ristretto as R
+from tendermint_tpu.crypto import sr25519
+from tendermint_tpu.crypto.encoding import pubkey_from_proto, pubkey_to_proto
+
+# draft-irtf-cfrg-ristretto255 small-multiple test vectors (first 6)
+SPEC_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    "e882b131016b52c1d3337080187cf768423efccbb517bb495ab812c4160ff44e",
+]
+
+
+class TestRistretto:
+    def test_spec_small_multiples(self):
+        pt = R.IDENTITY
+        for i, want_hex in enumerate(SPEC_MULTIPLES):
+            assert R.encode(pt) == bytes.fromhex(want_hex), f"multiple {i}"
+            pt = R.add(pt, R.BASE)
+
+    def test_decode_rejects_noncanonical(self):
+        # non-canonical field element (>= p)
+        assert R.decode(b"\xff" * 32) is None
+        # negative s (odd)
+        bad = bytearray(bytes.fromhex(SPEC_MULTIPLES[1]))
+        bad[0] |= 1
+        assert R.decode(bytes(bad)) is None
+
+    def test_roundtrip(self):
+        for k in (1, 7, 1234567):
+            pt = R.scalar_mult(k, R.BASE)
+            assert R.equals(R.decode(R.encode(pt)), pt)
+
+
+class TestSr25519:
+    def test_sign_verify(self):
+        sk = sr25519.gen_priv_key(bytes(range(32)))
+        pk = sk.pub_key()
+        sig = sk.sign(b"msg")
+        assert sig[63] & 0x80  # schnorrkel v1 marker
+        assert pk.verify_signature(b"msg", sig)
+        assert not pk.verify_signature(b"other", sig)
+        bad = bytearray(sig)
+        bad[5] ^= 1
+        assert not pk.verify_signature(b"msg", bytes(bad))
+        # missing marker bit rejected
+        nomark = bytearray(sig)
+        nomark[63] &= 0x7F
+        assert not pk.verify_signature(b"msg", bytes(nomark))
+
+    def test_randomized_signatures(self):
+        sk = sr25519.gen_priv_key(bytes([9]) * 32)
+        s1, s2 = sk.sign(b"m"), sk.sign(b"m")
+        assert s1 != s2
+        assert sk.pub_key().verify_signature(b"m", s1)
+        assert sk.pub_key().verify_signature(b"m", s2)
+
+    def test_batch_verifier(self):
+        bv = sr25519.BatchVerifier()
+        keys = [sr25519.gen_priv_key(bytes([i + 1]) * 32) for i in range(4)]
+        for i, sk in enumerate(keys):
+            bv.add(sk.pub_key(), b"m%d" % i, sk.sign(b"m%d" % i))
+        ok, valid = bv.verify()
+        assert ok and valid == [True] * 4
+        bv2 = sr25519.BatchVerifier()
+        bv2.add(keys[0].pub_key(), b"x", keys[0].sign(b"y"))
+        ok, valid = bv2.verify()
+        assert not ok and valid == [False]
+
+    def test_proto_encoding_roundtrip(self):
+        pk = sr25519.gen_priv_key(bytes([3]) * 32).pub_key()
+        rt = pubkey_from_proto(pubkey_to_proto(pk))
+        assert rt.type() == "sr25519" and rt.bytes() == pk.bytes()
+
+    def test_address(self):
+        pk = sr25519.gen_priv_key(bytes([4]) * 32).pub_key()
+        assert len(pk.address()) == 20
