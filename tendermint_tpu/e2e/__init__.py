@@ -146,10 +146,8 @@ class Testnet:
             for kind in rn.manifest.perturb:
                 if kind == "disconnect":
                     # sever all connections; peer manager will redial
-                    with rn.node.router._mtx:
-                        conns = list(rn.node.router._conns.values())
-                    for c in conns:
-                        c.close()
+                    for nid in rn.node.router.connected():
+                        rn.node.router.disconnect_peer(nid)
                 elif kind == "kill":
                     rn.node.stop()
                 elif kind == "restart":

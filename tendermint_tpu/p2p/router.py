@@ -210,3 +210,11 @@ class Router:
     def connected(self) -> List[str]:
         with self._mtx:
             return list(self._conns)
+
+    def disconnect_peer(self, node_id: str) -> None:
+        """Sever a peer connection (evictions, test perturbations); the
+        peer manager will redial persistent peers."""
+        with self._mtx:
+            conn = self._conns.get(node_id)
+        if conn is not None:
+            self._drop_peer(conn, None)

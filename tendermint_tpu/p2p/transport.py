@@ -110,6 +110,11 @@ class MConnConnection(Connection):
 
     def close(self) -> None:
         self._mconn.stop()
+        # wake any blocked receiver so the router drops this peer promptly
+        try:
+            self._recv_q.put_nowait((-1, b""))
+        except queue.Full:
+            pass
 
 
 class MConnTransport:
