@@ -60,10 +60,14 @@ class Node:
     blocksync_reactor: Optional[BlockSyncReactor] = None
     rpc_server: object = None
     proxy_app: object = None
+    indexer_service: object = None
+    tx_index_sink: object = None
     _started: bool = False
 
     def start(self) -> None:
         """OnStart (node.go:490-560)."""
+        if self.indexer_service is not None:
+            self.indexer_service.start()
         if self.router is not None:
             self.router.start()
         for r in (self.mempool_reactor, self.evidence_reactor, self.consensus_reactor):
@@ -83,6 +87,8 @@ class Node:
                 r.stop()
         if self.router is not None:
             self.router.stop()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
 
     @property
     def node_id(self) -> str:
@@ -219,6 +225,15 @@ def make_node(
             nid, _, paddr = entry.partition("@")
             peer_manager.add_address(PeerAddress(nid.strip(), paddr.strip()), persistent=True)
 
+    # indexer (node.go createAndStartIndexerService)
+    indexer_service = None
+    tx_index_sink = None
+    if "kv" in config.tx_index.indexer:
+        from ..indexer import IndexerService, KVSink
+
+        tx_index_sink = KVSink(MemDB() if not home else _db("tx_index"))
+        indexer_service = IndexerService([tx_index_sink], event_bus)
+
     node = Node(
         config=config,
         genesis=genesis,
@@ -236,6 +251,8 @@ def make_node(
         evidence_reactor=evidence_reactor,
         proxy_app=query_conn,
     )
+    node.indexer_service = indexer_service
+    node.tx_index_sink = tx_index_sink
     if with_rpc and config.rpc.laddr:
         from ..rpc.server import RPCServer
         from ..rpc.core import Environment

@@ -449,6 +449,56 @@ class Environment:
         self._node.evidence_pool.add_evidence(ev)
         return {"hash": _hex(ev.hash())}
 
+    # -- indexed search (core/tx.go TxSearch, blocks.go BlockSearch) ------
+
+    def tx_search(self, query: str, prove: bool = False, page: int = 1, per_page: int = 30) -> dict:
+        sink = getattr(self._node, "tx_index_sink", None)
+        if sink is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        page, per_page = int(page), int(per_page)
+        hits = sink.search_txs(query, limit=page * per_page + per_page)
+        total = len(hits)
+        sel = hits[(page - 1) * per_page : page * per_page]
+        return {
+            "txs": [
+                {
+                    "hash": _hex(_tx_hash(bytes.fromhex(rec["tx"]))),
+                    "height": str(rec["height"]),
+                    "index": rec["index"],
+                    "tx_result": {"code": rec["code"], "log": rec["log"]},
+                    "tx": _b64(bytes.fromhex(rec["tx"])),
+                }
+                for rec in sel
+            ],
+            "total_count": str(total),
+        }
+
+    def block_search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+        sink = getattr(self._node, "tx_index_sink", None)
+        if sink is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        page, per_page = int(page), int(per_page)
+        heights = sink.search_blocks(query, limit=page * per_page + per_page)
+        sel = heights[(page - 1) * per_page : page * per_page]
+        blocks = []
+        for h in sel:
+            try:
+                blocks.append(self.block(h))
+            except RPCError:
+                continue
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
+    # -- subscriptions (events.go; served over the websocket endpoint) ----
+
+    def _subscribe(self, subscriber: str, query: str):
+        return self._node.event_bus.subscribe(subscriber, query, capacity=200)
+
+    def _unsubscribe(self, subscriber: str, query: str) -> None:
+        self._node.event_bus.unsubscribe(subscriber, query)
+
+    def _unsubscribe_all(self, subscriber: str) -> None:
+        self._node.event_bus.unsubscribe_all(subscriber)
+
 
 # Method table (routes.go:12-50)
 ROUTES = [
@@ -456,6 +506,6 @@ ROUTES = [
     "block", "block_by_hash", "blockchain", "commit", "block_results",
     "validators", "consensus_params", "consensus_state", "dump_consensus_state",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
-    "tx", "num_unconfirmed_txs", "unconfirmed_txs", "check_tx",
-    "broadcast_evidence",
+    "tx", "tx_search", "block_search", "num_unconfirmed_txs",
+    "unconfirmed_txs", "check_tx", "broadcast_evidence",
 ]

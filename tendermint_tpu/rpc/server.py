@@ -82,6 +82,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
         method = parsed.path.strip("/")
+        if method == "websocket" and "websocket" in (
+            self.headers.get("Upgrade", "").lower()
+        ):
+            from .websocket import handle_websocket
+
+            handle_websocket(self, self.env)
+            return
         if method == "":
             # route listing like the reference's index page
             body = json.dumps({"available_methods": ROUTES}).encode()

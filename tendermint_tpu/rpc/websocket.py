@@ -1,0 +1,189 @@
+"""WebSocket event subscriptions (RFC 6455 server side).
+
+Reference parity: rpc/jsonrpc/server/ws_handler.go + core/events.go —
+clients connect to /websocket, send JSON-RPC subscribe/unsubscribe with an
+event query, and receive event messages as JSON-RPC notifications keyed by
+the subscription query. Stdlib-only frame implementation (no extensions,
+no fragmentation of outgoing frames).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import queue as _q
+import struct
+import threading
+from typing import Optional
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((client_key + _WS_GUID).encode()).digest()
+    ).decode()
+
+
+def encode_frame(opcode: int, payload: bytes) -> bytes:
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < 65536:
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def read_frame(rfile) -> Optional[tuple]:
+    """Returns (opcode, payload) or None on EOF."""
+    head = rfile.read(2)
+    if len(head) < 2:
+        return None
+    opcode = head[0] & 0x0F
+    masked = head[1] & 0x80
+    n = head[1] & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", rfile.read(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", rfile.read(8))[0]
+    mask = rfile.read(4) if masked else b""
+    payload = rfile.read(n)
+    if masked:
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+def handle_websocket(handler, env) -> None:
+    """Upgrade an http.server request to a websocket session and serve
+    subscribe/unsubscribe until the client goes away."""
+    key = handler.headers.get("Sec-WebSocket-Key", "")
+    handler.send_response(101, "Switching Protocols")
+    handler.send_header("Upgrade", "websocket")
+    handler.send_header("Connection", "Upgrade")
+    handler.send_header("Sec-WebSocket-Accept", accept_key(key))
+    handler.end_headers()
+
+    subscriber = f"ws-{id(handler)}"
+    write_mtx = threading.Lock()
+    stop = threading.Event()
+
+    def send_json(obj) -> None:
+        data = json.dumps(obj).encode()
+        with write_mtx:
+            handler.wfile.write(encode_frame(OP_TEXT, data))
+            handler.wfile.flush()
+
+    def pump(sub, query: str) -> None:
+        while not stop.is_set() and not sub.canceled.is_set():
+            try:
+                msg = sub.next(timeout=0.5)
+            except _q.Empty:
+                continue
+            try:
+                send_json(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": f"{query}#event",
+                        "result": {
+                            "query": query,
+                            "data": _serialize_event(msg),
+                            "events": msg.events,
+                        },
+                    }
+                )
+            except OSError:
+                return
+
+    pumps = []
+    try:
+        while not stop.is_set():
+            frame = read_frame(handler.rfile)
+            if frame is None:
+                break
+            opcode, payload = frame
+            if opcode == OP_CLOSE:
+                break
+            if opcode == OP_PING:
+                with write_mtx:
+                    handler.wfile.write(encode_frame(OP_PONG, payload))
+                continue
+            if opcode != OP_TEXT:
+                continue
+            try:
+                req = json.loads(payload)
+            except ValueError:
+                continue
+            method = req.get("method", "")
+            params = req.get("params") or {}
+            rid = req.get("id")
+            try:
+                if method == "subscribe":
+                    query = params.get("query", "")
+                    sub = env._subscribe(subscriber, query)
+                    t = threading.Thread(target=pump, args=(sub, query), daemon=True)
+                    t.start()
+                    pumps.append(t)
+                    send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+                elif method == "unsubscribe":
+                    env._unsubscribe(subscriber, params.get("query", ""))
+                    send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+                elif method == "unsubscribe_all":
+                    env._unsubscribe_all(subscriber)
+                    send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+                else:
+                    # any regular RPC method also works over the socket
+                    fn = getattr(env, method, None)
+                    if fn is None or method.startswith("_"):
+                        send_json(
+                            {
+                                "jsonrpc": "2.0",
+                                "id": rid,
+                                "error": {"code": -32601, "message": f"Method not found: {method}"},
+                            }
+                        )
+                    else:
+                        send_json({"jsonrpc": "2.0", "id": rid, "result": fn(**params)})
+            except Exception as e:  # noqa: BLE001
+                try:
+                    send_json(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": rid,
+                            "error": {"code": -32603, "message": str(e)},
+                        }
+                    )
+                except OSError:
+                    break
+    finally:
+        stop.set()
+        try:
+            env._unsubscribe_all(subscriber)
+        except KeyError:
+            pass
+
+
+def _serialize_event(msg) -> dict:
+    """Best-effort JSON form of eventbus payloads (events.go result_data)."""
+    d = msg.data
+    if isinstance(d, dict):
+        out = {}
+        for k, v in d.items():
+            if hasattr(v, "header"):
+                out[k] = {"height": v.header.height}
+            elif isinstance(v, (int, str)):
+                out[k] = v
+            elif isinstance(v, bytes):
+                out[k] = base64.b64encode(v).decode()
+            else:
+                out[k] = str(v)
+        return out
+    return {"value": str(d)}

@@ -1,0 +1,116 @@
+"""WebSocket subscriptions + indexed search over a running node."""
+
+import base64
+import json
+import socket
+import time
+
+import pytest
+
+from tendermint_tpu.rpc.websocket import OP_TEXT, encode_frame, read_frame
+from tests.test_node_rpc import two_node_net  # noqa: F401 — fixture
+
+
+def _ws_connect(addr: str):
+    host, _, port = addr.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=10)
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    req = (
+        f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+        "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    )
+    sock.sendall(req.encode())
+    # read the 101 response headers
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += sock.recv(4096)
+    assert b"101" in buf.split(b"\r\n")[0]
+    return sock
+
+
+def _ws_send_json(sock, obj) -> None:
+    payload = json.dumps(obj).encode()
+    # client frames must be masked
+    import os
+    import struct
+
+    mask = os.urandom(4)
+    n = len(payload)
+    head = bytes([0x80 | OP_TEXT])
+    if n < 126:
+        head += bytes([0x80 | n])
+    else:
+        head += bytes([0x80 | 126]) + struct.pack(">H", n)
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    sock.sendall(head + mask + masked)
+
+
+def _ws_recv_json(sock, timeout=15.0):
+    sock.settimeout(timeout)
+    rfile = sock.makefile("rb")
+    frame = read_frame(rfile)
+    assert frame is not None
+    opcode, payload = frame
+    assert opcode == OP_TEXT
+    return json.loads(payload)
+
+
+class TestWebSocket:
+    def test_subscribe_new_block(self, two_node_net):  # noqa: F811
+        nodes = two_node_net
+        nodes[0].wait_for_height(1, timeout=60)
+        sock = _ws_connect(nodes[0].rpc_server.listen_addr)
+        try:
+            _ws_send_json(
+                sock,
+                {
+                    "jsonrpc": "2.0",
+                    "id": 1,
+                    "method": "subscribe",
+                    "params": {"query": "tm.event='NewBlock'"},
+                },
+            )
+            ack = _ws_recv_json(sock)
+            assert ack["id"] == 1 and "result" in ack
+            ev = _ws_recv_json(sock, timeout=30)
+            assert ev["result"]["query"] == "tm.event='NewBlock'"
+            assert "tm.event" in ev["result"]["events"]
+        finally:
+            sock.close()
+
+    def test_rpc_method_over_websocket(self, two_node_net):  # noqa: F811
+        nodes = two_node_net
+        nodes[0].wait_for_height(1, timeout=60)
+        sock = _ws_connect(nodes[0].rpc_server.listen_addr)
+        try:
+            _ws_send_json(sock, {"jsonrpc": "2.0", "id": 9, "method": "status", "params": {}})
+            resp = _ws_recv_json(sock)
+            assert resp["id"] == 9
+            assert int(resp["result"]["sync_info"]["latest_block_height"]) >= 1
+        finally:
+            sock.close()
+
+
+class TestTxSearch:
+    def test_tx_search_and_block_search(self, two_node_net):  # noqa: F811
+        nodes = two_node_net
+        from tendermint_tpu.rpc import HTTPClient
+
+        rpc = HTTPClient(nodes[0].rpc_server.listen_addr)
+        res = rpc.broadcast_tx_commit(b"searchme=yes")
+        height = int(res["height"])
+        deadline = time.time() + 10
+        hits = None
+        while time.time() < deadline:
+            hits = rpc.call("tx_search", query=f"tx.height={height}")
+            if int(hits["total_count"]) > 0:
+                break
+            time.sleep(0.2)
+        assert hits and int(hits["total_count"]) >= 1
+        assert base64.b64decode(hits["txs"][0]["tx"]) == b"searchme=yes"
+        # event-key search (kvstore emits app.creator)
+        hits2 = rpc.call("tx_search", query="app.creator='Cosmoshi Netowoko'")
+        assert int(hits2["total_count"]) >= 1
+        blocks = rpc.call("block_search", query=f"block.height='{height}'")
+        assert int(blocks["total_count"]) >= 1
