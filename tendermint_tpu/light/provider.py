@@ -58,6 +58,11 @@ class NodeBackedProvider(Provider):
             height = self._bs.height()
         meta = self._bs.load_block_meta(height)
         commit = self._bs.load_block_commit(height)
+        if commit is None and height == self._bs.height():
+            # at the tip only the seen commit exists (core/blocks.go Commit)
+            seen = self._bs.load_seen_commit()
+            if seen is not None and seen.height == height:
+                commit = seen
         if meta is None or commit is None:
             raise ErrLightBlockNotFound(height)
         try:

@@ -1,0 +1,158 @@
+"""Proof-verifying RPC client + light proxy.
+
+Reference parity: light/rpc/client.go — an RPC client that cross-checks
+every response against light-client-verified headers: blocks by header
+hash, commits by verification, txs by merkle proof against the verified
+data hash, validators against the verified validators hash; and
+light/proxy/proxy.go — the RPC server exposing the verified surface.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import List, Optional
+
+from ..crypto import merkle
+from ..rpc.core import RPCError
+from ..types.tx import tx_hash
+from .client import Client
+from .provider import LightBlock
+
+
+class VerificationFailed(RuntimeError):
+    pass
+
+
+class VerifyingClient:
+    """light/rpc/client.go Client."""
+
+    def __init__(self, rpc, light_client: Client):
+        self._rpc = rpc  # an HTTPClient-like transport to the full node
+        self._lc = light_client
+
+    # -- verified reads --------------------------------------------------
+
+    def _trusted(self, height: int) -> LightBlock:
+        return self._lc.verify_light_block_at_height(height)
+
+    def block(self, height: int) -> dict:
+        res = self._rpc.block(height)
+        lb = self._trusted(height)
+        got = bytes.fromhex(res["block_id"]["hash"])
+        if got != lb.hash():
+            raise VerificationFailed(
+                f"block at {height}: hash {got.hex()} != verified {lb.hash().hex()}"
+            )
+        return res
+
+    def commit(self, height: int) -> dict:
+        res = self._rpc.commit(height)
+        lb = self._trusted(height)
+        hdr_height = int(res["signed_header"]["header"]["height"])
+        if hdr_height != height:
+            raise VerificationFailed("commit height mismatch")
+        want = lb.signed_header.header.validators_hash.hex().upper()
+        if res["signed_header"]["header"]["validators_hash"] != want:
+            raise VerificationFailed("commit validators hash mismatch")
+        return res
+
+    def validators(self, height: int) -> dict:
+        res = self._rpc.validators(height)
+        lb = self._trusted(height)
+        # reconstruct the validator-set hash from the response
+        from ..crypto import ed25519
+        from ..types import Validator, ValidatorSet
+
+        vals = []
+        for v in res["validators"]:
+            pk = ed25519.PubKey(base64.b64decode(v["pub_key"]["value"]))
+            vals.append(Validator.new(pk, int(v["voting_power"])))
+        got = ValidatorSet(validators=vals).hash()
+        if got != lb.signed_header.header.validators_hash:
+            raise VerificationFailed("validator set does not match verified header")
+        return res
+
+    def tx(self, tx_hash_bytes: bytes) -> dict:
+        res = self._rpc.tx(tx_hash_bytes, prove=True)
+        height = int(res["height"])
+        lb = self._trusted(height)
+        proof = res.get("proof")
+        if proof is None:
+            raise VerificationFailed("node did not return a tx proof")
+        p = merkle.Proof(
+            total=int(proof["proof"]["total"]),
+            index=int(proof["proof"]["index"]),
+            leaf_hash_=base64.b64decode(proof["proof"]["leaf_hash"]),
+            aunts=[base64.b64decode(a) for a in proof["proof"]["aunts"]],
+        )
+        data = base64.b64decode(proof["data"])
+        root = bytes.fromhex(proof["root_hash"])
+        if root != lb.signed_header.header.data_hash:
+            raise VerificationFailed("tx proof root does not match verified data hash")
+        try:
+            p.verify(root, data)
+        except ValueError as e:
+            raise VerificationFailed(f"tx proof invalid: {e}") from e
+        if tx_hash(data) != tx_hash_bytes:
+            raise VerificationFailed("tx bytes do not match requested hash")
+        return res
+
+    # -- pass-throughs (unverifiable surface) -----------------------------
+
+    def status(self) -> dict:
+        return self._rpc.status()
+
+    def broadcast_tx_sync(self, tx: bytes) -> dict:
+        return self._rpc.broadcast_tx_sync(tx)
+
+    def abci_query(self, path: str, data: bytes, height: int = 0, prove: bool = True) -> dict:
+        """abci_query returns app-level proofs (crypto.ProofOps); apps that
+        don't produce proofs (like the kvstore example) can't be verified —
+        surfaced to the caller rather than silently trusted."""
+        res = self._rpc.abci_query(path, data, height=height, prove=prove)
+        res["verified"] = False
+        return res
+
+
+class LightProxy:
+    """light/proxy/proxy.go: an RPC server exposing the verifying client."""
+
+    def __init__(self, verifying_client: VerifyingClient, laddr: str):
+        from ..rpc.server import RPCServer
+
+        class _Env:
+            def __init__(self, vc):
+                self._vc = vc
+
+            def status(self):
+                return self._vc.status()
+
+            def block(self, height=None):
+                return self._vc.block(int(height))
+
+            def commit(self, height=None):
+                return self._vc.commit(int(height))
+
+            def validators(self, height=None):
+                return self._vc.validators(int(height))
+
+            def tx(self, hash="", prove=True):  # noqa: A002
+                return self._vc.tx(bytes.fromhex(hash))
+
+            def broadcast_tx_sync(self, tx=""):
+                return self._vc.broadcast_tx_sync(base64.b64decode(tx))
+
+            def abci_query(self, path="", data="", height=0, prove=True):
+                return self._vc.abci_query(path, bytes.fromhex(data), int(height))
+
+        self._server = RPCServer(laddr, _Env(verifying_client))
+
+    @property
+    def listen_addr(self) -> str:
+        return self._server.listen_addr
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()

@@ -1,0 +1,82 @@
+"""Light proxy: proof-verifying RPC over a running node; tampered
+responses are rejected."""
+
+import base64
+
+import pytest
+
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.light import Client, LightStore, TrustOptions
+from tendermint_tpu.light.provider import NodeBackedProvider
+from tendermint_tpu.light.rpc import LightProxy, VerificationFailed, VerifyingClient
+from tendermint_tpu.rpc import HTTPClient
+from tendermint_tpu.types.tx import tx_hash
+from tests.test_node_rpc import two_node_net  # noqa: F401 — fixture
+
+
+@pytest.fixture
+def verifying(two_node_net):  # noqa: F811
+    nodes = two_node_net
+    nodes[0].wait_for_height(3, timeout=60)
+    rpc = HTTPClient(nodes[0].rpc_server.listen_addr)
+    prov = NodeBackedProvider(nodes[0].block_store, nodes[0].state_store)
+    lb1 = prov.light_block(1)
+    lc = Client(
+        chain_id="node-chain",
+        trust_options=TrustOptions(period=1e9, height=1, hash=lb1.hash()),
+        primary=prov,
+        witnesses=[prov],
+        store=LightStore(MemDB()),
+    )
+    return nodes, rpc, VerifyingClient(rpc, lc)
+
+
+class TestVerifyingClient:
+    def test_verified_reads(self, verifying):
+        nodes, rpc, vc = verifying
+        blk = vc.block(2)
+        assert int(blk["block"]["header"]["height"]) == 2
+        cm = vc.commit(2)
+        assert int(cm["signed_header"]["header"]["height"]) == 2
+        vals = vc.validators(2)
+        assert int(vals["total"]) == 2
+
+    def test_verified_tx_proof(self, verifying):
+        nodes, rpc, vc = verifying
+        res = rpc.broadcast_tx_commit(b"lighttx=1")
+        height = int(res["height"])
+        nodes[0].wait_for_height(height, timeout=30)
+        out = vc.tx(tx_hash(b"lighttx=1"))
+        assert int(out["height"]) == height
+
+    def test_tampering_detected(self, verifying):
+        nodes, rpc, vc = verifying
+
+        class EvilRPC:
+            def __init__(self, real):
+                self._real = real
+
+            def block(self, height):
+                res = self._real.block(height)
+                res["block_id"]["hash"] = "66" * 32
+                return res
+
+            def __getattr__(self, name):
+                return getattr(self._real, name)
+
+        evil_vc = VerifyingClient(EvilRPC(rpc), vc._lc)
+        with pytest.raises(VerificationFailed):
+            evil_vc.block(3)
+
+    def test_light_proxy_server(self, verifying):
+        nodes, rpc, vc = verifying
+        proxy = LightProxy(vc, "tcp://127.0.0.1:0")
+        proxy.start()
+        try:
+            pc = HTTPClient(proxy.listen_addr)
+            blk = pc.call("block", height=2)
+            assert int(blk["block"]["header"]["height"]) == 2
+            st = pc.call("status")
+            assert st["node_info"]["network"] == "node-chain"
+        finally:
+            proxy.stop()
