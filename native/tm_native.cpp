@@ -1,0 +1,313 @@
+// tm_native — native host-side hot paths for the TPU verification engine.
+//
+// The framework's compute path is JAX/XLA on the device; this module is the
+// native runtime seam around it (SURVEY.md §2: the batch verification
+// engine's host half): the per-batch packing that turns 10k signature
+// triples into kernel input arrays, and RFC-6962 merkle hashing for part
+// sets / block data. CPython C API (no pybind11 in this image), built by
+// native/build.py via setuptools.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+// --------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), self-contained.
+
+namespace sha256 {
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+struct Ctx {
+  uint32_t h[8];
+  uint64_t len;
+  uint8_t buf[64];
+  size_t buflen;
+};
+
+static void init(Ctx *c) {
+  static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                 0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                 0x1f83d9ab, 0x5be0cd19};
+  memcpy(c->h, iv, sizeof(iv));
+  c->len = 0;
+  c->buflen = 0;
+}
+
+static void compress(Ctx *c, const uint8_t *p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = c->h[0], b = c->h[1], cc = c->h[2], d = c->h[3], e = c->h[4],
+           f = c->h[5], g = c->h[6], h = c->h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & cc) ^ (b & cc);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = cc; cc = b; b = a; a = t1 + t2;
+  }
+  c->h[0] += a; c->h[1] += b; c->h[2] += cc; c->h[3] += d;
+  c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+static void update(Ctx *c, const uint8_t *data, size_t n) {
+  c->len += n;
+  if (c->buflen) {
+    size_t take = 64 - c->buflen;
+    if (take > n) take = n;
+    memcpy(c->buf + c->buflen, data, take);
+    c->buflen += take;
+    data += take;
+    n -= take;
+    if (c->buflen == 64) {
+      compress(c, c->buf);
+      c->buflen = 0;
+    }
+  }
+  while (n >= 64) {
+    compress(c, data);
+    data += 64;
+    n -= 64;
+  }
+  if (n) {
+    memcpy(c->buf, data, n);
+    c->buflen = n;
+  }
+}
+
+static void final(Ctx *c, uint8_t out[32]) {
+  uint64_t bitlen = c->len * 8;
+  uint8_t pad = 0x80;
+  update(c, &pad, 1);
+  uint8_t z = 0;
+  while (c->buflen != 56) update(c, &z, 1);
+  uint8_t lenb[8];
+  for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bitlen >> (56 - 8 * i));
+  update(c, lenb, 8);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(c->h[i] >> 24);
+    out[4 * i + 1] = uint8_t(c->h[i] >> 16);
+    out[4 * i + 2] = uint8_t(c->h[i] >> 8);
+    out[4 * i + 3] = uint8_t(c->h[i]);
+  }
+}
+
+static void digest(const uint8_t *data, size_t n, uint8_t out[32]) {
+  Ctx c;
+  init(&c);
+  update(&c, data, n);
+  final(&c, out);
+}
+
+}  // namespace sha256
+
+// --------------------------------------------------------------------------
+// RFC-6962 merkle (crypto/merkle/tree.go semantics)
+
+static void leaf_hash(const uint8_t *data, size_t n, uint8_t out[32]) {
+  sha256::Ctx c;
+  sha256::init(&c);
+  uint8_t prefix = 0x00;
+  sha256::update(&c, &prefix, 1);
+  sha256::update(&c, data, n);
+  sha256::final(&c, out);
+}
+
+static void inner_hash(const uint8_t *l, const uint8_t *r, uint8_t out[32]) {
+  sha256::Ctx c;
+  sha256::init(&c);
+  uint8_t prefix = 0x01;
+  sha256::update(&c, &prefix, 1);
+  sha256::update(&c, l, 32);
+  sha256::update(&c, r, 32);
+  sha256::final(&c, out);
+}
+
+static size_t split_point(size_t n) {
+  size_t k = 1;
+  while (k * 2 < n) k *= 2;
+  return k;
+}
+
+static void merkle_root_hashes(std::vector<uint8_t> &hashes, size_t lo,
+                               size_t hi, uint8_t out[32]) {
+  size_t n = hi - lo;
+  if (n == 1) {
+    memcpy(out, &hashes[32 * lo], 32);
+    return;
+  }
+  size_t k = split_point(n);
+  uint8_t left[32], right[32];
+  merkle_root_hashes(hashes, lo, lo + k, left);
+  merkle_root_hashes(hashes, lo + k, hi, right);
+  inner_hash(left, right, out);
+}
+
+// merkle_root(items: list[bytes]) -> bytes
+static PyObject *py_merkle_root(PyObject *, PyObject *args) {
+  PyObject *items;
+  if (!PyArg_ParseTuple(args, "O", &items)) return nullptr;
+  PyObject *seq = PySequence_Fast(items, "expected a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  uint8_t out[32];
+  if (n == 0) {
+    sha256::digest(nullptr, 0, out);
+    Py_DECREF(seq);
+    return PyBytes_FromStringAndSize((const char *)out, 32);
+  }
+  std::vector<uint8_t> hashes(size_t(n) * 32);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    char *buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(item, &buf, &len) < 0) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    leaf_hash((const uint8_t *)buf, size_t(len), &hashes[32 * size_t(i)]);
+  }
+  Py_DECREF(seq);
+  merkle_root_hashes(hashes, 0, size_t(n), out);
+  return PyBytes_FromStringAndSize((const char *)out, 32);
+}
+
+// sha256_many(items: list[bytes]) -> bytes (concatenated 32B digests)
+static PyObject *py_sha256_many(PyObject *, PyObject *args) {
+  PyObject *items;
+  if (!PyArg_ParseTuple(args, "O", &items)) return nullptr;
+  PyObject *seq = PySequence_Fast(items, "expected a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, n * 32);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint8_t *op = (uint8_t *)PyBytes_AS_STRING(out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    char *buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(item, &buf, &len) < 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    sha256::digest((const uint8_t *)buf, size_t(len), op + 32 * i);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+// pack_le_limbs(encodings: bytes (n*32), n: int) -> bytes (n*20 int32 LE)
+// Low 255 bits of each 32-byte little-endian encoding into 20 radix-2^13
+// limbs — the fe.py input format (ops/backend.py _pack_le_limbs).
+static PyObject *py_pack_le_limbs(PyObject *, PyObject *args) {
+  Py_buffer view;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "y*n", &view, &n)) return nullptr;
+  if (view.len < n * 32) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "buffer too small");
+    return nullptr;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, n * 20 * 4);
+  if (!out) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  int32_t *op = (int32_t *)PyBytes_AS_STRING(out);
+  const uint8_t *ip = (const uint8_t *)view.buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const uint8_t *enc = ip + 32 * i;
+    // 255-bit value as four 64-bit words (top bit cleared)
+    uint64_t w[4];
+    for (int j = 0; j < 4; j++) {
+      w[j] = 0;
+      for (int b = 0; b < 8; b++) w[j] |= uint64_t(enc[8 * j + b]) << (8 * b);
+    }
+    w[3] &= 0x7fffffffffffffffULL;
+    for (int limb = 0; limb < 20; limb++) {
+      int bit = limb * 13;
+      int word = bit >> 6, off = bit & 63;
+      uint64_t v = w[word] >> off;
+      if (off > 64 - 13 && word < 3) v |= w[word + 1] << (64 - off);
+      op[20 * i + limb] = int32_t(v & 0x1fff);
+    }
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// pack_bits_le(scalars: bytes (n*32), n: int, nbits: int)
+//   -> bytes (nbits * n int32 LE), transposed for the ladder.
+static PyObject *py_pack_bits_le(PyObject *, PyObject *args) {
+  Py_buffer view;
+  Py_ssize_t n;
+  int nbits;
+  if (!PyArg_ParseTuple(args, "y*ni", &view, &n, &nbits)) return nullptr;
+  if (view.len < n * 32 || nbits > 256) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "bad buffer/nbits");
+    return nullptr;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)nbits * n * 4);
+  if (!out) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  int32_t *op = (int32_t *)PyBytes_AS_STRING(out);
+  const uint8_t *ip = (const uint8_t *)view.buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const uint8_t *s = ip + 32 * i;
+    for (int b = 0; b < nbits; b++) {
+      op[(Py_ssize_t)b * n + i] = (s[b >> 3] >> (b & 7)) & 1;
+    }
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"merkle_root", py_merkle_root, METH_VARARGS,
+     "RFC-6962 merkle root of a list of byte strings"},
+    {"sha256_many", py_sha256_many, METH_VARARGS,
+     "SHA-256 of each item, concatenated"},
+    {"pack_le_limbs", py_pack_le_limbs, METH_VARARGS,
+     "pack 32B LE encodings into 13-bit limb arrays"},
+    {"pack_bits_le", py_pack_bits_le, METH_VARARGS,
+     "pack 32B LE scalars into transposed bit arrays"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "tm_native",
+                                       nullptr, -1, Methods};
+
+PyMODINIT_FUNC PyInit_tm_native(void) { return PyModule_Create(&moduledef); }

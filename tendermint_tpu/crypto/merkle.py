@@ -39,10 +39,17 @@ def split_point(length: int) -> int:
 
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Merkle root of the list (tree.go:11-29). Empty list hashes to
-    SHA256("")."""
+    SHA256(""). Large inputs route through the native C++ engine when
+    available (native/tm_native.cpp merkle_root)."""
     n = len(items)
     if n == 0:
         return _sha256(b"")
+    if n >= 16:
+        from ..native import load as _load_native
+
+        native = _load_native()
+        if native is not None:
+            return native.merkle_root(list(items))
     if n == 1:
         return leaf_hash(items[0])
     k = split_point(n)

@@ -1,0 +1,75 @@
+"""Loader for the native C++ module (native/tm_native.cpp).
+
+Builds on first use with the in-image toolchain (g++ via setuptools'
+build_ext), caches the shared object under native/_build, and degrades to
+None when no compiler is available — all callers keep a pure-Python path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import sysconfig
+import threading
+
+_lock = threading.Lock()
+_module = None
+_tried = False
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..", "native")
+_BUILD = os.path.join(_ROOT, "_build")
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_BUILD, f"tm_native{suffix}")
+
+
+def _build() -> bool:
+    src = os.path.join(_ROOT, "tm_native.cpp")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(_BUILD, exist_ok=True)
+    import subprocess
+
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = _so_path()
+    include = sysconfig.get_path("include")
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", out,
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(out)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load():
+    """Returns the tm_native module or None."""
+    global _module, _tried
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        if os.environ.get("TM_TPU_NO_NATIVE"):
+            return None
+        so = _so_path()
+        src = os.path.join(_ROOT, "tm_native.cpp")
+        if not os.path.exists(so) or (
+            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(so)
+        ):
+            if not _build():
+                return None
+        spec = importlib.util.spec_from_file_location("tm_native", so)
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+        except ImportError:
+            return None
+        _module = mod
+        return _module

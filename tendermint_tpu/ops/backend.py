@@ -54,7 +54,15 @@ def _bucket_for(n: int) -> int:
 
 def _pack_le_limbs(enc: np.ndarray) -> np.ndarray:
     """(B, 32) uint8 little-endian encodings -> (B, 20) int32 limbs of the
-    low 255 bits (bit 255 — the sign bit — is excluded)."""
+    low 255 bits (bit 255 — the sign bit — is excluded). Routes through the
+    native packer (native/tm_native.cpp) when built."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    n = enc.shape[0]
+    if native is not None:
+        raw = native.pack_le_limbs(np.ascontiguousarray(enc).tobytes(), n)
+        return np.frombuffer(raw, dtype=np.int32).reshape(n, 20).copy()
     bits = np.unpackbits(enc, axis=1, bitorder="little")[:, :255]
     pad = np.zeros((bits.shape[0], 20 * 13 - 255), dtype=bits.dtype)
     bits = np.concatenate([bits, pad], axis=1)
@@ -65,6 +73,13 @@ def _pack_le_limbs(enc: np.ndarray) -> np.ndarray:
 def _bits_253(le32: np.ndarray) -> np.ndarray:
     """(B, 32) uint8 little-endian scalars (< 2^253) -> (253, B) int32 bits,
     transposed for the ladder's row indexing."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    n = le32.shape[0]
+    if native is not None:
+        raw = native.pack_bits_le(np.ascontiguousarray(le32).tobytes(), n, 253)
+        return np.frombuffer(raw, dtype=np.int32).reshape(253, n).copy()
     bits = np.unpackbits(le32, axis=1, bitorder="little")[:, :253]
     return np.ascontiguousarray(bits.T).astype(np.int32)
 

@@ -1,0 +1,56 @@
+"""Native C++ module (native/tm_native.cpp): parity vs the pure paths.
+Skips when no toolchain can build it."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.native import load
+
+native = load()
+pytestmark = pytest.mark.skipif(native is None, reason="native module unavailable")
+
+
+def _pure_root(items):
+    from tendermint_tpu.crypto import merkle
+
+    if not items:
+        return hashlib.sha256(b"").digest()
+    if len(items) == 1:
+        return merkle.leaf_hash(items[0])
+    k = merkle.split_point(len(items))
+    return merkle.inner_hash(_pure_root(items[:k]), _pure_root(items[k:]))
+
+
+class TestNative:
+    def test_merkle_root_parity(self):
+        rng = random.Random(4)
+        for n in (0, 1, 2, 3, 7, 16, 33, 100):
+            items = [rng.randbytes(rng.randrange(0, 100)) for _ in range(n)]
+            assert native.merkle_root(items) == _pure_root(items), n
+
+    def test_sha256_many(self):
+        items = [b"a", b"bb", b"" , b"x" * 1000]
+        out = native.sha256_many(items)
+        for i, item in enumerate(items):
+            assert out[32 * i : 32 * i + 32] == hashlib.sha256(item).digest()
+
+    def test_pack_parity(self):
+        from tendermint_tpu.ops import backend
+        import tendermint_tpu.native as nat
+        import os
+
+        rng = random.Random(7)
+        enc = np.frombuffer(rng.randbytes(32 * 40), dtype=np.uint8).reshape(40, 32).copy()
+        os.environ["TM_TPU_NO_NATIVE"] = "1"
+        nat._module, nat._tried = None, False
+        try:
+            pure_limbs = backend._pack_le_limbs(enc)
+            pure_bits = backend._bits_253(enc)
+        finally:
+            os.environ.pop("TM_TPU_NO_NATIVE")
+            nat._module, nat._tried = None, False
+        assert (backend._pack_le_limbs(enc) == pure_limbs).all()
+        assert (backend._bits_253(enc) == pure_bits).all()
