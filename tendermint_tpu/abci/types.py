@@ -21,6 +21,7 @@ from ..wire.proto import (
     decode_message,
     field_bytes,
     field_int,
+    field_repeated_bytes,
     marshal_delimited,
     to_signed32,
     to_signed64,
@@ -101,7 +102,7 @@ class Event:
         f = decode_message(data)
         return cls(
             type=field_bytes(f, 1).decode("utf-8", "replace"),
-            attributes=[EventAttribute.decode(raw) for _, raw in f.get(2, [])],
+            attributes=[EventAttribute.decode(raw) for raw in field_repeated_bytes(f, 2)],
         )
 
 
@@ -180,7 +181,7 @@ class LastCommitInfo:
         f = decode_message(data)
         return cls(
             round=to_signed32(field_int(f, 1)),
-            votes=[VoteInfo.decode(raw) for _, raw in f.get(2, [])],
+            votes=[VoteInfo.decode(raw) for raw in field_repeated_bytes(f, 2)],
         )
 
 
@@ -558,7 +559,7 @@ def dec_request_payload(kind: str, data: bytes):
             time=_decode_ts(field_bytes(f, 1)),
             chain_id=field_bytes(f, 2).decode(),
             consensus_params=field_bytes(f, 3) if 3 in f else None,
-            validators=[ValidatorUpdate.decode(raw) for _, raw in f.get(4, [])],
+            validators=[ValidatorUpdate.decode(raw) for raw in field_repeated_bytes(f, 4)],
             app_state_bytes=field_bytes(f, 5),
             initial_height=to_signed64(field_int(f, 6)),
         )
@@ -574,7 +575,7 @@ def dec_request_payload(kind: str, data: bytes):
             hash=field_bytes(f, 1),
             header=field_bytes(f, 2),
             last_commit_info=LastCommitInfo.decode(field_bytes(f, 3)),
-            byzantine_validators=[ABCIEvidence.decode(raw) for _, raw in f.get(4, [])],
+            byzantine_validators=[ABCIEvidence.decode(raw) for raw in field_repeated_bytes(f, 4)],
         )
     if kind == "check_tx":
         return RequestCheckTx(tx=field_bytes(f, 1), type=field_int(f, 2))
@@ -695,7 +696,7 @@ def dec_response_payload(kind: str, data: bytes):
     if kind == "init_chain":
         return ResponseInitChain(
             consensus_params=field_bytes(f, 1) if 1 in f else None,
-            validators=[ValidatorUpdate.decode(raw) for _, raw in f.get(2, [])],
+            validators=[ValidatorUpdate.decode(raw) for raw in field_repeated_bytes(f, 2)],
             app_hash=field_bytes(f, 3),
         )
     if kind == "query":
@@ -711,7 +712,7 @@ def dec_response_payload(kind: str, data: bytes):
             codespace=field_bytes(f, 10).decode(),
         )
     if kind == "begin_block":
-        return ResponseBeginBlock(events=[Event.decode(raw) for _, raw in f.get(1, [])])
+        return ResponseBeginBlock(events=[Event.decode(raw) for raw in field_repeated_bytes(f, 1)])
     if kind in ("check_tx", "deliver_tx"):
         cls = ResponseCheckTx if kind == "check_tx" else ResponseDeliverTx
         resp = cls(
@@ -721,7 +722,7 @@ def dec_response_payload(kind: str, data: bytes):
             info=field_bytes(f, 4).decode(),
             gas_wanted=to_signed64(field_int(f, 5)),
             gas_used=to_signed64(field_int(f, 6)),
-            events=[Event.decode(raw) for _, raw in f.get(7, [])],
+            events=[Event.decode(raw) for raw in field_repeated_bytes(f, 7)],
             codespace=field_bytes(f, 8).decode(),
         )
         if kind == "check_tx":
@@ -731,9 +732,9 @@ def dec_response_payload(kind: str, data: bytes):
         return resp
     if kind == "end_block":
         return ResponseEndBlock(
-            validator_updates=[ValidatorUpdate.decode(raw) for _, raw in f.get(1, [])],
+            validator_updates=[ValidatorUpdate.decode(raw) for raw in field_repeated_bytes(f, 1)],
             consensus_param_updates=field_bytes(f, 2) if 2 in f else None,
-            events=[Event.decode(raw) for _, raw in f.get(3, [])],
+            events=[Event.decode(raw) for raw in field_repeated_bytes(f, 3)],
         )
     if kind == "commit":
         return ResponseCommit(
@@ -741,7 +742,7 @@ def dec_response_payload(kind: str, data: bytes):
         )
     if kind == "list_snapshots":
         return ResponseListSnapshots(
-            snapshots=[Snapshot.decode(raw) for _, raw in f.get(1, [])]
+            snapshots=[Snapshot.decode(raw) for raw in field_repeated_bytes(f, 1)]
         )
     if kind == "offer_snapshot":
         return ResponseOfferSnapshot(result=field_int(f, 1))
@@ -751,6 +752,6 @@ def dec_response_payload(kind: str, data: bytes):
         return ResponseApplySnapshotChunk(
             result=field_int(f, 1),
             refetch_chunks=[v for _, v in f.get(2, [])],
-            reject_senders=[raw.decode() for _, raw in f.get(3, [])],
+            reject_senders=[raw.decode() for raw in field_repeated_bytes(f, 3)],
         )
     raise ValueError(f"unknown response kind {kind}")

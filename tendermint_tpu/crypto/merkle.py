@@ -114,14 +114,20 @@ class Proof:
 
     @classmethod
     def decode(cls, data: bytes) -> "Proof":
-        from ..wire.proto import decode_message, field_bytes, field_int, to_signed64
+        from ..wire.proto import (
+            decode_message,
+            field_bytes,
+            field_int,
+            field_repeated_bytes,
+            to_signed64,
+        )
 
         f = decode_message(data)
         return cls(
             total=to_signed64(field_int(f, 1)),
             index=to_signed64(field_int(f, 2)),
             leaf_hash_=field_bytes(f, 3),
-            aunts=[raw for _, raw in f.get(4, [])],
+            aunts=field_repeated_bytes(f, 4),
         )
 
     def __eq__(self, other) -> bool:

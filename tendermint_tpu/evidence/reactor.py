@@ -32,7 +32,8 @@ def encode_evidence_list(evs) -> bytes:
 
 def decode_evidence_list(data: bytes):
     f = decode_message(data)
-    return [decode_evidence(raw) for _, raw in f.get(1, [])]
+    from ..wire.proto import field_repeated_bytes
+    return [decode_evidence(raw) for raw in field_repeated_bytes(f, 1)]
 
 
 class EvidenceReactor:

@@ -35,7 +35,8 @@ def encode_txs(txs) -> bytes:
 
 def decode_txs(data: bytes):
     f = decode_message(data)
-    return [raw for _, raw in f.get(1, [])]
+    from ..wire.proto import field_repeated_bytes
+    return field_repeated_bytes(f, 1)
 
 
 class MempoolReactor:

@@ -203,7 +203,16 @@ def make_node(
     mempool_reactor = None
     evidence_reactor = None
     if transport is None and config.p2p.laddr and config.p2p.laddr != "none":
-        transport = MConnTransport(node_key.priv_key, ALL_CHANNEL_DESCS)
+        from ..types.node_info import NodeInfo
+
+        node_info = NodeInfo(
+            node_id=node_key.node_id,
+            listen_addr=config.p2p.laddr,
+            network=genesis.chain_id,
+            moniker=config.base.moniker,
+            channels=bytes(d.id for d in ALL_CHANNEL_DESCS),
+        )
+        transport = MConnTransport(node_key.priv_key, ALL_CHANNEL_DESCS, node_info)
         addr = config.p2p.laddr
         for prefix in ("tcp://",):
             if addr.startswith(prefix):

@@ -81,7 +81,8 @@ class PexReactor:
                 self._ch.send(env.from_id, _encode_response(pairs))
             elif 2 in f:  # response: absorb addresses
                 inner = decode_message(field_bytes(f, 2))
-                for _, raw in inner.get(1, []):
+                from ..wire.proto import field_repeated_bytes
+                for raw in field_repeated_bytes(inner, 1):
                     e = decode_message(raw)
                     nid = field_bytes(e, 1).decode()
                     addr = field_bytes(e, 2).decode()

@@ -78,6 +78,7 @@ class MConnConnection(Connection):
         sock: socket.socket,
         local_priv: PrivKey,
         channel_descs: List[ChannelDescriptor],
+        node_info=None,
     ):
         super().__init__()
         stream = _SockStream(sock)
@@ -85,6 +86,19 @@ class MConnConnection(Connection):
         self.remote_pubkey = sconn.remote_pubkey
         self.remote_id = node_id_from_pubkey(sconn.remote_pubkey)
         self.local_id = node_id_from_pubkey(local_priv.pub_key())
+        # NodeInfo exchange (transport_mconn.go Handshake): one frame each
+        # way over the encrypted link, before channel routing starts.
+        self.remote_node_info = None
+        if node_info is not None:
+            sconn.write(node_info.encode())
+            from ..types.node_info import NodeInfo
+
+            raw = sconn.read_msg()
+            self.remote_node_info = NodeInfo.decode(raw)
+            if self.remote_node_info.node_id != self.remote_id:
+                raise ConnectionError(
+                    "peer's node info ID does not match its cryptographic identity"
+                )
         self._recv_q: "queue.Queue[Tuple[int, bytes]]" = queue.Queue(maxsize=1000)
         self._err: Optional[Exception] = None
         self._mconn = MConnection(
@@ -120,9 +134,15 @@ class MConnConnection(Connection):
 class MConnTransport:
     """transport_mconn.go MConnTransport: TCP listener + dialer."""
 
-    def __init__(self, local_priv: PrivKey, channel_descs: List[ChannelDescriptor]):
+    def __init__(
+        self,
+        local_priv: PrivKey,
+        channel_descs: List[ChannelDescriptor],
+        node_info=None,
+    ):
         self._priv = local_priv
         self._descs = channel_descs
+        self._node_info = node_info
         self._listener: Optional[socket.socket] = None
         self._accept_q: "queue.Queue[MConnConnection]" = queue.Queue(maxsize=64)
         self._closed = False
@@ -150,7 +170,7 @@ class MConnTransport:
 
     def _handshake_accepted(self, sock: socket.socket) -> None:
         try:
-            conn = MConnConnection(sock, self._priv, self._descs)
+            conn = MConnConnection(sock, self._priv, self._descs, self._node_info)
             self._accept_q.put(conn)
         except Exception:  # noqa: BLE001 — failed handshakes are dropped
             try:
@@ -166,7 +186,7 @@ class MConnTransport:
         sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return MConnConnection(sock, self._priv, self._descs)
+        return MConnConnection(sock, self._priv, self._descs, self._node_info)
 
     def close(self) -> None:
         self._closed = True

@@ -1,0 +1,72 @@
+"""AppConns — the 4-connection ABCI multiplexer.
+
+Reference parity: internal/proxy/multi_app_conn.go — one logical ABCI
+connection per use (consensus / mempool / query / snapshot), each with its
+own client instance so a slow CheckTx can't block block execution, plus
+per-connection call metrics (internal/proxy/client.go).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..abci import LocalClient, SocketClient
+from ..abci.application import Application
+from ..libs.metrics import Registry
+
+
+class _TimedConn:
+    """Wraps an ABCI client, timing every method (proxy metrics)."""
+
+    def __init__(self, inner, histogram=None):
+        self._inner = inner
+        self._hist = histogram
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn) or self._hist is None:
+            return fn
+
+        def timed(*a, **k):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                self._hist.observe(time.perf_counter() - t0)
+
+        return timed
+
+
+class AppConns:
+    """multi_app_conn.go AppConns: consensus/mempool/query/snapshot."""
+
+    def __init__(
+        self,
+        client_factory: Callable[[], object],
+        registry: Optional[Registry] = None,
+    ):
+        hist = None
+        if registry is not None:
+            hist = registry.histogram(
+                "abci_connection", "method_timing_seconds", "ABCI call latency."
+            )
+        self.consensus = _TimedConn(client_factory(), hist)
+        self.mempool = _TimedConn(client_factory(), hist)
+        self.query = _TimedConn(client_factory(), hist)
+        self.snapshot = _TimedConn(client_factory(), hist)
+
+    def stop(self) -> None:
+        for conn in (self.consensus, self.mempool, self.query, self.snapshot):
+            inner = conn._inner
+            if hasattr(inner, "close"):
+                inner.close()
+
+
+def local_client_factory(app: Application) -> Callable[[], object]:
+    """DefaultClientCreator for in-process apps (abci/client/creators.go)."""
+    return lambda: LocalClient(app)
+
+
+def socket_client_factory(address: str) -> Callable[[], object]:
+    return lambda: SocketClient(address)

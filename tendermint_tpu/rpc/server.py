@@ -72,11 +72,22 @@ class _Handler(BaseHTTPRequestHandler):
                 json.loads(
                     self._call(r.get("method", ""), r.get("params") or {}, r.get("id"))
                 )
+                if isinstance(r, dict)
+                else json.loads(_rpc_response(None, error=RPCError(-32600, "Invalid Request")))
                 for r in req
             ]
             self._send(200, json.dumps(out).encode())
             return
-        resp = self._call(req.get("method", ""), req.get("params") or {}, req.get("id"))
+        if not isinstance(req, dict):
+            self._send(400, _rpc_response(None, error=RPCError(-32600, "Invalid Request")))
+            return
+        params = req.get("params")
+        if not isinstance(params, dict):
+            params = {}
+        method = req.get("method", "")
+        if not isinstance(method, str):
+            method = ""
+        resp = self._call(method, params, req.get("id"))
         self._send(200, resp)
 
     def do_GET(self):  # noqa: N802

@@ -17,7 +17,7 @@ from ..db import DB, Batch
 from ..types import BlockID, Timestamp, ValidatorSet, Version
 from ..types.params import ConsensusParams
 from ..wire import canonical as _canon
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, field_repeated_bytes, to_signed64
 from . import State
 
 _KEY_STATE = b"stateKey"
@@ -56,7 +56,7 @@ class ABCIResponses:
     def decode(cls, data: bytes) -> "ABCIResponses":
         f = decode_message(data)
         return cls(
-            deliver_txs=[raw for _, raw in f.get(1, [])],
+            deliver_txs=field_repeated_bytes(f, 1),
             end_block=field_bytes(f, 2),
             begin_block=field_bytes(f, 3),
         )

@@ -16,7 +16,7 @@ from typing import List, Optional
 from ..crypto import merkle, tmhash
 from ..wire import canonical as _canon
 from ..wire.canonical import Timestamp
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, field_repeated_bytes, to_signed32, to_signed64
 
 MAX_HEADER_BYTES = 626  # types/block.go:570
 BLOCK_ID_FLAG_ABSENT = 1
@@ -378,7 +378,7 @@ class Commit:
     @classmethod
     def decode(cls, data: bytes) -> "Commit":
         f = decode_message(data)
-        sigs = [CommitSig.decode(raw) for _, raw in f.get(4, [])]
+        sigs = [CommitSig.decode(raw) for raw in field_repeated_bytes(f, 4)]
         return cls(
             height=to_signed64(field_int(f, 1)),
             round=to_signed32(field_int(f, 2)),
@@ -425,7 +425,7 @@ class Data:
     @classmethod
     def decode(cls, data: bytes) -> "Data":
         f = decode_message(data)
-        return cls(txs=[raw for _, raw in f.get(1, [])])
+        return cls(txs=field_repeated_bytes(f, 1))
 
 
 @dataclass
@@ -476,7 +476,7 @@ class Block:
         return cls(
             header=Header.decode(field_bytes(f, 1)),
             data=Data.decode(field_bytes(f, 2)),
-            evidence=[raw for _, raw in ev_f.get(1, [])],
+            evidence=field_repeated_bytes(ev_f, 1),
             last_commit=Commit.decode(field_bytes(f, 4)) if 4 in f else None,
         )
 

@@ -15,7 +15,7 @@ from ..crypto import merkle, tmhash
 from ..abci import types as abci
 from ..wire import canonical as _canon
 from ..wire.canonical import Timestamp
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed32, to_signed64
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, field_repeated_bytes, to_signed32, to_signed64
 from .block import Commit, Header
 from .validator_set import Validator, ValidatorSet
 from .vote import Vote
@@ -250,7 +250,7 @@ class LightClientAttackEvidence:
                 validator_set_raw=field_bytes(lb, 2),
             ),
             common_height=to_signed64(field_int(f, 2)),
-            byzantine_validators=[Validator.decode(raw) for _, raw in f.get(3, [])],
+            byzantine_validators=[Validator.decode(raw) for raw in field_repeated_bytes(f, 3)],
             total_voting_power=to_signed64(field_int(f, 4)),
             timestamp=Timestamp(
                 seconds=to_signed64(field_int(ts, 1)), nanos=to_signed32(field_int(ts, 2))

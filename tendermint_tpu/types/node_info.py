@@ -1,0 +1,92 @@
+"""NodeInfo — the identity/version record peers exchange at handshake.
+
+Reference parity: types/node_info.go — NodeInfo with protocol versions,
+node id, listen addr, network (chain id), channels, moniker; compatibility
+check on block protocol + network match (node_info.go CompatibleWith).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from ..version import BLOCK_PROTOCOL, P2P_PROTOCOL, TM_VERSION
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int
+
+MAX_NODE_INFO_SIZE = 10240  # node_info.go:15
+
+
+class IncompatiblePeerError(ValueError):
+    pass
+
+
+@dataclass
+class NodeInfo:
+    """node_info.go:30-60 (proto: p2p/types.pb.go NodeInfo)."""
+
+    p2p_version: int = P2P_PROTOCOL
+    block_version: int = BLOCK_PROTOCOL
+    app_version: int = 0
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""
+    version: str = TM_VERSION
+    channels: bytes = b""
+    moniker: str = ""
+
+    def validate_basic(self) -> None:
+        """node_info.go Validate."""
+        if not self.node_id:
+            raise ValueError("no node ID")
+        if len(self.channels) > 16:
+            raise ValueError("too many channels")
+        if len(self.moniker) > 64:
+            raise ValueError("moniker too long")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """node_info.go CompatibleWith: block protocol + network + at least
+        one common channel."""
+        if self.block_version != other.block_version:
+            raise IncompatiblePeerError(
+                f"peer is on a different Block version: {other.block_version} != {self.block_version}"
+            )
+        if self.network != other.network:
+            raise IncompatiblePeerError(
+                f"peer is on a different network: {other.network!r} != {self.network!r}"
+            )
+        if self.channels and other.channels:
+            if not set(self.channels) & set(other.channels):
+                raise IncompatiblePeerError("no common channels")
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        ver = ProtoWriter()
+        ver.write_varint(1, self.p2p_version)
+        ver.write_varint(2, self.block_version)
+        ver.write_varint(3, self.app_version)
+        w.write_message(1, ver.bytes(), always=True)
+        w.write_string(2, self.node_id)
+        w.write_string(3, self.listen_addr)
+        w.write_string(4, self.network)
+        w.write_string(5, self.version)
+        w.write_bytes(6, self.channels)
+        w.write_string(7, self.moniker)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        if len(data) > MAX_NODE_INFO_SIZE:
+            raise ValueError("node info too large")
+        f = decode_message(data)
+        ver = decode_message(field_bytes(f, 1))
+        return cls(
+            p2p_version=field_int(ver, 1),
+            block_version=field_int(ver, 2),
+            app_version=field_int(ver, 3),
+            node_id=field_bytes(f, 2).decode(),
+            listen_addr=field_bytes(f, 3).decode(),
+            network=field_bytes(f, 4).decode(),
+            version=field_bytes(f, 5).decode(),
+            channels=field_bytes(f, 6),
+            moniker=field_bytes(f, 7).decode(),
+        )

@@ -11,7 +11,7 @@ from dataclasses import dataclass, field, replace
 from typing import List, Optional
 
 from ..crypto import tmhash
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, field_repeated_bytes, to_signed64
 
 MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB (types/params.go:21)
 
@@ -83,7 +83,7 @@ class ValidatorParams:
     @classmethod
     def decode(cls, data: bytes) -> "ValidatorParams":
         f = decode_message(data)
-        return cls(pub_key_types=tuple(raw.decode() for _, raw in f.get(1, [])))
+        return cls(pub_key_types=tuple(raw.decode() for raw in field_repeated_bytes(f, 1)))
 
     def is_valid_pubkey_type(self, t: str) -> bool:
         return t in self.pub_key_types

@@ -13,7 +13,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 from ..crypto import PubKey, merkle
 from ..crypto.encoding import pubkey_from_proto, pubkey_to_proto
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, field_repeated_bytes, to_signed64
 
 INT64_MAX = (1 << 63) - 1
 INT64_MIN = -(1 << 63)
@@ -394,7 +394,7 @@ class ValidatorSet:
     @classmethod
     def decode(cls, data: bytes) -> "ValidatorSet":
         f = decode_message(data)
-        vals = [Validator.decode(raw) for _, raw in f.get(1, [])]
+        vals = [Validator.decode(raw) for raw in field_repeated_bytes(f, 1)]
         proposer = Validator.decode(field_bytes(f, 2)) if 2 in f else None
         vs = cls(validators=vals, proposer=proposer)
         vs.total_voting_power()  # recompute, never trust the wire

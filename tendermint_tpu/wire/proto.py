@@ -160,7 +160,10 @@ def field_bytes(
     vals = fields.get(num)
     if not vals:
         return default
-    return vals[-1][1]  # type: ignore[return-value]
+    wt, val = vals[-1]
+    if wt != WT_BYTES:
+        raise ValueError(f"field {num}: expected bytes, got wire type {wt}")
+    return val  # type: ignore[return-value]
 
 
 def field_int(
@@ -169,7 +172,23 @@ def field_int(
     vals = fields.get(num)
     if not vals:
         return default
-    return vals[-1][1]  # type: ignore[return-value]
+    wt, val = vals[-1]
+    if wt == WT_BYTES:
+        raise ValueError(f"field {num}: expected scalar, got length-delimited")
+    return val  # type: ignore[return-value]
+
+
+def field_repeated_bytes(
+    fields: Dict[int, List[Tuple[int, FieldValue]]], num: int
+) -> List[bytes]:
+    """All values of a repeated length-delimited field; raises if any
+    occurrence has a non-bytes wire type (adversarial input)."""
+    out: List[bytes] = []
+    for wt, val in fields.get(num, []):
+        if wt != WT_BYTES:
+            raise ValueError(f"repeated field {num}: expected bytes, got wire type {wt}")
+        out.append(val)  # type: ignore[arg-type]
+    return out
 
 
 def marshal_delimited(encoded: bytes) -> bytes:
