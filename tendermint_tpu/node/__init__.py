@@ -29,6 +29,7 @@ from ..evidence.reactor import EVIDENCE_DESC, EvidenceReactor
 from ..mempool import TxMempool
 from ..mempool.reactor import MEMPOOL_DESC, MempoolReactor
 from ..p2p import MConnTransport, MemoryTransport, NodeKey, PeerManager, Router
+from ..p2p.pex import PEX_DESC
 from ..privval import FilePV
 from ..state import make_genesis_state
 from ..state.execution import BlockExecutor
@@ -36,7 +37,7 @@ from ..state.store import StateStore
 from ..store import BlockStore
 from ..types.genesis import GenesisDoc
 
-ALL_CHANNEL_DESCS = CONSENSUS_DESCS + [BLOCKSYNC_DESC, MEMPOOL_DESC, EVIDENCE_DESC]
+ALL_CHANNEL_DESCS = CONSENSUS_DESCS + [BLOCKSYNC_DESC, MEMPOOL_DESC, EVIDENCE_DESC, PEX_DESC]
 
 
 @dataclass
@@ -58,6 +59,7 @@ class Node:
     mempool_reactor: Optional[MempoolReactor] = None
     evidence_reactor: Optional[EvidenceReactor] = None
     blocksync_reactor: Optional[BlockSyncReactor] = None
+    pex_reactor: object = None
     rpc_server: object = None
     proxy_app: object = None
     indexer_service: object = None
@@ -70,10 +72,14 @@ class Node:
             self.indexer_service.start()
         if self.router is not None:
             self.router.start()
-        for r in (self.mempool_reactor, self.evidence_reactor, self.consensus_reactor):
+        for r in (self.mempool_reactor, self.evidence_reactor,
+                  self.consensus_reactor, self.pex_reactor):
             if r is not None:
                 r.start()
-        self.consensus.start()
+        from ..config import MODE_SEED as _seed
+
+        if self.config.base.mode != _seed:
+            self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
         self._started = True
@@ -81,8 +87,12 @@ class Node:
     def stop(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.stop()
-        self.consensus.stop()
-        for r in (self.consensus_reactor, self.mempool_reactor, self.evidence_reactor, self.blocksync_reactor):
+        from ..config import MODE_SEED as _seed
+
+        if self.config.base.mode != _seed:
+            self.consensus.stop()
+        for r in (self.consensus_reactor, self.mempool_reactor,
+                  self.evidence_reactor, self.blocksync_reactor, self.pex_reactor):
             if r is not None:
                 r.stop()
         if self.router is not None:
@@ -218,15 +228,23 @@ def make_node(
             if addr.startswith(prefix):
                 addr = addr[len(prefix):]
         transport.listen(addr)
+    pex_reactor = None
     if transport is not None:
         pm_db = MemDB() if not home else _db("peers")
         peer_manager = PeerManager(
             node_key.node_id, pm_db, max_connected=config.p2p.max_connections
         )
         router = Router(transport, peer_manager, node_key.node_id)
-        consensus_reactor = ConsensusReactor(consensus, router)
-        mempool_reactor = MempoolReactor(mempool, router, broadcast=config.mempool.broadcast)
-        evidence_reactor = EvidenceReactor(evidence_pool, router)
+        if config.base.mode != MODE_SEED:
+            consensus_reactor = ConsensusReactor(consensus, router)
+            mempool_reactor = MempoolReactor(
+                mempool, router, broadcast=config.mempool.broadcast
+            )
+            evidence_reactor = EvidenceReactor(evidence_pool, router)
+        if config.p2p.pex:
+            from ..p2p.pex import PexReactor
+
+            pex_reactor = PexReactor(router, peer_manager)
         # persistent peers
         from ..p2p import PeerAddress
 
@@ -260,6 +278,7 @@ def make_node(
         evidence_reactor=evidence_reactor,
         proxy_app=query_conn,
     )
+    node.pex_reactor = pex_reactor
     node.indexer_service = indexer_service
     node.tx_index_sink = tx_index_sink
     if with_rpc and config.rpc.laddr:

@@ -126,8 +126,21 @@ def _select_point(table, idx):
     return tuple(out)
 
 
+def _bits_to_digits2(bits_t):
+    """(253, B) LSB-first bits -> (127, B) base-4 digits (bit 253 = 0)."""
+    pad = jnp.zeros((1,) + bits_t.shape[1:], dtype=bits_t.dtype)
+    padded = jnp.concatenate([bits_t, pad], axis=0)  # (254, B)
+    pairs = padded.reshape(127, 2, *padded.shape[1:])
+    return pairs[:, 0] + 2 * pairs[:, 1]
+
+
 def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok):
     """Batched cofactored verification.
+
+    Joint 2-bit-window Straus ladder: 127 iterations of (2 doublings +
+    one add from a 16-entry per-element table of s2*B + k2*(-A)) — ~20%
+    fewer field multiplies than the 1-bit ladder at the cost of 11 table
+    adds per batch element.
 
     Args (B = batch):
       a_y, r_y:       (B, 20) int32 — low-255-bit limbs of A / R encodings
@@ -150,19 +163,33 @@ def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok):
     zero_b = a_y - a_y
     base = (BX_L + zero_b, BY_L + zero_b, fe.ONE + zero_b, BT_L + zero_b)
     ident = (zero_b, fe.ONE + zero_b, fe.ONE + zero_b, zero_b)
-    base_negA = point_add(base, negA)
-    # Joint ladder addend table, indexed by s_bit + 2*k_bit.
-    table = [ident, base, negA, base_negA]
+
+    # 16-entry table: idx = s2 + 4*k2 -> [s2]B + [k2](-A).
+    b_row = [ident, base, point_double(base), point_add(point_double(base), base)]
+    a_multiples = [ident, negA, point_double(negA)]
+    a_multiples.append(point_add(a_multiples[2], negA))
+    table = []
+    for k2 in range(4):
+        for s2 in range(4):
+            if k2 == 0:
+                table.append(b_row[s2])
+            elif s2 == 0:
+                table.append(a_multiples[k2])
+            else:
+                table.append(point_add(b_row[s2], a_multiples[k2]))
+
+    s_digits = _bits_to_digits2(s_bits_t)  # (127, B)
+    k_digits = _bits_to_digits2(k_bits_t)
 
     def body(i, acc):
-        j = SCALAR_BITS - 1 - i
-        sb = lax.dynamic_index_in_dim(s_bits_t, j, 0, keepdims=False)
-        kb = lax.dynamic_index_in_dim(k_bits_t, j, 0, keepdims=False)
-        acc = point_double(acc)
-        addend = _select_point(table, sb + 2 * kb)
+        j = 126 - i
+        s2 = lax.dynamic_index_in_dim(s_digits, j, 0, keepdims=False)
+        k2 = lax.dynamic_index_in_dim(k_digits, j, 0, keepdims=False)
+        acc = point_double(point_double(acc))
+        addend = _select_point(table, s2 + 4 * k2)
         return point_add(acc, addend)
 
-    acc = lax.fori_loop(0, SCALAR_BITS, body, ident)
+    acc = lax.fori_loop(0, 127, body, ident)
     acc = point_add(acc, negR)
     # Multiply by the cofactor 8 and test against the identity.
     acc = point_double(point_double(point_double(acc)))
