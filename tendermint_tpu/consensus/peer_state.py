@@ -1,0 +1,381 @@
+"""Per-peer consensus round-state tracking for targeted gossip.
+
+Reference parity: internal/consensus/peer_state.go (PeerRoundState,
+peer_state.go:28+): the reactor keeps, for every peer, which height/round/
+step it is in and bit arrays of which proposal parts and votes it already
+has, so gossip sends each peer only what it is missing — instead of
+re-flooding every vote to every peer (reactor.go:503 gossipDataRoutine,
+:715 gossipVotesRoutine pick from exactly these structures).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..libs.bits import BitArray
+from ..types import BlockID, Vote, VoteSet
+from ..types.block import PartSetHeader
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+from .types import STEP_NEW_HEIGHT  # noqa: F401  (re-exported for reactor use)
+
+
+def commit_to_vote(commit, idx: int) -> Optional[Vote]:
+    """Reconstruct the precommit Vote behind commit.signatures[idx]
+    (types/vote_set.go CommitToVoteSet / types/block.go:816 semantics)."""
+    cs = commit.signatures[idx]
+    if cs.is_absent():
+        return None
+    return Vote(
+        type=PRECOMMIT_TYPE,
+        height=commit.height,
+        round=commit.round,
+        block_id=cs.block_id(commit.block_id),
+        timestamp=cs.timestamp,
+        validator_address=cs.validator_address,
+        validator_index=idx,
+        signature=cs.signature,
+    )
+
+
+@dataclass
+class PeerRoundState:
+    """peer_state.go PeerRoundState / internal/consensus/types."""
+
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    proposal: bool = False
+    proposal_block_part_set_header: Optional[PartSetHeader] = None
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None
+    prevotes: Optional[BitArray] = None
+    precommits: Optional[BitArray] = None
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+
+class PeerState:
+    """Mutable per-peer view, updated from NewRoundStep/HasVote/
+    VoteSetBits/NewValidBlock messages and from our own sends."""
+
+    def __init__(self, peer_id: str):
+        self.peer_id = peer_id
+        self.prs = PeerRoundState()
+        self._mtx = threading.RLock()
+
+    # -- applying messages from the peer --------------------------------
+
+    def apply_new_round_step(
+        self, height: int, round_: int, step: int, last_commit_round: int
+    ) -> None:
+        """peer_state.go:348 ApplyNewRoundStepMessage."""
+        with self._mtx:
+            prs = self.prs
+            ps_height, ps_round = prs.height, prs.round
+            ps_precommits = prs.precommits
+            ps_catchup_round = prs.catchup_commit_round
+            ps_catchup_commit = prs.catchup_commit
+
+            prs.height = height
+            prs.round = round_
+            prs.step = step
+            if ps_height != height or ps_round != round_:
+                prs.proposal = False
+                prs.proposal_block_part_set_header = None
+                prs.proposal_block_parts = None
+                prs.proposal_pol_round = -1
+                prs.proposal_pol = None
+                prs.prevotes = None
+                prs.precommits = None
+            if ps_height == height and ps_round != round_ and round_ == ps_catchup_round:
+                # peer caught up to the round we were accumulating a
+                # catchup commit for — reuse those precommit bits
+                prs.precommits = ps_catchup_commit
+            if ps_height != height:
+                # shift: the peer's precommits for its previous height
+                # become its last commit (peer_state.go:373-381)
+                if ps_height + 1 == height and ps_round == last_commit_round:
+                    prs.last_commit = ps_precommits
+                else:
+                    prs.last_commit = None
+                prs.last_commit_round = last_commit_round
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = None
+
+    def apply_new_valid_block(
+        self,
+        height: int,
+        round_: int,
+        psh: PartSetHeader,
+        parts: BitArray,
+        is_commit: bool,
+    ) -> None:
+        """peer_state.go ApplyNewValidBlockMessage."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height:
+                return
+            if prs.round != round_ and not is_commit:
+                return
+            prs.proposal_block_part_set_header = psh
+            prs.proposal_block_parts = parts
+
+    def apply_proposal(self, proposal: Proposal) -> None:
+        """peer_state.go SetHasProposal."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round != proposal.round:
+                return
+            if prs.proposal:
+                return
+            prs.proposal = True
+            if prs.proposal_block_parts is None:
+                psh = proposal.block_id.part_set_header
+                prs.proposal_block_part_set_header = psh
+                prs.proposal_block_parts = BitArray(max(psh.total, 1))
+            prs.proposal_pol_round = proposal.pol_round
+            prs.proposal_pol = None  # until a ProposalPOL arrives
+
+    def apply_proposal_pol(self, height: int, pol_round: int, pol: BitArray) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or prs.proposal_pol_round != pol_round:
+                return
+            prs.proposal_pol = pol
+
+    def apply_has_vote(self, height: int, round_: int, type_: int, index: int) -> None:
+        with self._mtx:
+            if self.prs.height != height:
+                return
+            self._set_has_vote_locked(height, round_, type_, index)
+
+    def apply_vote_set_bits(
+        self, height: int, round_: int, type_: int, bits: BitArray,
+        our_votes: Optional[BitArray] = None,
+    ) -> None:
+        """peer_state.go ApplyVoteSetBitsMessage: when the response is
+        keyed to a specific BlockID we only learn bits we also have set
+        (our_votes AND bits), otherwise take the peer's word wholesale."""
+        with self._mtx:
+            cur = self._get_vote_bits_locked(height, round_, type_)
+            if cur is None:
+                self._ensure_vote_bits_locked(height, round_, type_, bits.size())
+                cur = self._get_vote_bits_locked(height, round_, type_)
+            if cur is None:
+                return
+            if our_votes is not None:
+                learned = our_votes.and_(bits).or_(cur)
+            else:
+                learned = bits.copy()
+            self._put_vote_bits_locked(height, round_, type_, learned)
+
+    # -- bookkeeping after our own sends --------------------------------
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or prs.round != round_:
+                return
+            if prs.proposal_block_parts is None:
+                return
+            prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, height: int, round_: int, type_: int, index: int) -> None:
+        with self._mtx:
+            self._set_has_vote_locked(height, round_, type_, index)
+
+    # -- vote bit-array plumbing (peer_state.go getVoteBitArray) ----------
+
+    def _get_vote_bits_locked(
+        self, height: int, round_: int, type_: int
+    ) -> Optional[BitArray]:
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                return prs.prevotes if type_ == PREVOTE_TYPE else prs.precommits
+            if prs.catchup_commit_round == round_ and type_ == PRECOMMIT_TYPE:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and type_ == PREVOTE_TYPE:
+                return prs.proposal_pol
+        elif prs.height == height + 1:
+            if prs.last_commit_round == round_ and type_ == PRECOMMIT_TYPE:
+                return prs.last_commit
+        return None
+
+    def _put_vote_bits_locked(
+        self, height: int, round_: int, type_: int, bits: BitArray
+    ) -> None:
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                if type_ == PREVOTE_TYPE:
+                    prs.prevotes = bits
+                else:
+                    prs.precommits = bits
+            elif prs.catchup_commit_round == round_ and type_ == PRECOMMIT_TYPE:
+                prs.catchup_commit = bits
+            elif prs.proposal_pol_round == round_ and type_ == PREVOTE_TYPE:
+                prs.proposal_pol = bits
+        elif prs.height == height + 1:
+            if prs.last_commit_round == round_ and type_ == PRECOMMIT_TYPE:
+                prs.last_commit = bits
+
+    def _ensure_vote_bits_locked(
+        self, height: int, round_: int, type_: int, num_validators: int
+    ) -> None:
+        prs = self.prs
+        if prs.height == height + 1:
+            # the peer is one height ahead: these votes are its last commit
+            # (peer_state.go ensureVoteBitArrays seeds LastCommit for
+            # Height == height+1 unconditionally; getVoteBitArray still
+            # gates on LastCommitRound == round)
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+            return
+        if prs.height != height:
+            return
+        if prs.round == round_:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+        if prs.catchup_commit_round == round_ and prs.catchup_commit is None:
+            prs.catchup_commit = BitArray(num_validators)
+        if prs.proposal_pol_round == round_ and prs.proposal_pol is None:
+            prs.proposal_pol = BitArray(num_validators)
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        """peer_state.go EnsureVoteBitArrays."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height == height:
+                if prs.prevotes is None:
+                    prs.prevotes = BitArray(num_validators)
+                if prs.precommits is None:
+                    prs.precommits = BitArray(num_validators)
+                if prs.catchup_commit is None and prs.catchup_commit_round >= 0:
+                    prs.catchup_commit = BitArray(num_validators)
+                if prs.proposal_pol is None and prs.proposal_pol_round >= 0:
+                    prs.proposal_pol = BitArray(num_validators)
+            elif prs.height == height + 1:
+                if prs.last_commit is None:
+                    prs.last_commit = BitArray(num_validators)
+
+    def ensure_catchup_commit_round(
+        self, height: int, round_: int, num_validators: int
+    ) -> None:
+        """peer_state.go EnsureCatchUpCommitRound: we know `height` has a
+        commit at `round_`; prepare to track which of its precommits the
+        peer has."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height:
+                return
+            if prs.catchup_commit_round == round_:
+                return
+            prs.catchup_commit_round = round_
+            if round_ == prs.round:
+                prs.catchup_commit = prs.precommits
+            else:
+                prs.catchup_commit = BitArray(num_validators)
+
+    def _set_has_vote_locked(self, height: int, round_: int, type_: int, index: int) -> None:
+        bits = self._get_vote_bits_locked(height, round_, type_)
+        if bits is not None and 0 <= index < bits.size():
+            bits.set_index(index, True)
+
+    # -- gossip picks (peer_state.go PickVoteToSend) ----------------------
+
+    def pick_vote_to_send(self, votes: Optional[VoteSet]) -> Optional[Vote]:
+        """Pick one vote from `votes` (our VoteSet) that this peer does not
+        have yet, ensuring the peer-side bit array exists. Does NOT mark
+        the vote as held — the reactor calls set_has_vote after a
+        successful send (reactor.go:1008 pickSendVote)."""
+        if votes is None or not votes.votes:
+            return None
+        n_vals = len(votes.votes)
+        height, round_, type_ = votes.height, votes.round, votes.signed_msg_type
+        with self._mtx:
+            self._ensure_vote_bits_locked(height, round_, type_, n_vals)
+            peer_bits = self._get_vote_bits_locked(height, round_, type_)
+            if peer_bits is None:
+                return None
+            missing = votes.bit_array().sub(peer_bits)
+            idx_list = missing.get_true_indices()
+            if not idx_list:
+                return None
+            idx = random.choice(idx_list)
+            return votes.get_by_index(idx)
+
+    def init_proposal_block_parts(self, psh: PartSetHeader) -> None:
+        """peer_state.go InitProposalBlockParts: seed the part-tracking bit
+        array (used for catchup gossip of committed blocks)."""
+        with self._mtx:
+            prs = self.prs
+            if (
+                prs.proposal_block_part_set_header is not None
+                and prs.proposal_block_part_set_header == psh
+            ):
+                return
+            prs.proposal_block_part_set_header = psh
+            prs.proposal_block_parts = BitArray(max(psh.total, 1))
+
+    def pick_commit_vote_to_send(self, commit) -> Optional[Vote]:
+        """Pick one precommit reconstructed from a stored Commit that this
+        peer (which is at commit.height, behind us) does not have yet —
+        reactor.go:756-777 catchup via gossipVotesForHeight +
+        peer_state.go EnsureCatchUpCommitRound."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height != commit.height:
+                return None
+            n = len(commit.signatures)
+            if prs.catchup_commit_round != commit.round or prs.catchup_commit is None:
+                prs.catchup_commit_round = commit.round
+                prs.catchup_commit = (
+                    prs.precommits if commit.round == prs.round and prs.precommits is not None
+                    else BitArray(n)
+                )
+            have = BitArray(n)
+            for i, cs in enumerate(commit.signatures):
+                if not cs.is_absent():
+                    have.set_index(i, True)
+            missing = have.sub(prs.catchup_commit)
+            idx_list = missing.get_true_indices()
+            if not idx_list:
+                return None
+            idx = random.choice(idx_list)
+            return commit_to_vote(commit, idx)
+
+    def set_has_catchup_commit_vote(self, height: int, round_: int, index: int) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or prs.catchup_commit_round != round_:
+                return
+            if prs.catchup_commit is not None and 0 <= index < prs.catchup_commit.size():
+                prs.catchup_commit.set_index(index, True)
+
+    def snapshot(self) -> PeerRoundState:
+        """A shallow copy safe to read without the lock."""
+        with self._mtx:
+            prs = self.prs
+            return PeerRoundState(
+                height=prs.height, round=prs.round, step=prs.step,
+                proposal=prs.proposal,
+                proposal_block_part_set_header=prs.proposal_block_part_set_header,
+                proposal_block_parts=prs.proposal_block_parts,
+                proposal_pol_round=prs.proposal_pol_round,
+                proposal_pol=prs.proposal_pol,
+                prevotes=prs.prevotes, precommits=prs.precommits,
+                last_commit_round=prs.last_commit_round,
+                last_commit=prs.last_commit,
+                catchup_commit_round=prs.catchup_commit_round,
+                catchup_commit=prs.catchup_commit,
+            )

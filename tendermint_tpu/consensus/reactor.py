@@ -1,28 +1,59 @@
-"""Consensus reactor — gossips consensus messages over p2p channels.
+"""Consensus reactor — gossips consensus messages over p2p channels with
+per-peer targeted gossip.
 
 Reference parity: internal/consensus/reactor.go — channels State (0x20),
 Data (0x21), Vote (0x22), VoteSetBits (0x23) with the reference's channel
-priorities (reactor.go:32-73). The node's own proposals/parts/votes flow
-out through the ConsensusState broadcast seam; incoming envelopes are
-decoded and fed into the state machine's queues.
+priorities (reactor.go:32-73). Each peer's round state and vote bit arrays
+are tracked in a PeerState (peer_state.py ~ peer_state.go), and the gossip
+loop sends each peer only what it is missing — the Python analog of the
+reference's three per-peer goroutines (gossipDataRoutine reactor.go:503,
+gossipVotesRoutine :715, queryMaj23Routine :797), folded into one loop
+over all peers.
 
-Round-1 scope note: this reactor broadcasts and relays within a connected
-mesh (NewRoundStep/HasVote bookkeeping and the per-peer catchup gossip
-routines of reactor.go:503-797 land with blocksync integration).
+Wire (internal/consensus/msgs.go oneofs, field numbers ours):
+  State ch:  1 NewRoundStep{1 height, 2 round, 3 step, 4 secs_since_start,
+                            5 last_commit_round}
+           | 2 NewValidBlock{1 height, 2 round, 3 part_set_header,
+                             4 parts_bits, 5 is_commit}
+           | 3 HasVote{1 height, 2 round, 3 type, 4 index}
+           | 4 VoteSetMaj23{1 height, 2 round, 3 type, 4 block_id}
+  Data ch:   1 Proposal | 2 BlockPart{1 height, 2 round, 3 part}
+           | 3 ProposalPOL{1 height, 2 pol_round, 3 bits}
+  Vote ch:   1 Vote
+  VSB ch:    1 VoteSetBits{1 height, 2 round, 3 type, 4 block_id, 5 bits}
 """
 
 from __future__ import annotations
 
+import queue as _q
 import threading
-from typing import Optional
+import time as _t
+from typing import Dict, Optional
 
+from ..libs.bits import BitArray
 from ..p2p.conn.mconnection import ChannelDescriptor
 from ..p2p.router import Router
+from ..types import BlockID
 from ..types.part_set import Part
 from ..types.proposal import Proposal
-from ..types.vote import Vote
-from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+from ..wire.proto import (
+    ProtoWriter,
+    decode_message,
+    field_bytes,
+    field_int,
+    to_signed32,
+    to_signed64,
+)
+from .peer_state import PeerState
 from .state import BlockPartMessage, ConsensusState, ProposalMessage, VoteMessage
+from .types import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+)
 
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
@@ -45,85 +76,337 @@ def _encode_block_part(height: int, round_: int, part: Part) -> bytes:
     return w.bytes()
 
 
-class ConsensusReactor:
-    """reactor.go:100-300 (mesh-broadcast variant)."""
+def _wrap(field: int, inner: bytes) -> bytes:
+    w = ProtoWriter()
+    w.write_message(field, inner, always=True)
+    return w.bytes()
 
-    def __init__(self, cs: ConsensusState, router: Router):
+
+class ConsensusReactor:
+    """reactor.go:100-300 with per-peer targeted gossip."""
+
+    GOSSIP_INTERVAL = 0.05
+    QUERY_MAJ23_INTERVAL = 2.0
+
+    def __init__(self, cs: ConsensusState, router: Router, block_store=None):
         self._cs = cs
         self._router = router
+        self._block_store = (
+            block_store if block_store is not None else getattr(cs, "_block_store", None)
+        )
         self._data_ch = router.open_channel(DATA_DESC)
         self._vote_ch = router.open_channel(VOTE_DESC)
         self._state_ch = router.open_channel(STATE_DESC)
         self._vsb_ch = router.open_channel(VOTE_SET_BITS_DESC)
         self._stopped = threading.Event()
         self._threads = []
+        self._peers: Dict[str, PeerState] = {}
+        self._peers_mtx = threading.Lock()
+        self._last_nrs = None  # last broadcast (height, round, step, lcr)
+        self._last_nvb = None  # last broadcast NewValidBlock key
         cs.broadcast_hooks.append(self._broadcast_own)
+        cs.vote_added_hooks.append(self._broadcast_has_vote)
 
     def start(self) -> None:
         for ch, handler in (
             (self._data_ch, self._handle_data),
             (self._vote_ch, self._handle_vote),
             (self._state_ch, self._handle_state),
+            (self._vsb_ch, self._handle_vsb),
         ):
             t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
             t.start()
             self._threads.append(t)
-        t = threading.Thread(target=self._gossip_routine, daemon=True)
-        t.start()
-        self._threads.append(t)
+        for target in (self._peer_update_routine, self._gossip_routine):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._stopped.set()
 
-    # -- catchup gossip (reactor.go:503 gossipDataRoutine + :715
-    # gossipVotesRoutine, mesh-rebroadcast variant): periodically re-send
-    # the current round's proposal/parts/votes and the last commit's
-    # precommits so peers that missed messages (disconnect, late join,
-    # round skew) converge; receivers dedup, so this is idempotent. --------
+    # -- peer lifecycle ---------------------------------------------------
 
-    GOSSIP_INTERVAL = 0.3
+    def _peer_update_routine(self) -> None:
+        updates = self._router.subscribe_peer_updates()
+        while not self._stopped.is_set():
+            try:
+                upd = updates.get(timeout=0.5)
+            except _q.Empty:
+                continue
+            send_to = None
+            with self._peers_mtx:
+                if upd.status == "up":
+                    if upd.node_id not in self._peers:
+                        self._peers[upd.node_id] = PeerState(upd.node_id)
+                    send_to = upd.node_id
+                elif upd.status == "down":
+                    self._peers.pop(upd.node_id, None)
+            if send_to is not None:
+                # network send OUTSIDE the peers lock — a full send queue
+                # blocks up to the mconn timeout and every inbound handler
+                # takes this lock per message
+                self._send_new_round_step(send_to)
+
+    def _peer_list(self):
+        with self._peers_mtx:
+            return list(self._peers.values())
+
+    def _get_peer(self, peer_id: str) -> PeerState:
+        with self._peers_mtx:
+            ps = self._peers.get(peer_id)
+            if ps is None:
+                ps = self._peers[peer_id] = PeerState(peer_id)
+            return ps
+
+    # -- NewRoundStep / HasVote broadcasting ------------------------------
+
+    def _nrs_payload(self) -> tuple:
+        rs = self._cs.rs
+        lcr = rs.last_commit.round if rs.last_commit is not None else -1
+        return rs.height, rs.round, rs.step, lcr, rs.start_time
+
+    def _encode_nrs(self, h, r, s, lcr, start_time) -> bytes:
+        w = ProtoWriter()
+        w.write_varint(1, h)
+        w.write_varint(2, r)
+        w.write_varint(3, s)
+        w.write_varint(4, max(int(_t.time() - start_time), 0))
+        w.write_varint(5, lcr)
+        return _wrap(1, w.bytes())
+
+    def _send_new_round_step(self, peer_id: str) -> None:
+        h, r, s, lcr, st = self._nrs_payload()
+        self._state_ch.send(peer_id, self._encode_nrs(h, r, s, lcr, st))
+
+    def _maybe_broadcast_new_round_step(self) -> None:
+        h, r, s, lcr, st = self._nrs_payload()
+        key = (h, r, s, lcr)
+        if key != self._last_nrs:
+            self._last_nrs = key
+            self._state_ch.broadcast(self._encode_nrs(h, r, s, lcr, st))
+
+    def _maybe_broadcast_new_valid_block(self) -> None:
+        """reactor.go broadcastNewValidBlockMessage (sent from enterCommit
+        and on valid-block update): advertises our part-set header + which
+        parts we hold, so peers — including ones ahead of us — know they
+        can serve us the remaining parts."""
+        rs = self._cs.rs
+        parts = rs.proposal_block_parts
+        in_commit = rs.step >= STEP_COMMIT
+        is_valid = rs.valid_block_parts is parts and rs.valid_round >= 0
+        if parts is None or not (in_commit or is_valid):
+            return
+        bits = parts.bit_array()
+        key = (rs.height, rs.round, parts.header(), tuple(bits.get_true_indices()))
+        if key == self._last_nvb:
+            return
+        self._last_nvb = key
+        w = ProtoWriter()
+        w.write_varint(1, rs.height)
+        w.write_varint(2, rs.round)
+        w.write_message(3, parts.header().encode(), always=True)
+        w.write_message(4, bits.encode(), always=True)
+        w.write_varint(5, 1 if in_commit else 0)
+        self._state_ch.broadcast(_wrap(2, w.bytes()))
+
+    def _broadcast_has_vote(self, vote: Vote) -> None:
+        """reactor.go:1031 broadcastHasVoteMessage."""
+        w = ProtoWriter()
+        w.write_varint(1, vote.height)
+        w.write_varint(2, vote.round)
+        w.write_varint(3, vote.type)
+        w.write_varint(4, vote.validator_index)
+        self._state_ch.broadcast(_wrap(3, w.bytes()))
+
+    # -- gossip loop (the per-peer goroutines, folded) --------------------
 
     def _gossip_routine(self) -> None:
-        import time as _t
-
+        last_maj23 = 0.0
         while not self._stopped.is_set():
             _t.sleep(self.GOSSIP_INTERVAL)
             try:
-                self._gossip_once()
+                self._maybe_broadcast_new_round_step()
+                self._maybe_broadcast_new_valid_block()
+                query_maj23 = _t.time() - last_maj23 >= self.QUERY_MAJ23_INTERVAL
+                if query_maj23:
+                    last_maj23 = _t.time()
+                for ps in self._peer_list():
+                    self._gossip_data(ps)
+                    self._gossip_votes(ps)
+                    if query_maj23:
+                        self._query_maj23(ps)
             except Exception:  # noqa: BLE001 — gossip must never die
                 continue
 
-    def _gossip_once(self) -> None:
+    def _gossip_data(self, ps: PeerState) -> None:
+        """reactor.go:503 gossipDataRoutine (one iteration)."""
         rs = self._cs.rs
-        if rs.proposal is not None:
-            w = ProtoWriter()
-            w.write_message(1, rs.proposal.encode(), always=True)
-            self._data_ch.broadcast(w.bytes())
-        parts = rs.proposal_block_parts
-        if parts is not None:
-            for i in range(parts.total()):
-                p = parts.get_part(i)
-                if p is not None:
-                    w = ProtoWriter()
-                    w.write_message(
-                        2, _encode_block_part(rs.height, rs.round, p), always=True
-                    )
-                    self._data_ch.broadcast(w.bytes())
-        votes = []
-        hvs = rs.votes
-        if hvs is not None:
-            for r in {max(rs.round - 1, 0), rs.round}:
-                for vs in (hvs.prevotes(r), hvs.precommits(r)):
-                    if vs is not None:
-                        votes.extend(v for v in vs.votes if v is not None)
-        if rs.last_commit is not None:
-            votes.extend(v for v in rs.last_commit.votes if v is not None)
-        for v in votes:
-            w = ProtoWriter()
-            w.write_message(1, v.encode(), always=True)
-            self._vote_ch.broadcast(w.bytes())
+        prs = ps.snapshot()
+        if prs.height == rs.height:
+            # proposal first, then missing parts
+            if rs.proposal is not None and not prs.proposal:
+                w = ProtoWriter()
+                w.write_message(1, rs.proposal.encode(), always=True)
+                if self._data_ch.send(ps.peer_id, w.bytes()):
+                    ps.apply_proposal(rs.proposal)
+                    if rs.proposal.pol_round >= 0 and rs.votes is not None:
+                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pol is not None:
+                            pw = ProtoWriter()
+                            pw.write_varint(1, rs.height)
+                            pw.write_varint(2, rs.proposal.pol_round)
+                            pw.write_message(3, pol.bit_array().encode(), always=True)
+                            self._data_ch.send(ps.peer_id, _wrap(3, pw.bytes()))
+            parts = rs.proposal_block_parts
+            if (
+                parts is not None
+                and prs.proposal_block_parts is not None
+                and prs.proposal_block_part_set_header == parts.header()
+            ):
+                missing = parts.bit_array().sub(prs.proposal_block_parts)
+                idxs = missing.get_true_indices()
+                if idxs:
+                    idx = idxs[0]
+                    p = parts.get_part(idx)
+                    if p is not None:
+                        msg = _wrap(2, _encode_block_part(rs.height, rs.round, p))
+                        if self._data_ch.send(ps.peer_id, msg):
+                            # bookkeeping is keyed to the PEER's round
+                            # (reactor.go:545 SetHasProposalBlockPart(prs...))
+                            # — with rs.round a round-lagged peer's bit
+                            # would never set and the part resend forever
+                            ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+            return
+        # catchup: peer is behind — serve committed block parts from the
+        # store (reactor.go:556 gossipDataForCatchup)
+        bs = self._block_store
+        if (
+            bs is not None
+            and 0 < prs.height < rs.height
+            and bs.base() <= prs.height <= bs.height()
+        ):
+            meta = bs.load_block_meta(prs.height)
+            if meta is None:
+                return
+            psh = meta.block_id.part_set_header
+            # Only serve parts once the peer advertises the matching part
+            # set header (via its NewValidBlock after entering commit) —
+            # before that its consensus state would drop them
+            # (reactor.go:556 gossipDataForCatchup checks exactly this).
+            if (
+                prs.proposal_block_part_set_header != psh
+                or prs.proposal_block_parts is None
+            ):
+                return
+            have = BitArray(max(psh.total, 1))
+            for i in range(psh.total):
+                have.set_index(i, True)
+            missing = have.sub(prs.proposal_block_parts)
+            idxs = missing.get_true_indices()
+            if not idxs:
+                return
+            idx = idxs[0]
+            part = bs.load_block_part(prs.height, idx)
+            if part is None:
+                return
+            msg = _wrap(2, _encode_block_part(prs.height, prs.round, part))
+            if self._data_ch.send(ps.peer_id, msg):
+                ps.set_has_proposal_block_part(prs.height, prs.round, idx)
 
-    # -- outbound -------------------------------------------------------
+    def _send_vote(self, ps: PeerState, vote: Optional[Vote]) -> bool:
+        if vote is None:
+            return False
+        w = ProtoWriter()
+        w.write_message(1, vote.encode(), always=True)
+        if self._vote_ch.send(ps.peer_id, w.bytes()):
+            ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+            return True
+        return False
+
+    def _gossip_votes(self, ps: PeerState) -> None:
+        """reactor.go:715 gossipVotesRoutine (one iteration): send ONE vote
+        this peer is missing, chosen in the reference's priority order."""
+        rs = self._cs.rs
+        prs = ps.snapshot()
+        hvs = rs.votes
+        if prs.height == rs.height and hvs is not None:
+            # gossipVotesForHeight (reactor.go:616-713)
+            if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+                if self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit)):
+                    return
+            if (
+                prs.step <= STEP_PROPOSE
+                and 0 <= prs.round <= rs.round
+                and prs.proposal_pol_round >= 0
+            ):
+                if self._send_vote(
+                    ps, ps.pick_vote_to_send(hvs.prevotes(prs.proposal_pol_round))
+                ):
+                    return
+            if prs.step <= STEP_PREVOTE_WAIT and 0 <= prs.round <= rs.round:
+                if self._send_vote(ps, ps.pick_vote_to_send(hvs.prevotes(prs.round))):
+                    return
+            if prs.step <= STEP_PRECOMMIT_WAIT and 0 <= prs.round <= rs.round:
+                if self._send_vote(ps, ps.pick_vote_to_send(hvs.precommits(prs.round))):
+                    return
+            if 0 <= prs.round <= rs.round:
+                if self._send_vote(ps, ps.pick_vote_to_send(hvs.prevotes(prs.round))):
+                    return
+            if prs.proposal_pol_round >= 0:
+                self._send_vote(
+                    ps, ps.pick_vote_to_send(hvs.prevotes(prs.proposal_pol_round))
+                )
+            return
+        # peer is exactly one height behind: our last commit's precommits
+        # are its current height's votes (reactor.go:741-748)
+        if prs.height != 0 and rs.height == prs.height + 1 and rs.last_commit is not None:
+            if self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit)):
+                return
+        # peer is further behind: reconstruct precommits from the stored
+        # commit at its height (reactor.go:750-777)
+        bs = self._block_store
+        if (
+            bs is not None
+            and prs.height != 0
+            and rs.height >= prs.height + 2
+            and bs.base() <= prs.height <= bs.height()
+        ):
+            commit = bs.load_block_commit(prs.height)
+            if commit is not None:
+                vote = ps.pick_commit_vote_to_send(commit)
+                if vote is not None and self._send_vote(ps, vote):
+                    ps.set_has_catchup_commit_vote(prs.height, commit.round, vote.validator_index)
+
+    def _query_maj23(self, ps: PeerState) -> None:
+        """reactor.go:797 queryMaj23Routine (one iteration)."""
+        rs = self._cs.rs
+        prs = ps.snapshot()
+        hvs = rs.votes
+        if hvs is None or prs.height != rs.height:
+            return
+        probes = [
+            (rs.round, PREVOTE_TYPE, hvs.prevotes(rs.round)),
+            (rs.round, PRECOMMIT_TYPE, hvs.precommits(rs.round)),
+        ]
+        if prs.proposal_pol_round >= 0:
+            probes.append(
+                (prs.proposal_pol_round, PREVOTE_TYPE, hvs.prevotes(prs.proposal_pol_round))
+            )
+        for round_, type_, vs in probes:
+            if vs is None:
+                continue
+            block_id, ok = vs.two_thirds_majority()
+            if not ok:
+                continue
+            w = ProtoWriter()
+            w.write_varint(1, rs.height)
+            w.write_varint(2, round_)
+            w.write_varint(3, type_)
+            w.write_message(4, block_id.encode(), always=True)
+            self._state_ch.send(ps.peer_id, _wrap(4, w.bytes()))
+
+    # -- outbound (own messages) -----------------------------------------
 
     def _broadcast_own(self, msg) -> None:
         if isinstance(msg, ProposalMessage):
@@ -142,8 +425,6 @@ class ConsensusReactor:
     # -- inbound --------------------------------------------------------
 
     def _process(self, ch, handler) -> None:
-        import queue as _q
-
         while not self._stopped.is_set():
             try:
                 env = ch.receive(timeout=0.5)
@@ -155,25 +436,130 @@ class ConsensusReactor:
                 continue  # bad peer message: ignore (router would ban)
 
     def _handle_data(self, env) -> None:
-        """reactor.go:1261+ channel processors (Data)."""
+        """reactor.go:1087 handleDataMessage."""
         f = decode_message(env.message)
+        ps = self._get_peer(env.from_id)
         if 1 in f:
             proposal = Proposal.decode(field_bytes(f, 1))
+            ps.apply_proposal(proposal)
             self._cs.set_proposal(proposal, peer_id=env.from_id)
         elif 2 in f:
             bp = decode_message(field_bytes(f, 2))
-            self._cs.add_block_part(
-                field_int(bp, 1),
-                field_int(bp, 2),
-                Part.decode(field_bytes(bp, 3)),
-                peer_id=env.from_id,
+            height = to_signed64(field_int(bp, 1))
+            round_ = to_signed32(field_int(bp, 2))
+            part = Part.decode(field_bytes(bp, 3))
+            ps.set_has_proposal_block_part(height, round_, part.index)
+            self._cs.add_block_part(height, round_, part, peer_id=env.from_id)
+        elif 3 in f:
+            pol = decode_message(field_bytes(f, 3))
+            ps.apply_proposal_pol(
+                to_signed64(field_int(pol, 1)),
+                to_signed32(field_int(pol, 2)),
+                BitArray.decode(field_bytes(pol, 3)),
             )
 
     def _handle_vote(self, env) -> None:
         f = decode_message(env.message)
         if 1 in f:
             vote = Vote.decode(field_bytes(f, 1))
+            ps = self._get_peer(env.from_id)
+            ps.ensure_vote_bit_arrays(
+                vote.height,
+                len(self._cs.rs.validators.validators)
+                if self._cs.rs.validators is not None
+                else 0,
+            )
+            ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
             self._cs.add_vote_msg(vote, peer_id=env.from_id)
 
     def _handle_state(self, env) -> None:
-        pass  # NewRoundStep/HasVote bookkeeping (catchup gossip, later round)
+        """reactor.go:1261 handleStateMessage: NewRoundStep / HasVote /
+        VoteSetMaj23 bookkeeping."""
+        f = decode_message(env.message)
+        ps = self._get_peer(env.from_id)
+        if 1 in f:  # NewRoundStep
+            r = decode_message(field_bytes(f, 1))
+            ps.apply_new_round_step(
+                to_signed64(field_int(r, 1)),
+                to_signed32(field_int(r, 2)),
+                field_int(r, 3),
+                to_signed32(field_int(r, 5)),
+            )
+        elif 2 in f:  # NewValidBlock
+            r = decode_message(field_bytes(f, 2))
+            from ..types.block import PartSetHeader
+
+            ps.apply_new_valid_block(
+                to_signed64(field_int(r, 1)),
+                to_signed32(field_int(r, 2)),
+                PartSetHeader.decode(field_bytes(r, 3)),
+                BitArray.decode(field_bytes(r, 4)),
+                bool(field_int(r, 5)),
+            )
+        elif 3 in f:  # HasVote
+            r = decode_message(field_bytes(f, 3))
+            rs = self._cs.rs
+            if rs.validators is not None:
+                ps.ensure_vote_bit_arrays(
+                    to_signed64(field_int(r, 1)), len(rs.validators.validators)
+                )
+            ps.apply_has_vote(
+                to_signed64(field_int(r, 1)),
+                to_signed32(field_int(r, 2)),
+                field_int(r, 3),
+                field_int(r, 4),
+            )
+        elif 4 in f:  # VoteSetMaj23 -> record + respond with VoteSetBits
+            r = decode_message(field_bytes(f, 4))
+            height = to_signed64(field_int(r, 1))
+            round_ = to_signed32(field_int(r, 2))
+            type_ = field_int(r, 3)
+            block_id = BlockID.decode(field_bytes(r, 4))
+            rs = self._cs.rs
+            if rs.height != height or rs.votes is None:
+                return
+            try:
+                rs.votes.set_peer_maj23(round_, type_, env.from_id, block_id)
+            except ValueError:
+                return
+            vs = (
+                rs.votes.prevotes(round_)
+                if type_ == PREVOTE_TYPE
+                else rs.votes.precommits(round_)
+            )
+            if vs is None:
+                return
+            bits = vs.bit_array_by_block_id(block_id)
+            if bits is None:
+                bits = BitArray(len(vs.votes))
+            w = ProtoWriter()
+            w.write_varint(1, height)
+            w.write_varint(2, round_)
+            w.write_varint(3, type_)
+            w.write_message(4, block_id.encode(), always=True)
+            w.write_message(5, bits.encode(), always=True)
+            self._vsb_ch.send(env.from_id, _wrap(1, w.bytes()))
+
+    def _handle_vsb(self, env) -> None:
+        """reactor.go:1374 handleVoteSetBitsMessage."""
+        f = decode_message(env.message)
+        if 1 not in f:
+            return
+        r = decode_message(field_bytes(f, 1))
+        height = to_signed64(field_int(r, 1))
+        round_ = to_signed32(field_int(r, 2))
+        type_ = field_int(r, 3)
+        block_id = BlockID.decode(field_bytes(r, 4))
+        bits = BitArray.decode(field_bytes(r, 5))
+        ps = self._get_peer(env.from_id)
+        rs = self._cs.rs
+        our_votes = None
+        if rs.height == height and rs.votes is not None:
+            vs = (
+                rs.votes.prevotes(round_)
+                if type_ == PREVOTE_TYPE
+                else rs.votes.precommits(round_)
+            )
+            if vs is not None:
+                our_votes = vs.bit_array_by_block_id(block_id)
+        ps.apply_vote_set_bits(height, round_, type_, bits, our_votes)

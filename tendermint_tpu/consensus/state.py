@@ -129,6 +129,10 @@ class ConsensusState(BaseService):
         # node's own proposals/parts/votes (reactor.go's peer routines read
         # these off the internal message flow).
         self.broadcast_hooks: List[Callable] = []
+        # Called with every vote successfully added to the height vote sets
+        # (any source) — the reactor broadcasts HasVote off this
+        # (reactor.go:1031 broadcastHasVoteMessage).
+        self.vote_added_hooks: List[Callable] = []
 
         self._update_to_state(state)
 
@@ -438,10 +442,9 @@ class ConsensusState(BaseService):
             timestamp=_now_ts(),
         )
         try:
-            sig = self._priv_validator.sign_proposal(self._state.chain_id, proposal)
+            proposal = self._priv_validator.sign_proposal(self._state.chain_id, proposal)
         except ValueError:
             return
-        proposal = Proposal(**{**proposal.__dict__, "signature": sig})
         self._send_internal(ProposalMessage(proposal))
         for i in range(block_parts.total()):
             self._send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
@@ -740,8 +743,14 @@ class ConsensusState(BaseService):
             if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
                 return False
             added = rs.last_commit.add_vote(vote)
-            if added and self._event_bus is not None:
-                self._event_bus.publish_vote(vote)
+            if added:
+                if self._event_bus is not None:
+                    self._event_bus.publish_vote(vote)
+                for hook in self.vote_added_hooks:
+                    try:
+                        hook(vote)
+                    except Exception:  # noqa: BLE001
+                        pass
             return added
         if vote.height != rs.height:
             return False
@@ -751,6 +760,11 @@ class ConsensusState(BaseService):
             return False
         if self._event_bus is not None:
             self._event_bus.publish_vote(vote)
+        for hook in self.vote_added_hooks:
+            try:
+                hook(vote)
+            except Exception:  # noqa: BLE001 — gossip hooks must not break consensus
+                pass
 
         if vote.type == PREVOTE_TYPE:
             prevotes = rs.votes.prevotes(vote.round)
@@ -819,10 +833,12 @@ class ConsensusState(BaseService):
             validator_index=idx,
         )
         try:
-            sig = self._priv_validator.sign_vote(self._state.chain_id, vote)
+            # The signer returns the signed vote — possibly with the
+            # last-signed timestamp restored on a same-HRS re-sign
+            # (privval file.go:339-341), so the signature always verifies.
+            return self._priv_validator.sign_vote(self._state.chain_id, vote)
         except ValueError:
             return None
-        return Vote(**{**vote.__dict__, "signature": sig})
 
     def _vote_time(self) -> Timestamp:
         """state.go voteTime: max(now, lastBlockTime + 1ns-ish)."""

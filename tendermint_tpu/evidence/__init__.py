@@ -88,10 +88,20 @@ class Pool:
         height = state.last_block_height
         ev_params = state.consensus_params.evidence
         age_num_blocks = height - ev.height()
-        if age_num_blocks > ev_params.max_age_num_blocks:
+        # internal/evidence/verify.go:48: evidence expires only when BOTH
+        # the duration bound and the block-count bound are exceeded.
+        lbt, evt = state.last_block_time, ev.time()
+        age_duration_ns = (lbt.seconds - evt.seconds) * 10**9 + (
+            lbt.nanos - evt.nanos
+        )
+        if (
+            age_duration_ns > ev_params.max_age_duration_ns
+            and age_num_blocks > ev_params.max_age_num_blocks
+        ):
             raise EvidenceError(
                 f"evidence from height {ev.height()} is too old; "
-                f"min height is {height - ev_params.max_age_num_blocks}"
+                f"min height is {height - ev_params.max_age_num_blocks} "
+                f"(age {age_duration_ns}ns > {ev_params.max_age_duration_ns}ns)"
             )
         if isinstance(ev, DuplicateVoteEvidence):
             self._verify_duplicate_vote(ev, state)
@@ -192,8 +202,15 @@ class Pool:
                     if h in seen:
                         raise EvidenceError("duplicate evidence in block")
                     seen.add(h)
-                    if not self._is_committed(ev):
-                        self.verify(ev)
+                    # pool.go:210-212: a block may not carry evidence that
+                    # was already committed — otherwise a byzantine proposer
+                    # could replay the same evidence every block and trigger
+                    # repeated slashing of the same offense.
+                    if self._is_committed(ev):
+                        raise EvidenceError(
+                            f"evidence {h.hex()} was already committed"
+                        )
+                    self.verify(ev)
             finally:
                 self._state = prev if prev is not None else state
 

@@ -84,31 +84,76 @@ def _bits_253(le32: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(bits.T).astype(np.int32)
 
 
-def prepare_batch(
-    entries: List[Tuple[bytes, bytes, bytes]], bucket: int
-) -> tuple:
-    """entries: (pub32, msg, sig64) triples, len <= bucket. Returns the
-    kernel argument tuple, padded to `bucket` lanes."""
+_L_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
+
+
+def _pack_rows(entries, bucket: int):
+    """Bulk-pack (pub32, msg, sig64) triples into padded (bucket, 32)
+    pub/R/s arrays via two joins — no per-signature Python loop (SURVEY.md
+    §7 hard-part 3: host prep must not dominate the batch).
+
+    Padding lanes: A = R = identity encoding (y=1), s = 0 — these verify
+    trivially and keep the ladder numerically meaningful."""
     n = len(entries)
     pub = np.zeros((bucket, 32), dtype=np.uint8)
     r_enc = np.zeros((bucket, 32), dtype=np.uint8)
     s_enc = np.zeros((bucket, 32), dtype=np.uint8)
-    k_enc = np.zeros((bucket, 32), dtype=np.uint8)
-    s_ok = np.zeros((bucket,), dtype=bool)
-    # Padding lanes: A = R = identity encoding (y=1), s = k = 0 — these
-    # verify trivially and keep the ladder numerically meaningful.
+    if n:
+        # length check before the joins: a single wrong-length key would
+        # otherwise silently shift every later lane after the reshape
+        if any(len(pk) != 32 or len(s) != 64 for pk, _, s in entries):
+            raise ValueError("entries must be (pub32, msg, sig64) triples")
+        pub[:n] = np.frombuffer(
+            b"".join(pk for pk, _, _ in entries), dtype=np.uint8
+        ).reshape(n, 32)
+        sig = np.frombuffer(
+            b"".join(s for _, _, s in entries), dtype=np.uint8
+        ).reshape(n, 64)
+        r_enc[:n] = sig[:, :32]
+        s_enc[:n] = sig[:, 32:]
     pub[n:, 0] = 1
     r_enc[n:, 0] = 1
-    s_ok[n:] = True
+    return pub, r_enc, s_enc
 
-    for i, (pk, msg, sig) in enumerate(entries):
-        pub[i] = np.frombuffer(pk, dtype=np.uint8)
-        r_enc[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        s_enc[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        s = int.from_bytes(sig[32:], "little")
-        s_ok[i] = s < L
-        k = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
-        k_enc[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+
+def _s_below_l(s_enc: np.ndarray, n: int, bucket: int) -> np.ndarray:
+    """Vectorized s < L check (RFC 8032 scalar range): big-endian
+    lexicographic compare against L. Padding lanes pass (s = 0)."""
+    s_ok = np.zeros((bucket,), dtype=bool)
+    s_ok[n:] = True
+    if n:
+        s_be = s_enc[:n, ::-1]
+        diff = s_be != _L_BE
+        has_diff = diff.any(axis=1)
+        first = diff.argmax(axis=1)
+        rng = np.arange(n)
+        s_ok[:n] = has_diff & (s_be[rng, first] < _L_BE[first])
+    return s_ok
+
+
+def prepare_batch(
+    entries: List[Tuple[bytes, bytes, bytes]], bucket: int
+) -> tuple:
+    """entries: (pub32, msg, sig64) triples, len <= bucket. Returns the
+    kernel argument tuple, padded to `bucket` lanes. The challenge scalar
+    k = SHA512(R||A||M) mod L is computed host-side here (hashlib is
+    C-speed; the device-hash path in prepare_batch_device_hash avoids even
+    this)."""
+    n = len(entries)
+    pub, r_enc, s_enc = _pack_rows(entries, bucket)
+    k_enc = np.zeros((bucket, 32), dtype=np.uint8)
+    s_ok = _s_below_l(s_enc, n, bucket)
+    if n:
+        ks = b"".join(
+            (
+                int.from_bytes(
+                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
+                )
+                % L
+            ).to_bytes(32, "little")
+            for pk, msg, sig in entries
+        )
+        k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
 
     a_sign = (pub[:, 31] >> 7).astype(np.int32)
     r_sign = (r_enc[:, 31] >> 7).astype(np.int32)
@@ -131,20 +176,9 @@ def prepare_batch_device_hash(
     from . import sha512 as _sha
 
     n = len(entries)
-    pub = np.zeros((bucket, 32), dtype=np.uint8)
-    r_enc = np.zeros((bucket, 32), dtype=np.uint8)
-    s_enc = np.zeros((bucket, 32), dtype=np.uint8)
-    s_ok = np.zeros((bucket,), dtype=bool)
-    pub[n:, 0] = 1
-    r_enc[n:, 0] = 1
-    s_ok[n:] = True
-    msgs = []
-    for i, (pk, msg, sig) in enumerate(entries):
-        pub[i] = np.frombuffer(pk, dtype=np.uint8)
-        r_enc[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        s_enc[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        s_ok[i] = int.from_bytes(sig[32:], "little") < L
-        msgs.append(sig[:32] + pk + msg)
+    pub, r_enc, s_enc = _pack_rows(entries, bucket)
+    s_ok = _s_below_l(s_enc, n, bucket)
+    msgs = [sig[:32] + pk + msg for pk, msg, sig in entries]
     msgs += [b"\x01" + bytes(31) + b"\x01" + bytes(31)] * (bucket - n)
     hi, lo, counts = _sha.pad_messages(msgs, 64 + DEVICE_HASH_MAX_MSG)
     a_sign = (pub[:, 31] >> 7).astype(np.int32)

@@ -20,8 +20,9 @@ Design notes — why 13-bit limbs in int32:
 
 Invariants:
 - "reduced" form (output of carry/add/sub/mul/sq): every limb in
-  (-2^15, 2^13 + 8], |value| < 2^261, value correct mod p. Safe as input
-  to any op here.
+  (-608, 2^13 + 608], |value| < 2^258 (so value + 8p > 0), value correct
+  mod p. Safe as input to any op here: 20*(2^13+608)^2 < 2^31 keeps the
+  mul convolution overflow-free.
 - "canonical" form (output of canon): limbs in [0, 2^13), value in [0, p).
 """
 
@@ -75,26 +76,30 @@ TOEP_IDX = jnp.asarray(np.clip(_k - _i, 0, NLIMBS - 1).astype(np.int32))
 TOEP_MSK = jnp.asarray((((_k - _i) >= 0) & ((_k - _i) < NLIMBS)).astype(np.int32))
 
 
+def _carry_pass(x):
+    """One parallel carry pass: every limb sheds its carry to the next limb
+    simultaneously (the carry out of limb 19, weight 2^260, wraps to limb 0
+    via 2^260 ≡ 608 mod p). One pass shrinks |limb| from < 2^31 to < 2^18.4;
+    vectorized over the limb axis — no sequential dependency chain."""
+    c = x >> RADIX  # arithmetic shift == floor division (signed-safe)
+    r = x & MASK
+    wrap = jnp.concatenate(
+        [c[..., NLIMBS - 1 :] * _TOP_WRAP, c[..., : NLIMBS - 1]], axis=-1
+    )
+    return r + wrap
+
+
 def carry(x):
     """Propagate carries: (..., 20) int32 with |limb| < 2^31 -> reduced form.
 
-    Sequential 20-step chain (unrolled; each step is one vector op over the
-    batch). The final carry (weight 2^260) wraps via 2^260 ≡ 608 (mod p).
+    Three parallel passes instead of a 20-step sequential chain: the carry
+    magnitude contracts geometrically (2^31 -> 2^18.4 -> 2^15 -> 2^13+608),
+    so three passes land every limb in (-608, 2^13 + 608] — "reduced" form
+    (see module invariants; 20*(2^13+608)^2 = 1.55e9 < 2^31 keeps the next
+    convolution overflow-free). Vectorized form compiles to ~1/5 the HLO of
+    the unrolled chain and lets the VPU work the limb axis in parallel.
     """
-    out = []
-    c = jnp.zeros_like(x[..., 0])
-    for i in range(NLIMBS):
-        t = x[..., i] + c
-        c = t >> RADIX  # arithmetic shift == floor division (signed-safe)
-        out.append(t & MASK)
-    t0 = out[0] + c * _TOP_WRAP
-    c0 = t0 >> RADIX
-    out[0] = t0 & MASK
-    t1 = out[1] + c0
-    c1 = t1 >> RADIX
-    out[1] = t1 & MASK
-    out[2] = out[2] + c1  # |c1| <= 3: limb2 in [-3, 2^13+3]
-    return jnp.stack(out, axis=-1)
+    return _carry_pass(_carry_pass(_carry_pass(x)))
 
 
 def add(a, b):

@@ -13,7 +13,7 @@ import base64
 import json
 import os
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional, Tuple
 
 from ..crypto import PrivKey, PubKey, ed25519
@@ -44,11 +44,15 @@ class PrivValidator(abc.ABC):
     def get_pub_key(self) -> PubKey: ...
 
     @abc.abstractmethod
-    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
-        """Returns the signature; callers attach it to the vote."""
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        """Returns the signed vote. On a same-HRS re-sign where only the
+        timestamp differs, the returned vote carries the LAST-SIGNED
+        timestamp with the reused signature (file.go:339-341), so the
+        signature always verifies over the returned vote's sign bytes."""
 
     @abc.abstractmethod
-    def sign_proposal(self, chain_id: str, proposal) -> bytes: ...
+    def sign_proposal(self, chain_id: str, proposal):
+        """Returns the signed proposal (same timestamp rule as votes)."""
 
 
 @dataclass
@@ -163,7 +167,7 @@ class FilePV(PrivValidator):
     def get_pub_key(self) -> PubKey:
         return self._priv_key.pub_key()
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
         """file.go:280-330 signVote with double-sign protection."""
         height, round_, step = vote.height, vote.round, vote_to_step(vote.type)
         lss = self.last_sign_state
@@ -171,16 +175,20 @@ class FilePV(PrivValidator):
         sign_bytes = vote.sign_bytes(chain_id)
         if same_hrs:
             if sign_bytes == lss.sign_bytes:
-                return lss.signature
-            # only the timestamp may differ (file.go:307-316)
+                return replace(vote, signature=lss.signature)
+            # Only the timestamp may differ: reuse the stored signature but
+            # rewrite the vote's timestamp to the one the signature covers
+            # (file.go:339-341) — otherwise the emitted vote would not
+            # verify over its own sign bytes.
             if _only_timestamp_differs_vote(lss.sign_bytes, sign_bytes):
-                return lss.signature
+                ts = _extract_timestamp(lss.sign_bytes, 5)
+                return replace(vote, timestamp=ts, signature=lss.signature)
             raise ValueError("conflicting data")
         sig = self._priv_key.sign(sign_bytes)
         self._save_signed(height, round_, step, sign_bytes, sig)
-        return sig
+        return replace(vote, signature=sig)
 
-    def sign_proposal(self, chain_id: str, proposal) -> bytes:
+    def sign_proposal(self, chain_id: str, proposal):
         """file.go:335-370."""
         height, round_ = proposal.height, proposal.round
         lss = self.last_sign_state
@@ -188,13 +196,14 @@ class FilePV(PrivValidator):
         sign_bytes = proposal.sign_bytes(chain_id)
         if same_hrs:
             if sign_bytes == lss.sign_bytes:
-                return lss.signature
+                return replace(proposal, signature=lss.signature)
             if _only_timestamp_differs_proposal(lss.sign_bytes, sign_bytes):
-                return lss.signature
+                ts = _extract_timestamp(lss.sign_bytes, 6)
+                return replace(proposal, timestamp=ts, signature=lss.signature)
             raise ValueError("conflicting data")
         sig = self._priv_key.sign(sign_bytes)
         self._save_signed(height, round_, STEP_PROPOSE, sign_bytes, sig)
-        return sig
+        return replace(proposal, signature=sig)
 
     def _save_signed(self, height: int, round_: int, step: int, sign_bytes: bytes, sig: bytes) -> None:
         lss = self.last_sign_state
@@ -224,6 +233,27 @@ def _strip_timestamp(sign_bytes: bytes, ts_field: int) -> bytes:
             elif wt == 2:
                 w.write_bytes(num, val, always=True)
     return w.bytes()
+
+
+def _extract_timestamp(sign_bytes: bytes, ts_field: int) -> Timestamp:
+    """Decode the canonical timestamp field from delimited sign bytes."""
+    from ..wire.proto import unmarshal_delimited
+
+    msg, _ = unmarshal_delimited(sign_bytes)
+    fields = decode_message(msg)
+    raw = field_bytes(fields, ts_field)
+    if not raw:
+        return Timestamp.zero()
+    tf = decode_message(raw)
+
+    def _i64(num: int) -> int:
+        vals = tf.get(num)
+        if not vals:
+            return 0
+        v = int(vals[-1][1])
+        return v - (1 << 64) if v >= 1 << 63 else v
+
+    return Timestamp(seconds=_i64(1), nanos=_i64(2))
 
 
 def _only_timestamp_differs_vote(a: bytes, b: bytes) -> bool:

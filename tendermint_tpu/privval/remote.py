@@ -7,9 +7,12 @@ requests; privval/retry_signer_client.go wraps with retries.
 
 Wire (privval/types.pb.go Message oneof, uvarint-delimited):
   1 pub_key_request{1 chain_id} | 2 pub_key_response{1 pub_key_bytes, 2 error}
-  3 sign_vote_request{1 vote, 2 chain_id} | 4 signed_vote_response{1 sig, 2 error}
+  3 sign_vote_request{1 vote, 2 chain_id} | 4 signed_vote_response{1 vote, 2 error}
   5 sign_proposal_request{1 proposal, 2 chain_id}
-  | 6 signed_proposal_response{1 sig, 2 error} | 7 ping_request{} | 8 ping_response{}
+  | 6 signed_proposal_response{1 proposal, 2 error} | 7 ping_request{} | 8 ping_response{}
+The responses carry the FULL signed message (as the reference's
+privval/types.pb.go SignedVoteResponse does) so the signer's
+last-signed-timestamp rewrite survives the wire.
 """
 
 from __future__ import annotations
@@ -106,8 +109,8 @@ class SignerServer:
                 vote = Vote.decode(field_bytes(r, 1))
                 chain_id = field_bytes(r, 2).decode()
                 try:
-                    sig = self._pv.sign_vote(chain_id, vote)
-                    sock.sendall(_msg(4, {1: sig}))
+                    signed = self._pv.sign_vote(chain_id, vote)
+                    sock.sendall(_msg(4, {1: signed.encode()}))
                 except ValueError as e:
                     sock.sendall(_msg(4, {2: str(e)}))
             elif 5 in f:  # sign_proposal_request
@@ -115,8 +118,8 @@ class SignerServer:
                 proposal = Proposal.decode(field_bytes(r, 1))
                 chain_id = field_bytes(r, 2).decode()
                 try:
-                    sig = self._pv.sign_proposal(chain_id, proposal)
-                    sock.sendall(_msg(6, {1: sig}))
+                    signed = self._pv.sign_proposal(chain_id, proposal)
+                    sock.sendall(_msg(6, {1: signed.encode()}))
                 except ValueError as e:
                     sock.sendall(_msg(6, {2: str(e)}))
             elif 7 in f:  # ping
@@ -174,15 +177,13 @@ class SignerClient(PrivValidator):
         raw = self._round_trip(_msg(1, {1: ""}), 2)
         return ed25519.PubKey(raw)
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
-        return self._round_trip(
-            _msg(3, {1: vote.encode(), 2: chain_id}), 4
-        )
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        raw = self._round_trip(_msg(3, {1: vote.encode(), 2: chain_id}), 4)
+        return Vote.decode(raw)
 
-    def sign_proposal(self, chain_id: str, proposal: Proposal) -> bytes:
-        return self._round_trip(
-            _msg(5, {1: proposal.encode(), 2: chain_id}), 6
-        )
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        raw = self._round_trip(_msg(5, {1: proposal.encode(), 2: chain_id}), 6)
+        return Proposal.decode(raw)
 
     def ping(self) -> None:
         self._round_trip(_msg(7, {}), 8)

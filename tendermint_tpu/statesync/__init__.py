@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..abci import types as abci
+from ..light import verifier as light_verifier
 from ..light.provider import LightBlock
 from ..p2p.conn.mconnection import ChannelDescriptor
 from ..p2p.router import Router
@@ -33,11 +34,22 @@ from ..types import Commit, Header, SignedHeader, ValidatorSet
 from ..types.block import BlockID
 from ..types.validation import verify_commit_light
 from ..version import BLOCK_PROTOCOL
+from ..wire.canonical import Timestamp
 from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
 
 SNAPSHOT_CHANNEL = 0x60
 CHUNK_CHANNEL = 0x61
 LIGHT_BLOCK_CHANNEL = 0x62
+
+# stateprovider.go:21-27: the light client behind the state provider uses
+# the node's trusting period; this default mirrors config's 14-day window.
+DEFAULT_TRUSTING_PERIOD = 14 * 24 * 3600.0
+MAX_CLOCK_DRIFT = 10.0
+
+
+def _now_ts() -> Timestamp:
+    t = time.time()
+    return Timestamp(seconds=int(t), nanos=int((t % 1.0) * 1e9))
 
 SNAPSHOT_DESC = ChannelDescriptor(id=SNAPSHOT_CHANNEL, priority=5)
 CHUNK_DESC = ChannelDescriptor(
@@ -249,17 +261,23 @@ class StateSyncReactor:
     ) -> Tuple[State, Commit]:
         """Discover a snapshot, restore it, verify the app, and build the
         post-sync State with light-client-verified trust."""
-        # 1. verify the root of trust
+        # 1. verify the root of trust (light/client.go
+        # initializeWithTrustOptions: hash match, vals bound to the header,
+        # commit verified by those vals over this exact header).
         root = self._fetch_light_block(trust_height)
         if root.hash() != trust_hash:
             raise SyncError(
                 f"trust hash mismatch at height {trust_height}: "
                 f"got {root.hash().hex()}, want {trust_hash.hex()}"
             )
+        root.signed_header.validate_basic(self._chain_id)
+        if root.validators.hash() != root.signed_header.header.validators_hash:
+            raise SyncError("trusted root validators do not match header")
         verify_commit_light(
             self._chain_id, root.validators, root.signed_header.commit.block_id,
             trust_height, root.signed_header.commit,
         )
+        trusted: Dict[int, LightBlock] = {trust_height: root}
 
         # 2. discover snapshots
         deadline = time.time() + discovery_time
@@ -278,22 +296,81 @@ class StateSyncReactor:
 
         for snap in candidates:
             try:
-                return self._sync_one(genesis_state, snap, chunk_timeout)
+                return self._sync_one(genesis_state, snap, chunk_timeout, trusted)
             except SyncError:
                 continue
         raise SyncError("all discovered snapshots failed")
 
-    def _sync_one(self, genesis_state: State, snap: _SnapshotInfo, chunk_timeout: float):
-        # trusted app hash comes from the header at snapshot height + 1
-        header_next = self._fetch_light_block(snap.height + 1)
-        trusted_app_hash = header_next.signed_header.header.app_hash
-        snap_block = self._fetch_light_block(snap.height)
-        # verify both headers' commits (the device batch path)
-        for lb in (snap_block, header_next):
-            verify_commit_light(
-                self._chain_id, lb.validators, lb.signed_header.commit.block_id,
-                lb.height, lb.signed_header.commit,
+    def _verified_light_block(
+        self,
+        height: int,
+        trusted: Dict[int, LightBlock],
+        trusting_period: float = DEFAULT_TRUSTING_PERIOD,
+    ) -> LightBlock:
+        """Fetch a light block and verify it through the light-client chain
+        of trust rooted at the operator-provided trust hash — NOT against
+        its own peer-supplied validator set (stateprovider.go:33: every
+        header the state provider returns flows through light.Client
+        verification; skipping verification with bisection is
+        light/client.go:639 verifySkipping)."""
+        if height in trusted:
+            return trusted[height]
+        lower = [h for h in trusted if h < height]
+        if not lower:
+            raise SyncError(
+                f"height {height} is below the trusted root "
+                f"{min(trusted)} — cannot establish trust"
             )
+        cur = trusted[max(lower)]
+        now = _now_ts()
+        pending = [height]
+        fetched: Dict[int, LightBlock] = {}  # unverified fetch cache: each
+        # bisection retry would otherwise re-fetch the same block (10s
+        # network round-trip each)
+        while pending:
+            h = pending[-1]
+            if h in trusted:
+                cur = trusted[h]
+                pending.pop()
+                continue
+            lb = fetched.get(h)
+            if lb is None:
+                lb = fetched[h] = self._fetch_light_block(h)
+            try:
+                light_verifier.verify(
+                    cur.signed_header, cur.validators,
+                    lb.signed_header, lb.validators,
+                    trusting_period, now, MAX_CLOCK_DRIFT,
+                    light_verifier.DEFAULT_TRUST_LEVEL,
+                )
+            except light_verifier.ErrNotEnoughTrust:
+                # bisect: pivot 9/16 of the way up (client.go:44-45)
+                pivot = cur.height + (h - cur.height) * 9 // 16
+                if pivot <= cur.height or pivot >= h:
+                    raise SyncError(f"cannot bisect between {cur.height} and {h}")
+                pending.append(pivot)
+                continue
+            except ValueError as e:
+                raise SyncError(
+                    f"light block at height {h} failed verification: {e}"
+                ) from e
+            trusted[h] = lb
+            cur = lb
+            pending.pop()
+        return trusted[height]
+
+    def _sync_one(
+        self,
+        genesis_state: State,
+        snap: _SnapshotInfo,
+        chunk_timeout: float,
+        trusted: Dict[int, LightBlock],
+    ):
+        # Both headers verified through the chain of trust from the root —
+        # the trusted app hash comes from the header at snapshot height + 1.
+        snap_block = self._verified_light_block(snap.height, trusted)
+        header_next = self._verified_light_block(snap.height + 1, trusted)
+        trusted_app_hash = header_next.signed_header.header.app_hash
         if header_next.signed_header.header.last_block_id.hash != snap_block.hash():
             raise SyncError("light block chain linkage broken")
 
@@ -332,10 +409,11 @@ class StateSyncReactor:
         if info.last_block_height != snap.height:
             raise SyncError("app reported unexpected last block height")
 
-        # 6. build State (stateprovider.go State())
-        next_vals = self._fetch_light_block(snap.height + 1).validators
+        # 6. build State (stateprovider.go State()) — validator sets come
+        # from chain-of-trust-verified light blocks only.
+        next_vals = header_next.validators
         try:
-            nn_vals = self._fetch_light_block(snap.height + 2).validators
+            nn_vals = self._verified_light_block(snap.height + 2, trusted).validators
         except SyncError:
             nn_vals = next_vals
         state = State(

@@ -48,14 +48,14 @@ class TestRemoteSigner:
         pv, client = signer_pair
         assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
         v = _vote(5, 0)
-        sig = client.sign_vote(CHAIN, v)
-        assert pv.get_pub_key().verify_signature(v.sign_bytes(CHAIN), sig)
+        sv = client.sign_vote(CHAIN, v)
+        assert pv.get_pub_key().verify_signature(sv.sign_bytes(CHAIN), sv.signature)
         p = Proposal(
             height=6, round=0, pol_round=-1,
             block_id=_vote(6, 0).block_id, timestamp=Timestamp(seconds=120),
         )
-        psig = client.sign_proposal(CHAIN, p)
-        assert pv.get_pub_key().verify_signature(p.sign_bytes(CHAIN), psig)
+        sp = client.sign_proposal(CHAIN, p)
+        assert pv.get_pub_key().verify_signature(sp.sign_bytes(CHAIN), sp.signature)
         client.ping()
 
     def test_double_sign_rejected_via_remote(self, signer_pair):
@@ -70,3 +70,16 @@ class TestRemoteSigner:
         # height regression also rejected
         with pytest.raises(ValueError):
             client.sign_vote(CHAIN, _vote(6, 0))
+
+    def test_timestamp_only_resign_returns_last_signed_timestamp(self, signer_pair):
+        """privval file.go:339-341: a same-HRS re-sign where only the
+        timestamp differs must reuse the stored signature AND restore the
+        last-signed timestamp, so the returned vote verifies."""
+        pv, client = signer_pair
+        v1 = _vote(9, 0)
+        sv1 = client.sign_vote(CHAIN, v1)
+        v2 = Vote(**{**v1.__dict__, "timestamp": Timestamp(seconds=999)})
+        sv2 = client.sign_vote(CHAIN, v2)
+        assert sv2.timestamp == v1.timestamp
+        assert sv2.signature == sv1.signature
+        assert pv.get_pub_key().verify_signature(sv2.sign_bytes(CHAIN), sv2.signature)

@@ -133,3 +133,91 @@ class TestStateSync:
         assert fresh_sstore.load().last_block_height == snap_height
         assert fresh_sstore.load_validators(snap_height + 1).hash() == state.validators.hash()
         assert commit.height == snap_height
+
+
+class _OfflineReactor(StateSyncReactor):
+    """A reactor with the network replaced by a dict of light blocks, for
+    exercising the chain-of-trust verification in isolation."""
+
+    def __init__(self, chain_id, blocks):
+        self._chain_id = chain_id
+        self._blocks = blocks
+
+    def _fetch_light_block(self, height, timeout=10.0):
+        try:
+            return self._blocks[height]
+        except KeyError:
+            raise SyncError(f"no light block at height {height}")
+
+
+class TestStateSyncTrust:
+    """stateprovider.go:33: every header the state provider hands out is
+    verified through the light client from the trusted root — a
+    self-consistent forged block (attacker valset + header + commit signed
+    by the attacker) must NOT bootstrap the node."""
+
+    def _root(self, sstore, bstore, h):
+        from tendermint_tpu.light.provider import LightBlock
+        from tendermint_tpu.types import SignedHeader
+
+        meta = bstore.load_block_meta(h)
+        return LightBlock(
+            signed_header=SignedHeader(
+                header=meta.header, commit=bstore.load_block_commit(h)
+            ),
+            validators=sstore.load_validators(h),
+        )
+
+    def test_forged_light_block_rejected(self, snapshotting_chain):
+        from dataclasses import replace as dc_replace
+
+        from tendermint_tpu.light.provider import LightBlock
+        from tendermint_tpu.types import SignedHeader, Validator, ValidatorSet, Vote
+        from tendermint_tpu.types.block import BlockID, PartSetHeader
+        from tendermint_tpu.types.vote import PRECOMMIT_TYPE
+        from tendermint_tpu.types.vote_set import VoteSet
+
+        app, proxy, sstore, bstore, doc = snapshotting_chain
+        h = bstore.height() - 2
+        root = self._root(sstore, bstore, h)
+
+        atk_sk = ed25519.gen_priv_key(b"\x66" * 32)
+        atk_vset = ValidatorSet.new([Validator.new(atk_sk.pub_key(), 10)])
+        real_next = bstore.load_block_meta(h + 1).header
+        forged_header = dc_replace(
+            real_next,
+            validators_hash=atk_vset.hash(),
+            next_validators_hash=atk_vset.hash(),
+            app_hash=b"\x66" * 32,
+        )
+        bid = BlockID(
+            hash=forged_header.hash(),
+            part_set_header=PartSetHeader(total=1, hash=b"\x66" * 32),
+        )
+        vs = VoteSet(CHAIN_ID, h + 1, 0, PRECOMMIT_TYPE, atk_vset)
+        vote = Vote(
+            type=PRECOMMIT_TYPE, height=h + 1, round=0, block_id=bid,
+            timestamp=forged_header.time,
+            validator_address=atk_sk.pub_key().address(), validator_index=0,
+        )
+        vote = Vote(**{**vote.__dict__, "signature": atk_sk.sign(vote.sign_bytes(CHAIN_ID))})
+        assert vs.add_vote(vote)
+        forged = LightBlock(
+            signed_header=SignedHeader(header=forged_header, commit=vs.make_commit()),
+            validators=atk_vset,
+        )
+        # The forged block is self-consistent: its commit has 100% of its
+        # OWN validator set. Under self-referential verification it passes;
+        # under chain-of-trust verification it must fail.
+        r = _OfflineReactor(CHAIN_ID, {h: root, h + 1: forged})
+        with pytest.raises(SyncError):
+            r._verified_light_block(h + 1, {h: root})
+
+    def test_real_light_block_accepted(self, snapshotting_chain):
+        app, proxy, sstore, bstore, doc = snapshotting_chain
+        h = bstore.height() - 2
+        root = self._root(sstore, bstore, h)
+        real_next = self._root(sstore, bstore, h + 1)
+        r = _OfflineReactor(CHAIN_ID, {h: root, h + 1: real_next})
+        lb = r._verified_light_block(h + 1, {h: root})
+        assert lb.height == h + 1
