@@ -178,21 +178,92 @@ def worker() -> None:
     total = time.perf_counter() - t0
     dev_s = total / reps / n_sigs
 
+    # BASELINE config #5: pipelined adjacent-header verification
+    # (light/verifier.go VerifyAdjacent over a fetched range, signature
+    # batches double-buffered on the device via ops.pipeline). A failure
+    # here must never discard the primary metric above.
+    try:
+        hdr_rate = _bench_pipelined_headers(on_accel)
+    except Exception as e:  # noqa: BLE001
+        print(f"# pipelined-header bench failed: {e}", file=sys.stderr)
+        hdr_rate = 0.0
+
     out = {
         "metric": f"verify_commit_{n_sigs}",
         "value": round(1.0 / dev_s, 1),
         "unit": "sigs/s",
         "vs_baseline": round(host_s / dev_s, 3),
         "backend": backend_kind,
+        "pipelined_headers_per_s": round(hdr_rate, 1),
     }
     print(json.dumps(out))
     print(
         f"# backend={backend_kind} bucket={bucket} warmup={warm:.1f}s "
         f"host={1.0/host_s:.0f} sigs/s device={1.0/dev_s:.0f} sigs/s "
         f"host_prep={prep_t/reps:.3f}s/batch "
-        f"({100*prep_t/total:.0f}% of end-to-end)",
+        f"({100*prep_t/total:.0f}% of end-to-end) "
+        f"pipelined_headers={hdr_rate:.1f}/s",
         file=sys.stderr,
     )
+
+
+def _bench_pipelined_headers(on_accel: bool) -> float:
+    """Build a synthetic adjacent header chain and measure pipelined
+    verification throughput (headers/s, steady-state after warmup)."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.types import SignedHeader, Validator, ValidatorSet, Vote
+    from tendermint_tpu.types.block import BlockID, Header, PartSetHeader, Version
+    from tendermint_tpu.types.vote import PRECOMMIT_TYPE
+    from tendermint_tpu.types.vote_set import VoteSet
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    n_headers = int(os.environ.get("TM_TPU_BENCH_HEADERS", "1000" if on_accel else "32"))
+    n_vals = int(os.environ.get("TM_TPU_BENCH_HEADER_VALS", "128" if on_accel else "8"))
+    chain_id = "bench-chain"
+    sks, vals = [], []
+    for i in range(n_vals):
+        sk = ed25519.gen_priv_key((i + 7).to_bytes(32, "little"))
+        sks.append(sk)
+        vals.append(Validator.new(sk.pub_key(), 100))
+    vset = ValidatorSet.new(vals)
+    by_addr = {v.address: sk for sk, v in zip(sks, vals)}
+    ordered = [by_addr[v.address] for v in vset.validators]
+
+    shs = []
+    prev_hash = b"\x00" * 32
+    for h in range(1, n_headers + 2):
+        hdr = Header(
+            version=Version(block=11, app=0), chain_id=chain_id, height=h,
+            time=Timestamp(seconds=1_600_000_000 + h),
+            last_block_id=BlockID(
+                hash=prev_hash, part_set_header=PartSetHeader(total=1, hash=prev_hash)
+            ) if h > 1 else BlockID(),
+            validators_hash=vset.hash(), next_validators_hash=vset.hash(),
+            consensus_hash=b"\x01" * 32, app_hash=b"",
+            proposer_address=vset.validators[0].address,
+        )
+        bid = BlockID(hash=hdr.hash(), part_set_header=PartSetHeader(total=1, hash=hdr.hash()))
+        vs = VoteSet(chain_id, h, 0, PRECOMMIT_TYPE, vset)
+        for idx, sk in enumerate(ordered):
+            from dataclasses import replace as _dc_replace
+
+            v = Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=Timestamp(seconds=1_600_000_000 + h),
+                validator_address=vset.validators[idx].address, validator_index=idx,
+            )
+            v = _dc_replace(v, signature=sk.sign(v.sign_bytes(chain_id)))
+            vs.add_vote(v)
+        shs.append((SignedHeader(header=hdr, commit=vs.make_commit()), vset))
+        prev_hash = hdr.hash()
+
+    trusted = shs[0][0]
+    _pl.verify_headers_pipelined(chain_id, trusted, shs[1:2])  # warm the kernel
+    t0 = time.perf_counter()
+    _pl.verify_headers_pipelined(chain_id, trusted, shs[1:])
+    dt = time.perf_counter() - t0
+    return (len(shs) - 1) / dt
 
 
 if __name__ == "__main__":

@@ -134,12 +134,17 @@ class BlockPool:
 
     def peek_two_blocks(self):
         """reactor.go:500-520: need (first, second) to verify first."""
+        return self.peek_blocks_at(self.height)
+
+    def peek_blocks_at(self, height: int):
+        """(block at height, block at height+1) if both fetched — used by
+        the pipelined pre-verification to look one block ahead."""
         with self._mtx:
-            first = self._requests.get(self.height)
-            second = self._requests.get(self.height + 1)
+            a = self._requests.get(height)
+            b = self._requests.get(height + 1)
             return (
-                first.block if first else None,
-                second.block if second else None,
+                a.block if a else None,
+                b.block if b else None,
             )
 
     def pop_first(self) -> None:
@@ -248,8 +253,16 @@ class BlockSyncReactor:
             )
 
     def _apply_loop(self) -> None:
-        """reactor.go:500-560: verify first with second's LastCommit, apply."""
+        """reactor.go:500-560: verify first with second's LastCommit, apply.
+
+        Pipelined: while block h runs through ABCI apply (a host/process
+        round trip), block h+1's commit verification batch is already
+        in flight on the device via the shared AsyncBatchVerifier —
+        speculation is keyed on the validator-set hash and discarded if
+        the applied block changed the validators (SURVEY.md §7 hard-part
+        4; the device analog of pool.go:127's fetch/verify overlap)."""
         caught_up_reported = False
+        spec = None  # (height, valset_hash, future) of a pre-verification
         while not self._stopped.is_set():
             first, second = self._pool.peek_two_blocks()
             if first is None or second is None:
@@ -264,18 +277,82 @@ class BlockSyncReactor:
                 continue
             parts = PartSet.from_data(first.encode(), BLOCK_PART_SIZE_BYTES)
             first_id = BlockID(hash=first.hash(), part_set_header=parts.header())
-            try:
-                # VerifyCommitLight on the device engine (reactor.go:533)
-                verify_commit_light(
-                    self._state.chain_id,
-                    self._state.validators,
-                    first_id,
-                    first.header.height,
-                    second.last_commit,
-                )
-            except (ValueError, RuntimeError):
+            ok = self._take_speculation(spec, first, first_id, second)
+            spec = None
+            if ok is None:  # no usable speculation: verify synchronously
+                try:
+                    # VerifyCommitLight on the device engine (reactor.go:533)
+                    verify_commit_light(
+                        self._state.chain_id,
+                        self._state.validators,
+                        first_id,
+                        first.header.height,
+                        second.last_commit,
+                    )
+                    ok = True
+                except (ValueError, RuntimeError):
+                    ok = False
+            if not ok:
                 self._pool.redo_request(first.header.height)
                 continue
+            # launch next block's verification before the ABCI apply so the
+            # device works while the app executes transactions
+            spec = self._speculate_next(first.header.height)
             self._store.save_block(first, parts, second.last_commit)
             self._state = self._block_exec.apply_block(self._state, first_id, first)
             self._pool.pop_first()
+
+    def _speculate_next(self, applied_height: int):
+        """Pre-submit verification of the next pending block's commit,
+        assuming the validator set does not change at applied_height."""
+        from ..ops import backend as _backend
+        from ..ops import pipeline as _pipeline
+
+        nxt, after = self._pool.peek_blocks_at(applied_height + 1)
+        if nxt is None or after is None:
+            return None
+        vals = self._state.validators
+        try:
+            needed = vals.total_voting_power() * 2 // 3
+            entries, _ = _pipeline.commit_entries(
+                self._state.chain_id, vals, after.last_commit, needed
+            )
+        except (ValueError, RuntimeError, IndexError):
+            return None
+        if len(entries) < _backend.DEVICE_THRESHOLD:
+            return None  # small batches: sync path is cheaper than a round trip
+        fut = _pipeline.shared_verifier().submit(entries)
+        return (nxt.header.height, vals, vals.hash(), nxt.hash(), after.hash(), fut)
+
+    def _take_speculation(self, spec, first, first_id, second):
+        """Return True/False if the speculation covers (first, second) with
+        the current validator set, else None (caller verifies sync)."""
+        if spec is None:
+            return None
+        height, spec_vals, valhash, fhash, shash, fut = spec
+        cur_vals = self._state.validators
+        if height != first.header.height:
+            return None
+        # identity first: the common no-valset-change case skips a full
+        # Merkle rehash of the set on every applied block
+        if spec_vals is not cur_vals and valhash != cur_vals.hash():
+            return None
+        if fhash != first_id.hash or shash != second.hash():
+            return None
+        try:
+            valid = fut.result(timeout=300)
+        except Exception:  # noqa: BLE001
+            return None
+        if not bool(valid.all()):
+            return False
+        # structural checks the speculative path skipped
+        try:
+            from ..types.validation import _verify_basic_vals_and_commit
+
+            _verify_basic_vals_and_commit(
+                self._state.validators, second.last_commit,
+                first.header.height, first_id,
+            )
+        except (ValueError, RuntimeError):
+            return False
+        return True

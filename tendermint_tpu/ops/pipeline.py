@@ -1,0 +1,248 @@
+"""Async device verification pipeline — overlap host prep with device work.
+
+SURVEY.md §7 hard-part 4 and the reference's pipelined sync shape
+(internal/blocksync/pool.go:127 parallel requesters feeding a sequential
+verify/apply loop): verification batches are submitted to a single worker
+thread that dispatches the jitted kernel asynchronously (JAX dispatch
+returns before the device finishes) and only blocks on a result when the
+pipeline is `depth` batches deep — so batch N's host prep (sign-bytes
+construction, limb packing) runs while batch N-1 executes on device, and
+the device never waits on the host between batches.
+
+Consumers:
+- blocksync reactor: speculative pre-verification of the next block's
+  commit while the current block runs through ABCI apply.
+- light client header sync: verify_headers_pipelined — BASELINE config #5
+  (pipelined 1k-header verify).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..types.validation import ErrNotEnoughVotingPowerSigned
+from . import backend as _backend
+from . import ed25519_verify as _kernel
+
+
+class _Job:
+    __slots__ = ("entries", "future")
+
+    def __init__(self, entries):
+        self.entries = entries
+        self.future: Future = Future()
+
+
+class AsyncBatchVerifier:
+    """Double-buffered pipeline over the device engine.
+
+    submit(entries) returns a Future resolving to the (n,) bool validity
+    array. One worker thread owns all device dispatches; `depth` in-flight
+    batches bound device memory (2 = classic double buffering).
+    """
+
+    def __init__(self, depth: int = 2):
+        self._depth = max(depth, 1)
+        self._q: "queue.Queue[_Job]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def submit(self, entries: Sequence[Tuple[bytes, bytes, bytes]]) -> Future:
+        if self._stopped.is_set():
+            raise RuntimeError("verifier is closed")
+        job = _Job(list(entries))
+        self._q.put(job)
+        return job.future
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._thread.join(timeout=5)
+
+    # -- worker ----------------------------------------------------------
+
+    def _dispatch(self, entries):
+        """Host prep + async device dispatch (does not block on result)."""
+        device_hash = not _backend.HOST_HASH and all(
+            len(m) <= _backend.DEVICE_HASH_MAX_MSG for _, m, _ in entries
+        )
+        bucket = _backend._bucket_for(len(entries))
+        if device_hash:
+            args = _backend.prepare_batch_device_hash(entries, bucket)
+            return _kernel.jitted_verify_device_hash()(*args)
+        args = _backend.prepare_batch(entries, bucket)
+        return _kernel.jitted_verify()(*args)
+
+    def _resolve(self, job: _Job, dev) -> None:
+        try:
+            job.future.set_result(np.asarray(dev)[: len(job.entries)])
+        except Exception as e:  # noqa: BLE001
+            job.future.set_exception(e)
+
+    def _worker(self) -> None:
+        pending: deque = deque()  # (job, device_value)
+        while not (self._stopped.is_set() and self._q.empty() and not pending):
+            job = None
+            try:
+                job = self._q.get(timeout=0.02 if pending else 0.2)
+            except queue.Empty:
+                pass
+            if job is not None:
+                if len(job.entries) > _backend.BUCKETS[-1]:
+                    # oversized: chunked synchronous fallback
+                    try:
+                        job.future.set_result(_backend.verify_batch(job.entries))
+                    except Exception as e:  # noqa: BLE001
+                        job.future.set_exception(e)
+                else:
+                    try:
+                        dev = self._dispatch(job.entries)
+                        pending.append((job, dev))
+                    except Exception as e:  # noqa: BLE001
+                        job.future.set_exception(e)
+                while len(pending) > self._depth:
+                    j, d = pending.popleft()
+                    self._resolve(j, d)
+            elif pending:
+                j, d = pending.popleft()
+                self._resolve(j, d)
+
+
+_shared: Optional[AsyncBatchVerifier] = None
+_shared_mtx = threading.Lock()
+
+
+def shared_verifier() -> AsyncBatchVerifier:
+    """Process-wide pipeline instance (device submission is serialized
+    through one thread regardless of how many reactors use it)."""
+    global _shared
+    with _shared_mtx:
+        if _shared is None:
+            _shared = AsyncBatchVerifier()
+        return _shared
+
+
+# ---------------------------------------------------------------------------
+# Commit-level helpers: host-side entry construction mirrors
+# types/validation.go:152 verifyCommitBatch, device path per signature.
+# ---------------------------------------------------------------------------
+
+
+def commit_entries(
+    chain_id: str, vals, commit, voting_power_needed: int
+) -> Tuple[list, int]:
+    """Build (pub, sign_bytes, sig) entries for a commit's for-block
+    signatures (index lookup, early-stop past 2/3 like validation.go:152
+    with countAllSignatures=false). Returns (entries, tallied_power).
+    Raises on structural problems (bad counts, short power)."""
+    entries = []
+    tallied = 0
+    for idx, cs in enumerate(commit.signatures):
+        if not cs.for_block():
+            continue
+        val = vals.validators[idx]
+        entries.append(
+            (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
+        )
+        tallied += val.voting_power
+        if tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    return entries, tallied
+
+
+def verify_commits_pipelined(
+    chain_id: str,
+    jobs: Sequence[Tuple[object, object, int, object]],
+    verifier: Optional[AsyncBatchVerifier] = None,
+) -> List[Optional[str]]:
+    """jobs: (vals, block_id, height, commit) per header. All host prep
+    and device batches flow through the pipeline; returns one entry per
+    job — None on success or an error string.
+
+    The per-job semantics match verify_commit_light (types/validation.go
+    :59): basic val/commit binding, then +2/3 of `vals` must have signed
+    `block_id` at `height` with valid signatures.
+    """
+    from ..types.validation import _verify_basic_vals_and_commit
+
+    v = verifier or shared_verifier()
+    futures: List[Optional[Future]] = []
+    errors: List[Optional[str]] = [None] * len(jobs)
+    for i, (vals, block_id, height, commit) in enumerate(jobs):
+        try:
+            _verify_basic_vals_and_commit(vals, commit, height, block_id)
+            needed = vals.total_voting_power() * 2 // 3
+            entries, _ = commit_entries(chain_id, vals, commit, needed)
+            futures.append(v.submit(entries))
+        except (ValueError, RuntimeError) as e:
+            errors[i] = str(e)
+            futures.append(None)
+    for i, fut in enumerate(futures):
+        if fut is None:
+            continue
+        try:
+            valid = fut.result(timeout=300)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = str(e)
+            continue
+        if not bool(np.asarray(valid).all()):
+            bad = int(np.argmin(np.asarray(valid)))
+            errors[i] = f"wrong signature (batch lane {bad})"
+    return errors
+
+
+def verify_headers_pipelined(
+    chain_id: str,
+    trusted_header,
+    headers: Sequence[Tuple[object, object]],
+) -> None:
+    """Pipelined ADJACENT header-chain verification (BASELINE config #5:
+    light/verifier.go VerifyAdjacent's checks over a fetched range, with
+    all commit signature batches overlapped on the device).
+
+    headers: ordered [(signed_header, validator_set), ...] starting at
+    trusted_header.height + 1, strictly adjacent. Raises ValueError on the
+    first failure (host continuity checks first — they are cheap — then
+    the pipelined signature verdicts in order)."""
+    from ..types.block import BlockID
+
+    prev = trusted_header
+    jobs = []
+    for sh, vals in headers:
+        if sh.header.height != prev.header.height + 1:
+            raise ValueError(
+                f"headers must be adjacent: {sh.header.height} after {prev.header.height}"
+            )
+        sh.validate_basic(chain_id)
+        if sh.header.validators_hash != vals.hash():
+            raise ValueError(
+                f"header {sh.header.height} validators_hash does not match supplied set"
+            )
+        if sh.header.validators_hash != prev.header.next_validators_hash:
+            raise ValueError(
+                f"header {sh.header.height} validators_hash breaks continuity"
+            )
+        jobs.append(
+            (
+                vals,
+                BlockID(
+                    hash=sh.commit.block_id.hash,
+                    part_set_header=sh.commit.block_id.part_set_header,
+                ),
+                sh.header.height,
+                sh.commit,
+            )
+        )
+        prev = sh
+    errors = verify_commits_pipelined(chain_id, jobs)
+    for (sh, _), err in zip(headers, errors):
+        if err is not None:
+            raise ValueError(f"header {sh.header.height}: {err}")
