@@ -26,20 +26,25 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import fe
+from . import fe, sc, sha512 as _sha
 from ..crypto import _edwards
 
 # Curve constants in limb form (host-computed Python ints -> 20-limb arrays).
-D_L = jnp.asarray(fe.limbs_from_int(_edwards.D))
-D2_L = jnp.asarray(fe.limbs_from_int(_edwards.D2))
-SQRT_M1_L = jnp.asarray(fe.limbs_from_int(_edwards.SQRT_M1))
-BX_L = jnp.asarray(fe.limbs_from_int(_edwards.BASE[0]))
-BY_L = jnp.asarray(fe.limbs_from_int(_edwards.BASE[1]))
-BT_L = jnp.asarray(fe.limbs_from_int(_edwards.BASE[3]))
+# Kept as NUMPY arrays, not jnp: a module-level jnp constant created while
+# another function is being traced becomes a tracer and leaks (the r2 bench
+# crash); numpy constants are trace-immune and jit folds them identically.
+D_L = np.asarray(fe.limbs_from_int(_edwards.D))
+D2_L = np.asarray(fe.limbs_from_int(_edwards.D2))
+SQRT_M1_L = np.asarray(fe.limbs_from_int(_edwards.SQRT_M1))
+BX_L = np.asarray(fe.limbs_from_int(_edwards.BASE[0]))
+BY_L = np.asarray(fe.limbs_from_int(_edwards.BASE[1]))
+BT_L = np.asarray(fe.limbs_from_int(_edwards.BASE[3]))
 
 SCALAR_BITS = 253  # s, k < L < 2^253
 
@@ -230,8 +235,6 @@ def verify_kernel_device_hash(
     computed on-chip (ops.sha512 + ops.sc) before the ladder — no host
     hashing in the hot loop (SURVEY.md §7 hard-part #2 resolved on
     device)."""
-    from . import sc, sha512 as _sha
-
     digest = _sha.sha512_blocks(blocks_hi, blocks_lo, n_blocks)
     k_limbs = sc.mod_l_from_bits(sc.digest_to_le_bits(digest))
     k_bits_t = sc.limbs_to_bits(k_limbs, SCALAR_BITS)

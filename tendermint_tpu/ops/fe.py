@@ -60,20 +60,23 @@ def int_from_limbs(a) -> int:
     return int(sum(int(a[i]) << (RADIX * i) for i in range(NLIMBS)))
 
 
-ZERO = jnp.zeros(NLIMBS, dtype=jnp.int32)
-ONE = jnp.asarray(limbs_from_int(1))
-P_LIMBS = jnp.asarray(limbs_raw(P))  # limbs of p itself (NOT reduced!)
+# Module constants stay NUMPY (never jnp): a jnp array materialized at import
+# time *during an active trace* (lazy import under jit) leaks as a tracer;
+# numpy constants are immune and jit constant-folds them the same way.
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
+ONE = np.asarray(limbs_from_int(1))
+P_LIMBS = np.asarray(limbs_raw(P))  # limbs of p itself (NOT reduced!)
 
 # 8p in radix-13 limbs (fits: 8p < 2^258 < 2^260). Added before
 # canonicalization so possibly-negative reduced values become positive.
-P8_LIMBS = jnp.asarray(limbs_raw(8 * P))
+P8_LIMBS = np.asarray(limbs_raw(8 * P))
 
 # Convolution index/mask matrices: TOEP_IDX[k, i] = k - i (clipped),
 # TOEP_MSK[k, i] = 1 iff 0 <= k - i < NLIMBS.
 _k = np.arange(2 * NLIMBS - 1)[:, None]
 _i = np.arange(NLIMBS)[None, :]
-TOEP_IDX = jnp.asarray(np.clip(_k - _i, 0, NLIMBS - 1).astype(np.int32))
-TOEP_MSK = jnp.asarray((((_k - _i) >= 0) & ((_k - _i) < NLIMBS)).astype(np.int32))
+TOEP_IDX = np.clip(_k - _i, 0, NLIMBS - 1).astype(np.int32)
+TOEP_MSK = (((_k - _i) >= 0) & ((_k - _i) < NLIMBS)).astype(np.int32)
 
 
 def _carry_pass(x):

@@ -16,7 +16,9 @@ from jax import lax
 from ..crypto._edwards import L
 from . import fe
 
-L_LIMBS = jnp.asarray(fe.limbs_raw(L))
+# numpy, not jnp: trace-immune if this module is first imported under a jit
+# trace (the round-2 bench tracer-leak root cause).
+L_LIMBS = np.asarray(fe.limbs_raw(L))
 
 
 def _cond_sub_l(x):

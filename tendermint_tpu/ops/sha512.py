@@ -39,8 +39,9 @@ _K = [
     0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
     0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
 ]
-_K_HI = jnp.asarray([(k >> 32) & 0xFFFFFFFF for k in _K], dtype=jnp.uint32)
-_K_LO = jnp.asarray([k & 0xFFFFFFFF for k in _K], dtype=jnp.uint32)
+# numpy, not jnp: trace-immune under lazy import (see ops/fe.py note).
+_K_HI = np.asarray([(k >> 32) & 0xFFFFFFFF for k in _K], dtype=np.uint32)
+_K_LO = np.asarray([k & 0xFFFFFFFF for k in _K], dtype=np.uint32)
 
 _IV = [
     0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
