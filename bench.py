@@ -27,8 +27,16 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT = float(os.environ.get("TM_TPU_BENCH_PROBE_TIMEOUT", "120"))
+# Escalating probe timeouts: the TPU plugin has been observed to hang on
+# one attempt and come up fine on the next — fight for it over a
+# multi-minute window before conceding (round-2 lesson: one 120s probe
+# gave up and the round recorded a CPU number).
+PROBE_TIMEOUTS = tuple(
+    float(t)
+    for t in os.environ.get("TM_TPU_BENCH_PROBE_TIMEOUTS", "90,180,300").split(",")
+)
 WORKER_TIMEOUT = float(os.environ.get("TM_TPU_BENCH_WORKER_TIMEOUT", "900"))
+ACCEL_ATTEMPTS = int(os.environ.get("TM_TPU_BENCH_ACCEL_ATTEMPTS", "2"))
 
 
 def _cache_env(env: dict, cpu: bool = False) -> dict:
@@ -46,15 +54,15 @@ def _cache_env(env: dict, cpu: bool = False) -> dict:
 
 
 def _probe_backend() -> str:
-    """Ask a subprocess what jax.default_backend() is, with a hard timeout
-    and one retry — survives a hung/broken PJRT plugin. Returns the
-    backend name, or None if the probe itself failed (hang/crash)."""
+    """Ask a subprocess what jax.default_backend() is, with escalating hard
+    timeouts — survives a hung/broken PJRT plugin. Returns the backend
+    name, or None if every probe failed (hang/crash)."""
     code = "import jax; print(jax.default_backend())"
-    for attempt in range(2):
+    for attempt, timeout_s in enumerate(PROBE_TIMEOUTS):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+                capture_output=True, text=True, timeout=timeout_s,
                 env=_cache_env(os.environ), cwd=os.path.dirname(os.path.abspath(__file__)),
             )
             if out.returncode == 0 and out.stdout.strip():
@@ -66,9 +74,9 @@ def _probe_backend() -> str:
         except subprocess.TimeoutExpired:
             print(
                 f"# backend probe attempt {attempt} timed out after "
-                f"{PROBE_TIMEOUT}s", file=sys.stderr,
+                f"{timeout_s}s", file=sys.stderr,
             )
-        time.sleep(2 * (attempt + 1))
+        time.sleep(5 * (attempt + 1))
     return None
 
 
@@ -104,15 +112,20 @@ def _run_worker(force_cpu: bool) -> dict | None:
 def main() -> None:
     backend = _probe_backend()
     print(f"# probed backend: {backend}", file=sys.stderr)
-    if backend is None:
-        # backend init is hung/broken — don't let the worker hang on it for
-        # another WORKER_TIMEOUT; go straight to the CPU fallback
+    # Fight for the accelerator: even when the probe failed (None), the
+    # worker gets its own attempts under WORKER_TIMEOUT — a hung probe does
+    # not mean the next plugin init will hang too. Only surrender to CPU
+    # after every accel attempt has failed.
+    result = None
+    if backend != "cpu":
+        for attempt in range(ACCEL_ATTEMPTS):
+            result = _run_worker(force_cpu=False)
+            if result is not None:
+                break
+            print(f"# accel worker attempt {attempt} failed", file=sys.stderr)
+            time.sleep(10)
+    if result is None:
         result = _run_worker(force_cpu=True)
-    else:
-        result = _run_worker(force_cpu=False)
-        if result is None and backend != "cpu":
-            # accel path failed — fall back to the in-process CPU backend
-            result = _run_worker(force_cpu=True)
     if result is None:
         result = {
             "metric": "verify_commit_10k", "value": 0.0, "unit": "sigs/s",
@@ -125,6 +138,30 @@ def main() -> None:
 # ---------------------------------------------------------------------------
 # Worker: the actual measurement (runs in a subprocess).
 # ---------------------------------------------------------------------------
+
+
+def _mp_verify_chunk(chunk) -> bool:
+    from tendermint_tpu.crypto import ed25519 as _e
+
+    return all(_e.verify_zip215_fast(p, m, s) for p, m, s in chunk)
+
+
+def _host_multicore_rate(entries) -> float:
+    """Strongest-CPU figure the 20x claim gets judged against: per-sig
+    OpenSSL verify fanned over every core (the reference's Go batch
+    verifier is single-threaded, but a fair host baseline isn't)."""
+    import multiprocessing as mp
+
+    nproc = min(mp.cpu_count(), 32)
+    chunks = [entries[i::nproc] for i in range(nproc)]
+    ctx = mp.get_context("spawn")  # no fork: jax/TPU client is live here
+    with ctx.Pool(nproc) as pool:
+        pool.map(_mp_verify_chunk, [c[:2] for c in chunks])  # warm imports
+        t0 = time.perf_counter()
+        oks = pool.map(_mp_verify_chunk, chunks)
+    dt = time.perf_counter() - t0
+    assert all(oks)
+    return len(entries) / dt
 
 
 def worker() -> None:
@@ -178,6 +215,12 @@ def worker() -> None:
     total = time.perf_counter() - t0
     dev_s = total / reps / n_sigs
 
+    try:
+        host_mc = _host_multicore_rate(entries)
+    except Exception as e:  # noqa: BLE001
+        print(f"# multicore host baseline failed: {e}", file=sys.stderr)
+        host_mc = 0.0
+
     # BASELINE config #5: pipelined adjacent-header verification
     # (light/verifier.go VerifyAdjacent over a fetched range, signature
     # batches double-buffered on the device via ops.pipeline). A failure
@@ -194,12 +237,16 @@ def worker() -> None:
         "unit": "sigs/s",
         "vs_baseline": round(host_s / dev_s, 3),
         "backend": backend_kind,
+        "host_sigs_per_s": round(1.0 / host_s, 1),
+        "host_multicore_sigs_per_s": round(host_mc, 1),
+        "vs_host_multicore": round(1.0 / dev_s / host_mc, 3) if host_mc else 0.0,
         "pipelined_headers_per_s": round(hdr_rate, 1),
     }
     print(json.dumps(out))
     print(
         f"# backend={backend_kind} bucket={bucket} warmup={warm:.1f}s "
-        f"host={1.0/host_s:.0f} sigs/s device={1.0/dev_s:.0f} sigs/s "
+        f"host={1.0/host_s:.0f} sigs/s host_mc={host_mc:.0f} sigs/s "
+        f"device={1.0/dev_s:.0f} sigs/s "
         f"host_prep={prep_t/reps:.3f}s/batch "
         f"({100*prep_t/total:.0f}% of end-to-end) "
         f"pipelined_headers={hdr_rate:.1f}/s",

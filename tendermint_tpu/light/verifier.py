@@ -9,7 +9,7 @@ pipelined 1k-header sync workload of BASELINE config #5.
 
 from __future__ import annotations
 
-from ..types import Fraction, SignedHeader, ValidatorSet
+from ..types import ErrNotEnoughVotingPowerSigned, Fraction, SignedHeader, ValidatorSet
 from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
@@ -110,14 +110,20 @@ def verify_adjacent(
             f"expected old header next validators ({trusted_header.header.next_validators_hash.hex()}) "
             f"to match those from new header ({untrusted_header.header.validators_hash.hex()})"
         )
-    # full commit verification on the device engine (verifier.go:143-148)
-    verify_commit_light(
-        trusted_header.header.chain_id,
-        untrusted_vals,
-        untrusted_header.commit.block_id,
-        untrusted_header.header.height,
-        untrusted_header.commit,
-    )
+    # full commit verification on the device engine (verifier.go:143-148);
+    # any commit defect surfaces as ErrInvalidHeader
+    try:
+        verify_commit_light(
+            trusted_header.header.chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except ErrInvalidHeader:
+        raise
+    except ValueError as e:
+        raise ErrInvalidHeader(str(e)) from e
 
 
 def verify_non_adjacent(
@@ -139,7 +145,9 @@ def verify_non_adjacent(
     verify_new_header_and_vals(
         untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
     )
-    # trust-level check against the OLD validator set (verifier.go:67-80)
+    # trust-level check against the OLD validator set (verifier.go:67-80):
+    # only insufficient tallied power is a (retryable) trust failure —
+    # any other commit defect is an invalid header.
     try:
         verify_commit_light_trusting(
             trusted_header.header.chain_id,
@@ -147,16 +155,23 @@ def verify_non_adjacent(
             untrusted_header.commit,
             trust_level,
         )
-    except ValueError as e:
+    except ErrNotEnoughVotingPowerSigned as e:
         raise ErrNotEnoughTrust(str(e)) from e
+    except ValueError as e:
+        raise ErrInvalidHeader(str(e)) from e
     # then the full +2/3 of the NEW set (verifier.go:82-88)
-    verify_commit_light(
-        trusted_header.header.chain_id,
-        untrusted_vals,
-        untrusted_header.commit.block_id,
-        untrusted_header.header.height,
-        untrusted_header.commit,
-    )
+    try:
+        verify_commit_light(
+            trusted_header.header.chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except ErrInvalidHeader:
+        raise
+    except ValueError as e:
+        raise ErrInvalidHeader(str(e)) from e
 
 
 def verify(

@@ -127,8 +127,11 @@ def _compress_block(state, block_hi, block_lo):
         s1 = _big_sigma1(e_h, e_l)
         ch_h = (e_h & f_h) ^ (~e_h & g_h)
         ch_l = (e_l & f_l) ^ (~e_l & g_l)
-        kt_h = _K_HI[t]
-        kt_l = _K_LO[t]
+        # jnp.asarray inside the trace: the constant is created in the same
+        # trace that consumes it (numpy module constants are trace-immune,
+        # but numpy can't be indexed by the tracer t directly).
+        kt_h = jnp.asarray(_K_HI)[t]
+        kt_l = jnp.asarray(_K_LO)[t]
         t1 = _add64(h_h, h_l, *s1)
         t1 = _add64(*t1, ch_h, ch_l)
         t1 = _add64(*t1, jnp.broadcast_to(kt_h, h_h.shape), jnp.broadcast_to(kt_l, h_l.shape))

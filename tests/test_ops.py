@@ -7,6 +7,7 @@ pure-Python oracle (crypto/_edwards), including the ZIP-215 edge cases the
 reference inherits from curve25519-voi (small-order points, non-canonical
 encodings, s >= L)."""
 
+import os
 import random
 
 import numpy as np
@@ -179,3 +180,44 @@ class TestShardedCommit:
             sharded.split_power(np.asarray([2**62]))
         with pytest.raises(ValueError):
             sharded.split_power(np.asarray([-1]))
+
+
+class TestFreshImportUnderTrace:
+    """Regression for the round-2 bench crash: the device-hash kernel was
+    the FIRST jax trace in the process, and a lazy `from . import sc`
+    inside it materialized module-level jnp constants inside the trace
+    (ops/sc.py L_LIMBS leaked as a DynamicJaxprTracer). The fix is
+    two-fold: module-scope imports in ops/ed25519_verify.py and numpy
+    (trace-immune) module constants; this test reproduces the bench's
+    exact import order in a fresh interpreter so a regression fails here
+    and not in the driver's bench run."""
+
+    def test_device_hash_kernel_first_trace(self):
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            "import numpy as np\n"
+            "from tendermint_tpu.crypto import ed25519\n"
+            "from tendermint_tpu.ops import backend\n"
+            "sk = ed25519.gen_priv_key(b'\\x07' * 32)\n"
+            "msg = b'fresh-trace'\n"
+            "entries = [(sk.pub_key().bytes(), msg, sk.sign(msg))]\n"
+            "args = backend.prepare_batch_device_hash(entries, 128)\n"
+            "kern = backend.ed25519_verify.jitted_verify_device_hash()\n"
+            "res = np.asarray(kern(*args))\n"
+            "assert bool(res[0]), 'signature must verify'\n"
+            "print('OK')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "OK" in out.stdout
