@@ -49,6 +49,40 @@ class ErrLightClientAttack(RuntimeError):
     """detector.go: divergence between primary and witness."""
 
 
+class ErrNoWitnesses(RuntimeError):
+    """light/errors.go ErrNoWitnesses."""
+
+
+class ErrFailedHeaderCrossReferencing(RuntimeError):
+    """light/errors.go: no witness could confirm the primary's header."""
+
+
+def make_attack_evidence(conflicted: LightBlock, trusted: LightBlock, common: LightBlock):
+    """detector.go:406-423 newLightClientAttackEvidence. The common height
+    encodes the attack form: lunatic (forged state hashes) points at the
+    last common header; equivocation/amnesia at the conflicting height."""
+    from ..types.evidence import LightBlockData, LightClientAttackEvidence
+
+    ev = LightClientAttackEvidence(
+        conflicting_block=LightBlockData.from_parts(
+            conflicted.signed_header, conflicted.validators
+        ),
+        common_height=0,
+    )
+    if ev.conflicting_header_is_invalid(trusted.signed_header.header):
+        ev.common_height = common.height
+        ev.timestamp = common.signed_header.header.time
+        ev.total_voting_power = common.validators.total_voting_power()
+    else:
+        ev.common_height = trusted.height
+        ev.timestamp = trusted.signed_header.header.time
+        ev.total_voting_power = trusted.validators.total_voting_power()
+    ev.byzantine_validators = ev.get_byzantine_validators(
+        common.validators, trusted.signed_header
+    )
+    return ev
+
+
 def _now_ts() -> Timestamp:
     t = _time.time()
     return Timestamp(seconds=int(t), nanos=int((t % 1) * 1e9))
@@ -219,24 +253,143 @@ class Client:
         self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
     ) -> None:
         """client.go:722-780 + detector.go: verify against the primary,
-        then cross-check the final header with every witness."""
-        self._verify_skipping(self._primary, trusted, new_block, now)
-        self._detect_divergence(new_block, now)
+        then cross-check the verified trace with every witness."""
+        trace = self._verify_skipping(self._primary, trusted, new_block, now)
+        self._detect_divergence(trace, now)
 
-    def _detect_divergence(self, new_block: LightBlock, now: Timestamp) -> None:
-        """detector.go:40-120 (comparison phase; evidence construction is
-        handled by the evidence pool when running in a full node)."""
+    # -- divergence detector (detector.go) --------------------------------
+
+    def _detect_divergence(self, primary_trace: List[LightBlock], now: Timestamp) -> None:
+        """detector.go:28-118 detectDivergence: compare the end of the
+        verified trace with each witness; on a conflicting header, examine
+        it against the trace, build LightClientAttackEvidence for both
+        sides, submit, and halt. Witnesses that conflict but cannot sustain
+        their own header are removed; if no witness matches, verification
+        fails with ErrFailedHeaderCrossReferencing."""
+        if not primary_trace or len(primary_trace) < 2:
+            return  # nothing beyond the root of trust to cross-examine
+        if not self._witnesses:
+            raise ErrNoWitnesses("no witnesses connected. falling back to primary")
+        last = primary_trace[-1]
+        header_matched = False
+        to_remove: List[int] = []
         for i, witness in enumerate(self._witnesses):
             try:
-                w_block = witness.light_block(new_block.height)
+                w_block = witness.light_block(last.height)
             except (ErrLightBlockNotFound, ConnectionError):
                 continue  # witness doesn't have it (yet) — tolerated
-            if w_block.hash() != new_block.hash():
-                raise ErrLightClientAttack(
-                    f"witness #{i} has a different header "
-                    f"{w_block.hash().hex()} != {new_block.hash().hex()} "
-                    f"at height {new_block.height}"
-                )
+            if w_block.hash() != last.hash():
+                # raises ErrLightClientAttack when the conflict is real;
+                # returns normally when the witness can't sustain it
+                self._handle_conflicting_headers(primary_trace, w_block, i, now)
+                to_remove.append(i)
+            else:
+                header_matched = True
+        for i in reversed(to_remove):
+            del self._witnesses[i]
+        if not header_matched:
+            raise ErrFailedHeaderCrossReferencing(
+                "all witnesses have either not responded, don't have the "
+                "block or sent invalid blocks"
+            )
+
+    def _handle_conflicting_headers(
+        self,
+        primary_trace: List[LightBlock],
+        challenging_block: LightBlock,
+        witness_index: int,
+        now: Timestamp,
+    ) -> None:
+        """detector.go:228-290 handleConflictingHeaders: hold the witness
+        as source of truth -> evidence against the primary; then reverse
+        roles -> evidence against the witness; always halt with
+        ErrLightClientAttack."""
+        witness = self._witnesses[witness_index]
+        try:
+            witness_trace, primary_block = self._examine_conflicting_header_against_trace(
+                primary_trace, challenging_block, witness, now
+            )
+        except (ValueError, RuntimeError, ErrLightBlockNotFound, ConnectionError):
+            # witness couldn't sustain its own header — not an attack proof
+            return
+        common, trusted_block = witness_trace[0], witness_trace[-1]
+        ev_against_primary = make_attack_evidence(primary_block, trusted_block, common)
+        self._send_evidence(ev_against_primary, witness)
+
+        # Reverse: examine the witness's trace holding the primary as the
+        # source of truth (best effort — we halt either way).
+        try:
+            primary_trace2, witness_block = self._examine_conflicting_header_against_trace(
+                witness_trace, primary_block, self._primary, now
+            )
+            common2, trusted2 = primary_trace2[0], primary_trace2[-1]
+            ev_against_witness = make_attack_evidence(witness_block, trusted2, common2)
+            self._send_evidence(ev_against_witness, self._primary)
+        except (ValueError, RuntimeError, ErrLightBlockNotFound, ConnectionError):
+            pass
+        raise ErrLightClientAttack(
+            f"conflicting header at height {challenging_block.height}: "
+            f"witness #{witness_index} {challenging_block.hash().hex()} vs "
+            f"primary {primary_trace[-1].hash().hex()}"
+        )
+
+    def _examine_conflicting_header_against_trace(
+        self,
+        trace: List[LightBlock],
+        target_block: LightBlock,
+        source: Provider,
+        now: Timestamp,
+    ) -> tuple:
+        """detector.go:289-374 examineConflictingHeaderAgainstTrace: walk
+        the trace verifying the source's chain at each intermediate height
+        until the bifurcation point. Returns (source_trace,
+        divergent_trace_block)."""
+        if target_block.height < trace[0].height:
+            raise ValueError(
+                f"target block height {target_block.height} below trusted "
+                f"height {trace[0].height}"
+            )
+        previously_verified: Optional[LightBlock] = None
+        source_trace: List[LightBlock] = []
+        for idx, trace_block in enumerate(trace):
+            # forward lunatic: the trace extends beyond the target
+            if trace_block.height > target_block.height:
+                tb_t = trace_block.signed_header.header.time
+                tg_t = target_block.signed_header.header.time
+                if (tb_t.seconds, tb_t.nanos) > (tg_t.seconds, tg_t.nanos):
+                    raise RuntimeError(
+                        "sanity: trace block after target must not be newer"
+                    )
+                if previously_verified.height != target_block.height:
+                    source_trace = self._verify_skipping(
+                        source, previously_verified, target_block, now
+                    )
+                return source_trace, trace_block
+            if trace_block.height == target_block.height:
+                source_block = target_block
+            else:
+                source_block = source.light_block(trace_block.height)
+            if idx == 0:
+                if source_block.hash() != trace_block.hash():
+                    raise ValueError(
+                        "trusted block differs from the source's first block"
+                    )
+                previously_verified = source_block
+                continue
+            source_trace = self._verify_skipping(
+                source, previously_verified, source_block, now
+            )
+            if source_block.hash() != trace_block.hash():
+                return source_trace, trace_block  # bifurcation point
+            previously_verified = source_block
+        raise RuntimeError("no divergence found along the trace")
+
+    def _send_evidence(self, ev, receiver: Provider) -> None:
+        """detector.go:220-226 sendEvidence (best effort)."""
+        try:
+            receiver.report_evidence(ev)
+        except Exception:  # noqa: BLE001 — provider failure must not mask the halt
+            pass
 
     def _backwards(
         self, trusted: LightBlock, height: int, now: Timestamp

@@ -131,6 +131,18 @@ class LightBlockData:
     def validator_set(self) -> ValidatorSet:
         return ValidatorSet.decode(self.validator_set_raw)
 
+    @classmethod
+    def from_parts(cls, signed_header, validator_set) -> "LightBlockData":
+        """Encode a (SignedHeader, ValidatorSet) pair into wire form — the
+        shape the light-client detector captures a conflicting block in
+        (detector.go:406 newLightClientAttackEvidence)."""
+        w = ProtoWriter()
+        w.write_message(1, signed_header.header.encode(), always=True)
+        w.write_message(2, signed_header.commit.encode(), always=True)
+        return cls(
+            signed_header_raw=w.bytes(), validator_set_raw=validator_set.encode()
+        )
+
 
 @dataclass
 class LightClientAttackEvidence:
@@ -172,44 +184,47 @@ class LightClientAttackEvidence:
         ]
 
     def get_byzantine_validators(
-        self, common_vals: ValidatorSet, trusted_header_hash: bytes
+        self, common_vals: ValidatorSet, trusted
     ) -> List[Validator]:
-        """evidence.go:277-307: lunatic attack -> common-height signers of
-        the conflicting block; equivocation/amnesia -> conflicting signers."""
+        """evidence.go GetByzantineValidators: lunatic attack -> the
+        common-height validators who signed the lunatic header;
+        equivocation (same round) -> validators who signed both blocks;
+        amnesia (different rounds) -> indeterminable, empty set.
+        `trusted` is the SignedHeader at the conflicting height."""
         out: List[Validator] = []
-        conflicting_header = self.conflicting_block.header()
         commit = self.conflicting_block.commit()
-        if conflicting_header.hash() == trusted_header_hash:
-            return out
-        if self.conflicting_header_is_invalid(trusted_header_hash, None):
-            # Lunatic: blame common-height validators who signed.
+        if self.conflicting_header_is_invalid(trusted.header):
+            # Lunatic: blame common-height validators who voted for it.
             for cs in commit.signatures:
-                if cs.is_absent():
+                if not cs.for_block():
                     continue
                 _, val = common_vals.get_by_address(cs.validator_address)
                 if val is not None:
                     out.append(val)
-            out.sort(key=lambda v: v.address)
-        else:
-            # Equivocation/amnesia: blame conflicting-block signers.
+            return out
+        if trusted.commit.round == commit.round:
+            # Equivocation: blame validators who signed both conflicting
+            # blocks (same commit index in both commits).
             vals = self.conflicting_block.validator_set()
-            for cs in commit.signatures:
-                if cs.is_absent():
+            for i, sig_a in enumerate(commit.signatures):
+                if not sig_a.for_block():
                     continue
-                _, val = vals.get_by_address(cs.validator_address)
+                if i >= len(trusted.commit.signatures):
+                    continue
+                sig_b = trusted.commit.signatures[i]
+                if not sig_b.for_block():
+                    continue
+                _, val = vals.get_by_address(sig_a.validator_address)
                 if val is not None:
                     out.append(val)
-            out.sort(key=lambda v: v.address)
+        # Amnesia (differing rounds): byzantine set not deducible.
         return out
 
-    def conflicting_header_is_invalid(
-        self, trusted_header_hash: bytes, trusted_header: Optional[Header]
-    ) -> bool:
-        """evidence.go:320-330: lunatic iff the conflicting header's
-        val-hash machinery doesn't match the trusted one (approximated by
-        header-hash inequality at common height when no header given)."""
-        if trusted_header is None:
-            return True
+    def conflicting_header_is_invalid(self, trusted_header: Header) -> bool:
+        """evidence.go ConflictingHeaderIsInvalid: lunatic iff the
+        conflicting header forges any of the hashes the application/state
+        machine determines (valhash, next-valhash, consensus, app,
+        last-results)."""
         ch = self.conflicting_block.header()
         return not (
             trusted_header.validators_hash == ch.validators_hash

@@ -1,0 +1,188 @@
+"""Light-client attack detection: a forging primary is examined against an
+honest witness, LightClientAttackEvidence is built and submitted to both
+sides, and the evidence verifies in the evidence pool.
+
+Reference parity: light/detector.go:21-120 (detectDivergence +
+handleConflictingHeaders), :228-374 (examineConflictingHeaderAgainstTrace),
+:406-423 (newLightClientAttackEvidence); internal/evidence/verify.go:159
+(pool-side verification).
+"""
+
+from dataclasses import replace
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.light import Client, LightStore, NodeBackedProvider, TrustOptions
+from tendermint_tpu.light.client import (
+    ErrFailedHeaderCrossReferencing,
+    ErrLightClientAttack,
+)
+from tendermint_tpu.light.provider import LightBlock, Provider
+from tendermint_tpu.types import SignedHeader, Vote
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.evidence import LightClientAttackEvidence
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE
+from tendermint_tpu.types.vote_set import VoteSet
+from tests.test_consensus import make_node
+
+CHAIN_ID = "cs-chain"
+
+
+@pytest.fixture(scope="module")
+def produced_chain():
+    sk = ed25519.gen_priv_key(bytes([9]) * 32)
+    cs, bstore, _ = make_node([sk], 0)
+    cs.start()
+    try:
+        cs.wait_for_height(5, timeout=60)
+    finally:
+        cs.stop()
+    return sk, cs, bstore
+
+
+def _forge_block(lb: LightBlock, sk, prev_forged: LightBlock = None) -> LightBlock:
+    """Re-sign a lunatic variant of a real light block: forged app_hash,
+    re-linked to the forged parent, committed by the real validator key."""
+    hdr = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
+    if prev_forged is not None:
+        ph = prev_forged.hash()
+        hdr = replace(
+            hdr,
+            last_block_id=BlockID(
+                hash=ph, part_set_header=PartSetHeader(total=1, hash=ph)
+            ),
+        )
+    bid = BlockID(
+        hash=hdr.hash(), part_set_header=PartSetHeader(total=1, hash=hdr.hash())
+    )
+    vset = lb.validators
+    vs = VoteSet(CHAIN_ID, hdr.height, 0, PRECOMMIT_TYPE, vset)
+    v = Vote(
+        type=PRECOMMIT_TYPE,
+        height=hdr.height,
+        round=0,
+        block_id=bid,
+        timestamp=hdr.time,
+        validator_address=vset.validators[0].address,
+        validator_index=0,
+    )
+    v = replace(v, signature=sk.sign(v.sign_bytes(CHAIN_ID)))
+    vs.add_vote(v)
+    return LightBlock(
+        signed_header=SignedHeader(header=hdr, commit=vs.make_commit()),
+        validators=vset,
+    )
+
+
+class ForgingPrimary(Provider):
+    """Serves the honest chain below the fork height and a self-consistent
+    forged (lunatic) chain at and above it."""
+
+    def __init__(self, honest: Provider, sk, fork_height: int, tip: int):
+        self._forged = {}
+        self._tip = tip
+        prev = None
+        for h in range(fork_height, tip + 1):
+            fb = _forge_block(honest.light_block(h), sk, prev)
+            self._forged[h] = fb
+            prev = fb
+        self._honest = honest
+        self._fork = fork_height
+        self.received_evidence = []
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self._tip
+        if height >= self._fork:
+            return self._forged[height]
+        return self._honest.light_block(height)
+
+    def report_evidence(self, ev) -> None:
+        self.received_evidence.append(ev)
+
+
+class RecordingWitness(NodeBackedProvider):
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.received_evidence = []
+
+    def report_evidence(self, ev) -> None:
+        self.received_evidence.append(ev)
+
+
+def test_forging_primary_detected_and_evidence_submitted(produced_chain):
+    sk, cs, bstore = produced_chain
+    honest = NodeBackedProvider(bstore, cs._block_exec.store)
+    evil = ForgingPrimary(honest, sk, fork_height=3, tip=5)
+    witness = RecordingWitness(bstore, cs._block_exec.store)
+    lb1 = honest.light_block(1)
+    c = Client(
+        chain_id=CHAIN_ID,
+        trust_options=TrustOptions(period=1e9, height=1, hash=lb1.hash()),
+        primary=evil,
+        witnesses=[witness],
+        store=LightStore(MemDB()),
+    )
+    with pytest.raises(ErrLightClientAttack):
+        c.verify_light_block_at_height(5)
+
+    # evidence against the primary went to the witness
+    assert len(witness.received_evidence) == 1
+    ev = witness.received_evidence[0]
+    assert isinstance(ev, LightClientAttackEvidence)
+    # lunatic attack (forged app_hash): common height is the last agreed one
+    assert ev.conflicting_header_is_invalid(
+        honest.light_block(5).signed_header.header
+    )
+    assert ev.common_height < 3
+    assert ev.conflicting_block.header().app_hash == b"\x66" * 32
+    # the equivocating validator is named byzantine
+    byz = ev.byzantine_validators
+    assert [v.address for v in byz] == [sk.pub_key().address()]
+    # counter-evidence against the witness went to the primary (best effort)
+    assert len(evil.received_evidence) == 1
+
+    # the evidence verifies in the evidence pool against real state
+    from tendermint_tpu.evidence import Pool
+
+    pool = Pool(
+        MemDB(), state_store=cs._block_exec.store, block_store=bstore
+    )
+    pool.set_state(cs.committed_state)
+    pool.add_evidence(ev)
+    pending = pool.pending_evidence(-1)
+    assert len(pending) == 1 and pending[0].hash() == ev.hash()
+
+
+def test_unsustained_witness_divergence_removes_witness(produced_chain):
+    """A witness that serves a forged header it cannot verify is dropped,
+    and with no matching witness left the verification fails cross-
+    referencing (detector.go:88-101)."""
+    sk, cs, bstore = produced_chain
+    honest = NodeBackedProvider(bstore, cs._block_exec.store)
+
+    class EvilWitness(NodeBackedProvider):
+        def light_block(self, height):
+            lb = super().light_block(height)
+            evil_header = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
+            return LightBlock(
+                signed_header=SignedHeader(
+                    header=evil_header, commit=lb.signed_header.commit
+                ),
+                validators=lb.validators,
+            )
+
+    evil = EvilWitness(bstore, cs._block_exec.store)
+    lb1 = honest.light_block(1)
+    c = Client(
+        chain_id=CHAIN_ID,
+        trust_options=TrustOptions(period=1e9, height=1, hash=lb1.hash()),
+        primary=honest,
+        witnesses=[evil],
+        store=LightStore(MemDB()),
+    )
+    with pytest.raises(ErrFailedHeaderCrossReferencing):
+        c.verify_light_block_at_height(3)
+    assert c._witnesses == []
