@@ -179,18 +179,226 @@ def cmd_testnet(args) -> int:
         ],
     )
     doc.validate_and_complete()
+    base = args.port_base
     peers = ",".join(
-        f"{nk.node_id}@127.0.0.1:{26656 + 10 * i}" for i, nk in enumerate(node_keys)
+        f"{nk.node_id}@127.0.0.1:{base + 10 * i}" for i, nk in enumerate(node_keys)
     )
     for i in range(n):
         home = os.path.join(out, f"node{i}")
         cfg = default_config(home)
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{26656 + 10 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{26657 + 10 * i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base + 10 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base + 1 + 10 * i}"
         cfg.p2p.persistent_peers = peers
         cfg.save()
         doc.save_as(cfg.base.genesis_path())
     print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """replay (replay.go / replay_file.go): re-drive the consensus WAL
+    through the state machine against the stores — console mode prints
+    each record."""
+    from .consensus.wal import WAL
+
+    cfg = _cfg(args.home)
+    home = args.home
+    wal = WAL(cfg.consensus.wal_path(home))
+    count = 0
+    last_height = None
+    for rec in wal.iter_messages():
+        count += 1
+        if rec.end_height is not None:
+            last_height = rec.end_height
+        if args.console:
+            if rec.end_height is not None:
+                print(f"#{count}: ENDHEIGHT {rec.end_height}")
+            elif rec.timeout is not None:
+                d, h, r, st = rec.timeout
+                print(f"#{count}: TIMEOUT h={h} r={r} step={st} after {d}ms")
+            else:
+                print(
+                    f"#{count}: {rec.msg_kind} ({len(rec.msg_payload)}B)"
+                    + (f" from {rec.peer_id}" if rec.peer_id else "")
+                )
+    print(f"replayed {count} WAL records; last committed height: {last_height}")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """debug dump (cmd/tendermint/commands/debug): capture a node's
+    status, consensus state, and net info from its RPC into a directory."""
+    import json as _json
+    import urllib.request
+
+    out = args.output_directory
+    os.makedirs(out, exist_ok=True)
+    base = args.rpc_laddr
+    for prefix in ("tcp://",):
+        if base.startswith(prefix):
+            base = "http://" + base[len(prefix):]
+    captured = []
+    for method in ("status", "net_info", "dump_consensus_state", "consensus_state"):
+        try:
+            with urllib.request.urlopen(f"{base}/{method}", timeout=5) as r:
+                data = _json.loads(r.read())
+            with open(os.path.join(out, f"{method}.json"), "w") as f:
+                _json.dump(data, f, indent=2)
+            captured.append(method)
+        except (OSError, ValueError) as e:  # incl. malformed JSON bodies
+            print(f"warning: {method} failed: {e}", file=sys.stderr)
+    # include the node config if reachable on disk
+    cfg_path = os.path.join(args.home, "config", "config.toml")
+    if os.path.exists(cfg_path):
+        import shutil
+
+        shutil.copy(cfg_path, os.path.join(out, "config.toml"))
+        captured.append("config.toml")
+    print(f"captured {captured} into {out}")
+    return 0 if captured else 1
+
+
+def cmd_key_migrate(args) -> int:
+    """key-migrate (cmd key-migrate): rewrite every store database into a
+    fresh file, dropping dead space and normalizing the on-disk layout."""
+    from .db import SQLiteDB
+
+    migrated = []
+    data_dir = os.path.join(args.home, "data")
+    if not os.path.isdir(data_dir):
+        print(f"no data directory at {data_dir}", file=sys.stderr)
+        return 1
+    for name in sorted(os.listdir(data_dir)):
+        if not name.endswith(".db"):
+            continue
+        src_path = os.path.join(data_dir, name)
+        tmp_path = src_path + ".migrate"
+        if os.path.exists(tmp_path):
+            os.remove(tmp_path)
+        src = SQLiteDB(src_path)
+        dst = SQLiteDB(tmp_path)
+        n = 0
+        batch = []
+        for k, v in src.iterator(None, None):
+            batch.append(("set", k, v))
+            n += 1
+            if len(batch) >= 1000:
+                dst.write_batch(batch)
+                batch = []
+        if batch:
+            dst.write_batch(batch)
+        src.close()
+        dst.close()
+        # drop stale sqlite sidecars BEFORE the swap: a crash after
+        # os.replace but before cleanup would otherwise leave the OLD
+        # database's -wal applied to the NEW file (malformed image)
+        for path in (src_path, tmp_path):
+            for suffix in ("-wal", "-shm"):
+                try:
+                    os.remove(path + suffix)
+                except FileNotFoundError:
+                    pass
+        os.replace(tmp_path, src_path)
+        migrated.append((name, n))
+    for name, n in migrated:
+        print(f"migrated {name}: {n} keys")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """reindex-event (commands/reindex_event.go): rebuild the tx/block
+    event indexes from the block store + stored ABCI responses."""
+    from .abci import types as abci_t
+    from .db import SQLiteDB
+    from .eventbus import _merge_abci_events
+    from .indexer import KVSink
+    from .state.store import StateStore
+    from .store import BlockStore
+
+
+    data = os.path.join(args.home, "data")
+    bstore = BlockStore(SQLiteDB(os.path.join(data, "blockstore.db")))
+    sstore = StateStore(SQLiteDB(os.path.join(data, "state.db")))
+    sink = KVSink(SQLiteDB(os.path.join(data, "tx_index.db")))
+    start = args.start_height or bstore.base() or 1
+    end = args.end_height or bstore.height()
+    indexed = 0
+    for h in range(start, end + 1):
+        block = bstore.load_block(h)
+        responses = sstore.load_abci_responses(h)
+        if block is None or responses is None:
+            continue
+        eb = abci_t.dec_response_payload("end_block", responses.end_block)
+        bb = abci_t.dec_response_payload("begin_block", responses.begin_block) \
+            if getattr(responses, "begin_block", None) else None
+        blk_events = {}
+        for res in (bb, eb):
+            if res is not None:
+                # append (not overwrite): begin/end block may emit the same
+                # composite key and the live index keeps both values
+                _merge_abci_events(blk_events, res.events)
+        sink.index_block(h, blk_events)
+        for i, raw in enumerate(responses.deliver_txs):
+            r = abci_t.dec_response_payload("deliver_tx", raw)
+            tx_events = {}
+            _merge_abci_events(tx_events, r.events)
+            sink.index_tx(h, i, block.data.txs[i], r, tx_events)
+            indexed += 1
+    print(f"reindexed blocks {start}..{end}: {indexed} txs")
+    return 0
+
+
+def cmd_light(args) -> int:
+    """light (commands/light.go): run a verifying light proxy against a
+    primary + witnesses, serving verified RPC reads."""
+    from .db import MemDB
+    from .light import Client, LightStore, TrustOptions
+    from .light.provider import HTTPProvider
+    from .light.rpc import LightProxy, VerifyingClient
+    from .rpc.client import HTTPClient
+
+    primary = HTTPProvider(args.primary)
+    witnesses = [HTTPProvider(w) for w in args.witnesses.split(",") if w]
+    if not witnesses:
+        # commands/light.go refuses to run without a real witness: with the
+        # primary as its own witness, divergence detection is vacuous
+        print(
+            "error: at least one witness (-w) distinct from the primary is "
+            "required for attack detection",
+            file=sys.stderr,
+        )
+        return 1
+    if args.trusted_height and args.trusted_hash:
+        opts = TrustOptions(
+            period=float(args.trusting_period),
+            height=int(args.trusted_height),
+            hash=bytes.fromhex(args.trusted_hash),
+        )
+    else:
+        lb = primary.light_block(0)
+        print(
+            f"no trust root given; trusting the primary's latest header "
+            f"{lb.height} {lb.hash().hex()}"
+        )
+        opts = TrustOptions(
+            period=float(args.trusting_period), height=lb.height, hash=lb.hash()
+        )
+    client = Client(
+        chain_id=args.chain_id,
+        trust_options=opts,
+        primary=primary,
+        witnesses=witnesses or [primary],
+        store=LightStore(MemDB()),
+    )
+    vc = VerifyingClient(HTTPClient(args.primary), client)
+    srv = LightProxy(vc, args.laddr)
+    srv.start()
+    print(f"light proxy for {args.chain_id} listening on {args.laddr}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
     return 0
 
 
@@ -263,6 +471,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--v", type=int, default=4)
     sp.add_argument("--o", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--port-base", type=int, default=26656)
+    sp = sub.add_parser("replay")
+    sp.add_argument("--console", action="store_true")
+    sp = sub.add_parser("debug")
+    sp.add_argument("--rpc-laddr", default="http://127.0.0.1:26657")
+    sp.add_argument("--output-directory", default="./debug-dump")
+    sub.add_parser("key-migrate")
+    sp = sub.add_parser("reindex-event")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp = sub.add_parser("light")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", "-p", required=True)
+    sp.add_argument("--witnesses", "-w", default="")
+    sp.add_argument("--trusted-height", type=int, default=0)
+    sp.add_argument("--trusted-hash", default="")
+    sp.add_argument("--trusting-period", default=str(14 * 24 * 3600))
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sub.add_parser("rollback")
     sub.add_parser("inspect")
     sub.add_parser("unsafe-reset-all")
@@ -278,6 +504,11 @@ COMMANDS = {
     "show-validator": cmd_show_validator,
     "start": cmd_start,
     "testnet": cmd_testnet,
+    "replay": cmd_replay,
+    "debug": cmd_debug,
+    "key-migrate": cmd_key_migrate,
+    "reindex-event": cmd_reindex_event,
+    "light": cmd_light,
     "rollback": cmd_rollback,
     "inspect": cmd_inspect,
     "unsafe-reset-all": cmd_reset_unsafe,

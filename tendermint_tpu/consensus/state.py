@@ -140,6 +140,7 @@ class ConsensusState(BaseService):
     # lifecycle
 
     def on_start(self) -> None:
+        self._reconstruct_last_commit()
         if self._wal is not None:
             self._wal.start()
             self._replay_wal()
@@ -859,6 +860,42 @@ class ConsensusState(BaseService):
 
     # ------------------------------------------------------------------
     # WAL replay (replay.go:96-160 catchupReplay)
+
+    def _reconstruct_last_commit(self) -> None:
+        """state.go:518-543 reconstructLastCommit: after a restart the
+        in-memory precommit VoteSet for the last committed height is gone;
+        rebuild it from the block store's seen commit so the proposer can
+        assemble the next block's LastCommit (without this a restarted
+        validator can never propose again)."""
+        state = self._state
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen = self._block_store.load_seen_commit()
+        if seen is None or seen.height != state.last_block_height:
+            return
+        vs = VoteSet(
+            state.chain_id, seen.height, seen.round, PRECOMMIT_TYPE, state.last_validators
+        )
+        for idx, cs in enumerate(seen.signatures):
+            if cs.is_absent():
+                continue
+            try:
+                vs.add_vote(
+                    Vote(
+                        type=PRECOMMIT_TYPE,
+                        height=seen.height,
+                        round=seen.round,
+                        block_id=cs.block_id(seen.block_id),
+                        timestamp=cs.timestamp,
+                        validator_address=cs.validator_address,
+                        validator_index=idx,
+                        signature=cs.signature,
+                    )
+                )
+            except ValueError:
+                continue  # e.g. nil-vote sigs; majority check below decides
+        if vs.has_two_thirds_majority():
+            self.rs.last_commit = vs
 
     def _replay_wal(self) -> None:
         if self._wal is None:

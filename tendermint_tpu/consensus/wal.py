@@ -21,6 +21,7 @@ import zlib
 from dataclasses import dataclass
 from typing import BinaryIO, Iterator, Optional, Tuple
 
+from ..libs import autofile
 from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
 
 MAX_MSG_SIZE = 1 << 20  # 1MB (wal.go:25)
@@ -79,28 +80,62 @@ def _decode_record(data: bytes) -> WALMessage:
 
 
 class WAL:
-    """wal.go:58-220 BaseWAL (single-file variant of the autofile group;
-    size rotation is delegated to height-based truncation on restart)."""
+    """wal.go:58-220 BaseWAL on an autofile Group (size-rotated chunks,
+    internal/libs/autofile/group.go parity via libs.autofile)."""
 
-    def __init__(self, path: str):
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: int = autofile.DEFAULT_HEAD_SIZE_LIMIT,
+        total_size_limit: int = autofile.DEFAULT_TOTAL_SIZE_LIMIT,
+    ):
         self._path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._fh: Optional[BinaryIO] = None
+        self._group = autofile.Group(
+            path, head_size_limit=head_size_limit, total_size_limit=total_size_limit
+        )
         self._mtx = threading.Lock()
+        self._started = False
 
     def start(self) -> None:
-        exists = os.path.exists(self._path) and os.path.getsize(self._path) > 0
-        self._fh = open(self._path, "ab")
+        self._repair_torn_tail()
+        exists = any(
+            os.path.getsize(p) > 0 for p in self._group.files_oldest_first()
+        )
+        self._group.open()
+        self._started = True
         if not exists:
             self.write(WALMessage(end_height=0))  # wal.go OnStart:118-124
 
+    def _repair_torn_tail(self) -> None:
+        """Truncate a crash-torn partial frame at the end of the head file
+        BEFORE appending: without this, post-restart records land after
+        the garbage and become invisible to replay (frame decoding stops
+        at the first bad CRC), silently breaking the write_sync recovery
+        invariant."""
+        if not os.path.exists(self._path):
+            return
+        good_end = 0
+        with open(self._path, "rb") as fh:
+            while True:
+                head = fh.read(8)
+                if len(head) < 8:
+                    break
+                crc, length = struct.unpack(">II", head)
+                if length > MAX_MSG_SIZE:
+                    break
+                body = fh.read(length)
+                if len(body) < length or (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                    break
+                good_end = fh.tell()
+        if good_end < os.path.getsize(self._path):
+            with open(self._path, "r+b") as fh:
+                fh.truncate(good_end)
+
     def stop(self) -> None:
         with self._mtx:
-            if self._fh is not None:
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
-                self._fh.close()
-                self._fh = None
+            if self._started:
+                self._group.close()
+                self._started = False
 
     # -- writes ---------------------------------------------------------
 
@@ -111,8 +146,9 @@ class WAL:
         crc = zlib.crc32(body) & 0xFFFFFFFF
         frame = struct.pack(">II", crc, len(body)) + body
         with self._mtx:
-            if self._fh is not None:
-                self._fh.write(frame)
+            if self._started:
+                self._group.write(frame)
+                self._group.maybe_rotate()
 
     def write_sync(self, msg: WALMessage) -> None:
         """wal.go:196-210: fsync before the process acts on its own
@@ -125,17 +161,14 @@ class WAL:
 
     def flush_and_sync(self) -> None:
         with self._mtx:
-            if self._fh is not None:
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
+            if self._started:
+                self._group.flush_and_sync()
 
     # -- reads ----------------------------------------------------------
 
-    def iter_messages(self) -> Iterator[WALMessage]:
-        """Decode from the start; stop at corruption (crash-torn tail)."""
-        if not os.path.exists(self._path):
-            return
-        with open(self._path, "rb") as fh:
+    @staticmethod
+    def _iter_file(path: str) -> Iterator[WALMessage]:
+        with open(path, "rb") as fh:
             while True:
                 head = fh.read(8)
                 if len(head) < 8:
@@ -153,14 +186,27 @@ class WAL:
                 except (ValueError, KeyError):
                     return
 
+    def iter_messages(self) -> Iterator[WALMessage]:
+        """Decode across the group, oldest chunk -> head; stop at
+        corruption (only the head can carry a crash-torn tail)."""
+        for path in self._group.files_oldest_first():
+            yield from self._iter_file(path)
+
     def search_for_end_height(self, height: int) -> Optional[list]:
-        """wal.go:226-280: find EndHeightMessage(height) and return the
-        messages after it (what must be replayed for height+1)."""
-        found = False
-        tail: list = []
-        for msg in self.iter_messages():
-            if found:
-                tail.append(msg)
-            elif msg.end_height == height:
-                found = True
-        return tail if found else None
+        """wal.go:226-280 SearchForEndHeight, newest-chunk-first: walk the
+        chunks backwards, decoding each file AT MOST ONCE (newer chunks'
+        decoded records are kept — they are part of the replay tail), so
+        startup replay cost is bounded by the tail, not O(chunks^2) over
+        the whole rotated group."""
+        files = self._group.files_oldest_first()
+        newer_msgs: list = []  # records of files newer than the current one
+        for start in range(len(files) - 1, -1, -1):
+            msgs = list(self._iter_file(files[start]))
+            last = -1
+            for i, msg in enumerate(msgs):
+                if msg.end_height == height:
+                    last = i  # replay from the LAST marker for the height
+            if last >= 0:
+                return msgs[last + 1 :] + newer_msgs
+            newer_msgs = msgs + newer_msgs
+        return None

@@ -1,0 +1,123 @@
+"""Size-rotated file groups backing the WAL.
+
+Reference parity: internal/libs/autofile/group.go — a Group is a head
+file plus numbered rotated chunks (`wal`, `wal.000`, `wal.001`, ...).
+When the head exceeds head_size_limit it is renamed to the next index and
+a fresh head opened; when the group's total size exceeds total_size_limit
+the oldest chunks are deleted. Readers iterate oldest chunk -> head.
+
+Differences from the reference (deliberate): rotation is checked on write
+rather than by a 10s ticker (no background goroutine needed — the check
+is one integer compare), and minIndex/maxIndex are derived from the
+directory listing at open.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import BinaryIO, List, Optional
+
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # group.go:26 (10MB)
+DEFAULT_TOTAL_SIZE_LIMIT = 1024 * 1024 * 1024  # group.go:27 (1GB)
+
+
+class Group:
+    """autofile.Group (write side + chunk enumeration)."""
+
+    def __init__(
+        self,
+        head_path: str,
+        head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+        total_size_limit: int = DEFAULT_TOTAL_SIZE_LIMIT,
+    ):
+        self._head_path = head_path
+        self._head_size_limit = head_size_limit
+        self._total_size_limit = total_size_limit
+        self._mtx = threading.Lock()
+        self._fh: Optional[BinaryIO] = None
+        self._head_size = 0
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def open(self) -> None:
+        with self._mtx:
+            self._fh = open(self._head_path, "ab")
+            self._head_size = self._fh.tell()
+
+    def close(self) -> None:
+        with self._mtx:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+
+    # -- chunk bookkeeping ----------------------------------------------
+
+    def _indices(self) -> List[int]:
+        d = os.path.dirname(self._head_path) or "."
+        base = os.path.basename(self._head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        out = []
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _chunk_path(self, idx: int) -> str:
+        return f"{self._head_path}.{idx:03d}"
+
+    def files_oldest_first(self) -> List[str]:
+        """All group files in log order (rotated chunks, then head)."""
+        paths = [self._chunk_path(i) for i in self._indices()]
+        if os.path.exists(self._head_path):
+            paths.append(self._head_path)
+        return paths
+
+    # -- writes ----------------------------------------------------------
+
+    def write(self, data: bytes) -> None:
+        with self._mtx:
+            if self._fh is None:
+                raise ValueError("group is closed")
+            self._fh.write(data)
+            self._head_size += len(data)
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def maybe_rotate(self) -> bool:
+        """group.go checkHeadSizeLimit/rotateFile: rename a full head to
+        the next index and open a fresh one; then enforce the total-size
+        cap by deleting the oldest chunks."""
+        with self._mtx:
+            if self._fh is None or self._head_size < self._head_size_limit:
+                return False
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            indices = self._indices()
+            nxt = (indices[-1] + 1) if indices else 0
+            os.rename(self._head_path, self._chunk_path(nxt))
+            self._fh = open(self._head_path, "ab")
+            self._head_size = 0
+            self._enforce_total_locked()
+            return True
+
+    def _enforce_total_locked(self) -> None:
+        total = self._head_size
+        chunks = [(i, self._chunk_path(i)) for i in self._indices()]
+        sizes = {i: os.path.getsize(p) for i, p in chunks}
+        total += sum(sizes.values())
+        for i, p in chunks:  # oldest first
+            if total <= self._total_size_limit:
+                break
+            os.remove(p)
+            total -= sizes[i]

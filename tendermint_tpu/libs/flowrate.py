@@ -1,0 +1,91 @@
+"""Flow-rate monitoring and limiting.
+
+Reference parity: internal/libs/flowrate/ (Monitor with EWMA rate tracking
+and Limit(want, rate, block)); used by MConnection for per-connection
+send/recv rate caps (internal/p2p/conn/connection.go:103-104) and exposed
+in net_info peer status.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Monitor:
+    """flowrate.Monitor: tracks transfer rate with an exponentially
+    weighted moving average over `window` seconds."""
+
+    def __init__(self, sample_period: float = 0.1, window: float = 1.0):
+        self._mtx = threading.Lock()
+        self._sample = max(sample_period, 0.01)
+        self._window = max(window, self._sample)
+        self._start = time.monotonic()
+        self._last = self._start
+        self._acc = 0  # bytes since last sample
+        self._rate = 0.0  # EWMA bytes/s
+        self._total = 0
+
+    def update(self, n: int) -> None:
+        with self._mtx:
+            self._total += n
+            self._acc += n
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
+        now = time.monotonic()
+        dt = now - self._last
+        if dt >= self._sample:
+            alpha = 1.0 - pow(2.7182818, -dt / self._window)
+            self._rate += alpha * (self._acc / dt - self._rate)
+            self._acc = 0
+            self._last = now
+
+    def rate(self) -> float:
+        with self._mtx:
+            self._tick_locked()
+            return self._rate
+
+    def total(self) -> int:
+        with self._mtx:
+            return self._total
+
+    def status(self) -> dict:
+        with self._mtx:
+            self._tick_locked()
+            now = time.monotonic()
+            return {
+                "duration": now - self._start,
+                "bytes": self._total,
+                "cur_rate": self._rate,
+                "avg_rate": self._total / max(now - self._start, 1e-9),
+            }
+
+
+class Limiter:
+    """Token-bucket byte-rate limiter: `wait(n)` blocks just long enough to
+    keep throughput at or below `rate` bytes/s (burst of one bucket).
+    flowrate.Monitor.Limit analog shaped for blocking writers."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        if rate <= 0:
+            raise ValueError("rate must be positive")
+        self._rate = float(rate)
+        self._burst = float(burst if burst is not None else rate / 10)
+        self._tokens = self._burst
+        self._last = time.monotonic()
+        self._mtx = threading.Lock()
+
+    def wait(self, n: int) -> None:
+        delay = 0.0
+        with self._mtx:
+            now = time.monotonic()
+            self._tokens = min(
+                self._burst, self._tokens + (now - self._last) * self._rate
+            )
+            self._last = now
+            self._tokens -= n
+            if self._tokens < 0:
+                delay = -self._tokens / self._rate
+        if delay > 0:
+            time.sleep(delay)

@@ -46,6 +46,76 @@ class Provider(abc.ABC):
         pass
 
 
+class HTTPProvider(Provider):
+    """light/provider/http: fetches signed headers + validator sets from a
+    node's JSON-RPC endpoint (/commit, /validators with pagination)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self._url = base_url.rstrip("/")
+        for prefix in ("tcp://",):
+            if self._url.startswith(prefix):
+                self._url = "http://" + self._url[len(prefix):]
+        if not self._url.startswith("http"):
+            self._url = "http://" + self._url
+        self._timeout = timeout
+
+    def _get(self, path: str) -> dict:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{self._url}/{path}", timeout=self._timeout
+        ) as r:
+            res = _json.loads(r.read())
+        if "error" in res and res["error"]:
+            raise ErrLightBlockNotFound(res["error"])
+        return res["result"]
+
+    def light_block(self, height: int) -> LightBlock:
+        from ..wire.json_types import parse_signed_header, parse_validator_set
+
+        try:
+            q = f"?height={height}" if height else ""
+            com = self._get(f"commit{q}")
+            sh = parse_signed_header(com["signed_header"])
+            h = sh.header.height
+            vals = []
+            page = 1
+            while True:
+                res = self._get(f"validators?height={h}&page={page}&per_page=100")
+                got = res["validators"]
+                if not got:
+                    # a byzantine primary could promise total=N forever;
+                    # an empty page means it cannot deliver — stop
+                    raise ErrLightBlockNotFound(f"empty validator page {page}")
+                vals.extend(got)
+                if len(vals) >= int(res["total"]) or page >= 100:
+                    break
+                page += 1
+            vset = parse_validator_set({"validators": vals})
+        except (OSError, ValueError, KeyError) as e:
+            raise ErrLightBlockNotFound(str(e)) from e
+        return LightBlock(signed_header=sh, validators=vset)
+
+    def report_evidence(self, ev) -> None:
+        import base64 as _b64
+        import urllib.parse
+        import urllib.request
+
+        from ..types.evidence import encode_evidence
+
+        # percent-encode: raw base64 '+' would decode as a space in the
+        # server's query parser and silently corrupt the evidence
+        data = urllib.parse.quote(_b64.b64encode(encode_evidence(ev)).decode())
+        try:
+            urllib.request.urlopen(
+                f"{self._url}/broadcast_evidence?evidence=%22{data}%22",
+                timeout=self._timeout,
+            ).read()
+        except OSError:
+            pass  # best effort (detector.go sendEvidence)
+
+
 class NodeBackedProvider(Provider):
     """Reads block store + state store of a (local) node."""
 

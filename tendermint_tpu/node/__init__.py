@@ -193,7 +193,18 @@ def make_node(
 
     wal = None
     if home:
-        wal = WAL(config.consensus.wal_path(home))
+        import os as _os
+
+        from ..libs import autofile as _autofile
+
+        wal = WAL(
+            config.consensus.wal_path(home),
+            head_size_limit=int(
+                _os.environ.get(
+                    "TM_TPU_WAL_HEAD_LIMIT", _autofile.DEFAULT_HEAD_SIZE_LIMIT
+                )
+            ),
+        )
 
     consensus = ConsensusState(
         config.consensus,

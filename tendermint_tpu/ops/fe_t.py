@@ -1,0 +1,168 @@
+"""Limb-major field arithmetic over GF(2^255 - 19) for the Pallas kernel.
+
+Same mathematics as ops.fe (13-bit limbs in int32, see the invariants
+there), but transposed: a field element is (20, B) with the batch on the
+LAST axis so the TPU's (sublane, lane) = (8, 128) vector registers tile the
+batch across lanes. Written for Mosaic (Pallas-TPU):
+
+- no gathers: the 39-coefficient limb convolution is one (20, 20, B)
+  outer product plus 20 statically shifted row-pads — ~60 primitive ops
+  per field-mul, which keeps the traced ladder body small enough for the
+  Mosaic compiler while saturating the VPU;
+- all shapes static; batch B is a compile-time block size.
+
+Reference parity: the arithmetic mirrors crypto/_edwards (the ZIP-215
+oracle); differential tests drive both from the same vectors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1
+P = 2**255 - 19
+_TOP_WRAP = 608  # 2^260 mod p = 2^5 * 19
+
+
+def const_col(v: int):
+    """Python int (< 2^260, canonical caller-side) -> (20, 1) jnp column
+    built from SCALAR constants at trace time. Pallas kernels cannot
+    capture array constants, so every in-kernel field constant goes
+    through here (scalars inline into the jaxpr; arrays do not)."""
+    rows = [
+        jnp.full((1,), (v >> (RADIX * i)) & MASK, dtype=jnp.int32)
+        for i in range(NLIMBS)
+    ]
+    return jnp.stack(rows, axis=0)
+
+
+def limbs_from_int_t(v: int):
+    """Python int -> canonical (20, 1) trace-time column."""
+    return const_col(v % P)
+
+
+def carry_pass(x):
+    """One parallel carry pass over the leading limb axis."""
+    c = x >> RADIX
+    r = x & MASK
+    wrap = jnp.concatenate([c[NLIMBS - 1 :] * _TOP_WRAP, c[: NLIMBS - 1]], axis=0)
+    return r + wrap
+
+
+def carry(x):
+    return carry_pass(carry_pass(carry_pass(x)))
+
+
+def add(a, b):
+    """ONE carry pass suffices after add/sub of reduced operands: inputs
+    have limbs in (-1216, 2^13 + 1216], sums in (-2432, 2^14 + 2432], and
+    a single pass contracts back into (-1216, 2^13 + 1216] — closed under
+    further add/sub and mul-safe (20 * (2^13 + 1216)^2 < 2^31). Cuts ~2/3
+    of the VPU ops the 3-pass carry spent on every point-op add chain."""
+    return carry_pass(a + b)
+
+
+def sub(a, b):
+    return carry_pass(a - b)
+
+
+def neg(a):
+    return carry_pass(-a)
+
+
+def mul(a, b):
+    """Field multiply, trace-compact: one (20, 20, B) outer product, then
+    each row i lands at offset i via a static pad, summed into the 39
+    convolution coefficients."""
+    outer = a[:, None, :] * b[None, :, :]  # (20, 20, B)
+    c39 = None
+    for i in range(NLIMBS):
+        row = jnp.pad(outer[i], ((i, NLIMBS - 1 - i), (0, 0)))  # (39, B)
+        c39 = row if c39 is None else c39 + row
+    lo = c39[:NLIMBS]
+    hi = c39[NLIMBS:]  # coefficients 20..38
+    hi_lo = hi & MASK
+    hi_hi = hi >> RADIX
+    r = (
+        lo
+        + _TOP_WRAP * jnp.pad(hi_lo, ((0, 1), (0, 0)))
+        + _TOP_WRAP * jnp.pad(hi_hi, ((1, 0), (0, 0)))
+    )
+    return carry(r)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def sqn(a, n: int):
+    if n <= 4:
+        for _ in range(n):
+            a = sq(a)
+        return a
+    return lax.fori_loop(0, n, lambda _, v: sq(v), a, unroll=False)
+
+
+def pow22523(z):
+    """z^(2^252 - 3) — ref10 addition chain (see ops.fe.pow22523)."""
+    x2 = sq(z)
+    x9 = mul(z, sqn(x2, 2))
+    x11 = mul(x2, x9)
+    x31 = mul(x9, sq(x11))
+    xa = mul(sqn(x31, 5), x31)
+    xb = mul(sqn(xa, 10), xa)
+    xc = mul(sqn(xb, 20), xb)
+    xd = mul(sqn(xc, 10), xa)
+    xe = mul(sqn(xd, 50), xd)
+    xf = mul(sqn(xe, 100), xe)
+    xg = mul(sqn(xf, 50), xd)
+    return mul(sqn(xg, 2), z)
+
+
+def _fold255(x):
+    """Fold bits >= 2^255 (2^255 ≡ 19); input limbs near-canonical."""
+    q = x[NLIMBS - 1] >> 8
+    top = x[NLIMBS - 1] & 0xFF
+    body = jnp.concatenate([(x[0] + 19 * q)[None], x[1 : NLIMBS - 1], top[None]], axis=0)
+    # sequential small-carry chain (bounded: one pass suffices after carry)
+    return carry(body)
+
+
+def _cond_sub(x, const_col):
+    """x - const if x >= const (canonical-ish nonneg limbs)."""
+    d = x - const_col
+    # sequential borrow propagation across 20 limbs (static unroll)
+    rows = []
+    c = jnp.zeros_like(x[0])
+    for i in range(NLIMBS):
+        t = d[i] + c
+        c = t >> RADIX
+        rows.append(t & MASK)
+    t = jnp.stack(rows, axis=0)
+    keep = (c < 0)[None, :]
+    return jnp.where(keep, x, t)
+
+
+def canon(x):
+    p_col = const_col(P)
+    x = carry(x)
+    x = carry(x + const_col(8 * P))
+    x = _fold255(x)
+    x = _fold255(x)
+    x = _cond_sub(x, p_col)
+    x = _cond_sub(x, p_col)
+    return x
+
+
+def is_zero(x):
+    """(1, B) bool: value ≡ 0 (mod p). Kept 2D — 1D vectors force Mosaic
+    into unsupported gather lowerings at concat/slice sites."""
+    return jnp.all(canon(x) == 0, axis=0, keepdims=True)
+
+
+def eq(a, b):
+    return is_zero(a - b)

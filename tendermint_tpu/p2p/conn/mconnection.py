@@ -97,7 +97,11 @@ class MConnection:
         channel_descs: List[ChannelDescriptor],
         on_receive: Callable[[int, bytes], None],
         on_error: Callable[[Exception], None],
+        send_rate: Optional[float] = None,  # bytes/s; None = unlimited
+        recv_rate: Optional[float] = None,
     ):
+        from ...libs import flowrate
+
         self._conn = conn
         self._channels: Dict[int, _Channel] = {
             d.id: _Channel(d) for d in channel_descs
@@ -108,6 +112,11 @@ class MConnection:
         self._quit = threading.Event()
         self._last_pong = time.time()
         self._threads: List[threading.Thread] = []
+        # connection.go:103-104: flowrate monitors + optional rate caps
+        self.send_monitor = flowrate.Monitor()
+        self.recv_monitor = flowrate.Monitor()
+        self._send_limiter = flowrate.Limiter(send_rate) if send_rate else None
+        self._recv_limiter = flowrate.Limiter(recv_rate) if recv_rate else None
 
     def start(self) -> None:
         for fn in (self._send_routine, self._recv_routine):
@@ -167,7 +176,11 @@ class MConnection:
                     pending.sort(key=lambda c: -c.desc.priority)
                     chunk = pending[0].next_packet_chunk()
                     if chunk is not None:
-                        self._conn.write(encode_packet_msg(*chunk))
+                        pkt = encode_packet_msg(*chunk)
+                        if self._send_limiter is not None:
+                            self._send_limiter.wait(len(pkt))
+                        self._conn.write(pkt)
+                        self.send_monitor.update(len(pkt))
                         wrote = True
         except (OSError, ConnectionError, ValueError) as e:
             self._error(e)
@@ -181,6 +194,9 @@ class MConnection:
                 chunk = self._conn.read(65536)
                 if not chunk:
                     raise ConnectionError("connection closed by peer")
+                if self._recv_limiter is not None:
+                    self._recv_limiter.wait(len(chunk))
+                self.recv_monitor.update(len(chunk))
                 buf += chunk
                 while True:
                     try:

@@ -19,6 +19,12 @@ from ..db import DB, MemDB
 MAX_PEER_SCORE = 100
 PERSISTENT_PEER_SCORE = MAX_PEER_SCORE
 
+# Score at or below which a connected peer is scheduled for eviction and a
+# candidate stops being dialed (peermanager.go's negative-score behavior).
+EVICT_SCORE = -10
+# Cap on stored (unconnected) addresses before the book GCs the worst ones.
+DEFAULT_MAX_PEERS = 1000
+
 
 @dataclass
 class PeerAddress:
@@ -34,6 +40,7 @@ class _PeerInfo:
     last_dial_failure: float = 0.0
     dial_failures: int = 0
     mutable_score: int = 0
+    banned_until: float = 0.0
 
     def score(self) -> int:
         if self.persistent:
@@ -51,17 +58,22 @@ class PeerManager:
         max_connected: int = 16,
         min_retry_time: float = 0.25,
         max_retry_time: float = 30.0,
+        max_peers: int = DEFAULT_MAX_PEERS,
+        ban_duration: float = 60.0,
     ):
         self._self_id = self_id
         self._db = db or MemDB()
         self._max_connected = max_connected
         self._min_retry = min_retry_time
         self._max_retry = max_retry_time
+        self._max_peers = max_peers
+        self._ban_duration = ban_duration
         self._mtx = threading.RLock()
         self._peers: Dict[str, _PeerInfo] = {}
         self._connected: Set[str] = set()
         self._dialing: Set[str] = set()
         self._evicting: Set[str] = set()
+        self._evict_queue: List[str] = []
         self._load()
 
     # -- address book ----------------------------------------------------
@@ -114,6 +126,8 @@ class PeerManager:
                     continue
                 if not info.addresses:
                     continue
+                if now < info.banned_until:
+                    continue
                 if info.dial_failures > 0:
                     backoff = min(
                         self._min_retry * (2 ** (info.dial_failures - 1)), self._max_retry
@@ -138,40 +152,143 @@ class PeerManager:
                 info.dial_failures += 1
                 info.last_dial_failure = time.time()
 
+    def _admit_locked(self, node_id: str) -> bool:
+        """Shared admission: dedup/self/ban checks, then capacity with the
+        upgrade rule (peermanager.go upgrade machinery): a candidate that
+        outscores the worst connected non-persistent peer displaces it —
+        the loser is queued for eviction and the candidate admitted.
+        The address-book entry is only created AFTER admission — rejected
+        connection attempts (capacity, bans) must not grow the book."""
+        if node_id in self._connected or node_id == self._self_id:
+            return False
+        info = self._peers.get(node_id) or _PeerInfo(node_id=node_id)
+        if time.time() < info.banned_until:
+            return False
+        if len(self._connected) >= self._max_connected:
+            evictable = [
+                self._peers[n]
+                for n in self._connected
+                if n not in self._evicting
+                and n not in self._evict_queue
+                and not self._peers[n].persistent
+            ]
+            if not evictable:
+                return False
+            worst = min(evictable, key=lambda i: i.score())
+            if worst.score() >= info.score():
+                return False
+            self._schedule_evict_locked(worst.node_id)
+        self._peers.setdefault(node_id, info)
+        self._connected.add(node_id)
+        return True
+
     def dialed(self, node_id: str) -> bool:
         """Outbound connect succeeded; False -> reject (e.g. full/dup)."""
         with self._mtx:
             self._dialing.discard(node_id)
-            if node_id in self._connected or node_id == self._self_id:
+            if not self._admit_locked(node_id):
                 return False
-            if len(self._connected) >= self._max_connected:
-                return False
-            info = self._peers.setdefault(node_id, _PeerInfo(node_id=node_id))
-            info.dial_failures = 0
-            self._connected.add(node_id)
+            self._peers[node_id].dial_failures = 0
             return True
 
     def accepted(self, node_id: str) -> bool:
         """Inbound connect; same admission rules (peermanager.go Accepted)."""
         with self._mtx:
-            if node_id in self._connected or node_id == self._self_id:
-                return False
-            if len(self._connected) >= self._max_connected:
-                return False
-            self._peers.setdefault(node_id, _PeerInfo(node_id=node_id))
-            self._connected.add(node_id)
-            return True
+            return self._admit_locked(node_id)
 
     def disconnected(self, node_id: str) -> None:
         with self._mtx:
             self._connected.discard(node_id)
             self._evicting.discard(node_id)
+            if node_id in self._evict_queue:
+                self._evict_queue.remove(node_id)
 
-    def errored(self, node_id: str, err: Exception) -> None:
+    def errored(self, node_id: str, err: Exception, weight: int = 1) -> None:
+        """peermanager.go Errored: demote the peer's score; once it sinks
+        to EVICT_SCORE the peer is queued for eviction and (non-persistent
+        peers) banned from redial for ban_duration."""
         with self._mtx:
             info = self._peers.get(node_id)
-            if info:
-                info.mutable_score -= 1
+            if info is None:
+                return
+            info.mutable_score -= weight
+            if info.score() <= EVICT_SCORE and not info.persistent:
+                info.banned_until = time.time() + self._ban_duration
+                self._schedule_evict_locked(node_id)
+
+    # -- eviction (peermanager.go EvictNext/evict state) ------------------
+
+    def _schedule_evict_locked(self, node_id: str) -> None:
+        if (
+            node_id in self._connected
+            and node_id not in self._evicting
+            and node_id not in self._evict_queue
+        ):
+            self._evict_queue.append(node_id)
+
+    def schedule_evict(self, node_id: str) -> None:
+        with self._mtx:
+            self._schedule_evict_locked(node_id)
+
+    def evict_next(self) -> Optional[str]:
+        """peermanager.go EvictNext: pop a peer the router must drop.
+        Non-blocking; the router pumps this in its eviction loop."""
+        with self._mtx:
+            # over capacity -> evict the lowest-scoring non-persistent peer
+            if len(self._connected) > self._max_connected:
+                excess = [
+                    self._peers[n]
+                    for n in self._connected
+                    if n not in self._evicting and not self._peers[n].persistent
+                ]
+                if excess:
+                    worst = min(excess, key=lambda i: i.score())
+                    self._schedule_evict_locked(worst.node_id)
+            while self._evict_queue:
+                nid = self._evict_queue.pop(0)
+                if nid in self._connected and nid not in self._evicting:
+                    self._evicting.add(nid)
+                    return nid
+            return None
+
+    def evict_failed(self, node_id: str) -> None:
+        """The router had no live connection for a popped eviction (admit
+        race: accepted() marks connected before the router registers the
+        conn). Clear the in-flight mark and re-queue so the eviction is
+        retried once the connection lands — otherwise the peer would stay
+        in _evicting forever and become immune to eviction."""
+        with self._mtx:
+            self._evicting.discard(node_id)
+            self._schedule_evict_locked(node_id)
+
+    def is_banned(self, node_id: str) -> bool:
+        with self._mtx:
+            info = self._peers.get(node_id)
+            return bool(info and time.time() < info.banned_until)
+
+    # -- address book GC --------------------------------------------------
+
+    def prune_addresses(self) -> int:
+        """peermanager.go prunePeers: when the book exceeds max_peers,
+        drop the lowest-scored unconnected, non-persistent entries."""
+        with self._mtx:
+            overflow = len(self._peers) - self._max_peers
+            if overflow <= 0:
+                return 0
+            candidates = [
+                i
+                for i in self._peers.values()
+                if i.node_id not in self._connected
+                and i.node_id not in self._dialing
+                and not i.persistent
+            ]
+            candidates.sort(key=lambda i: i.score())
+            dropped = 0
+            for info in candidates[:overflow]:
+                del self._peers[info.node_id]
+                self._db.delete(b"peer:" + info.node_id.encode())
+                dropped += 1
+            return dropped
 
     # -- persistence -----------------------------------------------------
 

@@ -9,6 +9,7 @@ per-peer connections.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -26,6 +27,64 @@ class PeerUpdate:
 
     node_id: str
     status: str  # "up" | "down"
+
+
+class _PeerQueue:
+    """internal/p2p/pqueue.go: per-peer outbound queue with one bounded
+    deque per channel, drained highest-priority-first by the peer's send
+    thread. A slow peer fills its own deques and drops its own traffic —
+    it can never head-of-line-block another peer or starve a
+    higher-priority channel (vote gossip) behind bulk data (blocksync)."""
+
+    def __init__(self, descs: Dict[int, ChannelDescriptor]):
+        self._mtx = threading.Lock()
+        self._ready = threading.Event()
+        # highest priority first; stable order for equal priorities
+        self._order = sorted(descs.values(), key=lambda d: -d.priority)
+        self._qs: Dict[int, collections.deque] = {
+            d.id: collections.deque(maxlen=d.send_queue_capacity) for d in descs.values()
+        }
+        self.dropped = 0
+        self.closed = False
+
+    def ensure_channel(self, desc: ChannelDescriptor) -> None:
+        with self._mtx:
+            if desc.id not in self._qs:
+                self._qs[desc.id] = collections.deque(maxlen=desc.send_queue_capacity)
+                self._order = sorted(
+                    self._order + [desc], key=lambda d: -d.priority
+                )
+
+    def put(self, channel_id: int, msg: bytes) -> bool:
+        with self._mtx:
+            q = self._qs.get(channel_id)
+            if q is None or self.closed:
+                return False
+            if len(q) == q.maxlen:
+                self.dropped += 1  # pqueue.go drops on overflow
+                return False
+            q.append(msg)
+        self._ready.set()
+        return True
+
+    def pop(self, timeout: float) -> Optional[tuple]:
+        """Next (channel_id, msg) by priority, or None on timeout/close."""
+        while True:
+            with self._mtx:
+                if self.closed:
+                    return None
+                for d in self._order:
+                    q = self._qs[d.id]
+                    if q:
+                        return (d.id, q.popleft())
+                self._ready.clear()
+            if not self._ready.wait(timeout):
+                return None
+
+    def close(self) -> None:
+        with self._mtx:
+            self.closed = True
+        self._ready.set()
 
 
 class Channel:
@@ -65,6 +124,7 @@ class Router:
         self.node_id = node_id
         self._channels: Dict[int, Channel] = {}
         self._conns: Dict[str, Connection] = {}
+        self._queues: Dict[str, _PeerQueue] = {}
         self._mtx = threading.RLock()
         self._stopped = threading.Event()
         self._peer_update_subs: List["queue.Queue[PeerUpdate]"] = []
@@ -78,6 +138,8 @@ class Router:
                 raise ValueError(f"channel {desc.id} already open")
             ch = Channel(self, desc)
             self._channels[desc.id] = ch
+            for pq in self._queues.values():
+                pq.ensure_channel(desc)
             return ch
 
     def subscribe_peer_updates(self) -> "queue.Queue[PeerUpdate]":
@@ -101,7 +163,7 @@ class Router:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
-        for fn in (self._accept_loop, self._dial_loop):
+        for fn in (self._accept_loop, self._dial_loop, self._evict_loop):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             self._threads.append(t)
@@ -152,17 +214,41 @@ class Router:
             conn.close()
             return
         with self._mtx:
+            pq = _PeerQueue({c.desc.id: c.desc for c in self._channels.values()})
             self._conns[nid] = conn
-        t = threading.Thread(target=self._receive_peer, args=(conn,), daemon=True)
-        t.start()
-        self._threads.append(t)
+            self._queues[nid] = pq
+        for fn in (self._receive_peer, self._send_peer):
+            # per-connection daemon threads exit with the connection and are
+            # deliberately NOT retained: under peer churn a kept list would
+            # grow without bound (only the loop threads in start() persist)
+            threading.Thread(target=fn, args=(conn,), daemon=True).start()
         self._notify_peer_update(PeerUpdate(nid, "up"))
+
+    def _evict_loop(self) -> None:
+        """router.go evictPeers: pump the peer manager's eviction queue;
+        also the periodic address-book GC home."""
+        last_gc = time.time()
+        while not self._stopped.is_set():
+            if time.time() - last_gc > 30:
+                self._pm.prune_addresses()
+                last_gc = time.time()
+            nid = self._pm.evict_next()
+            if nid is None:
+                time.sleep(0.1)
+                continue
+            if not self.disconnect_peer(nid):
+                # connection not registered yet (admit in flight): retry
+                self._pm.evict_failed(nid)
+                time.sleep(0.05)
 
     def _drop_peer(self, conn: Connection, err: Optional[Exception]) -> None:
         nid = conn.remote_id
         with self._mtx:
             if self._conns.get(nid) is conn:
                 del self._conns[nid]
+                pq = self._queues.pop(nid, None)
+                if pq is not None:
+                    pq.close()
         conn.close()
         self._pm.disconnected(nid)
         if err is not None:
@@ -190,31 +276,51 @@ class Router:
             except queue.Full:
                 pass  # drop under backpressure (router.go pqueue drop)
 
+    def _send_peer(self, conn: Connection) -> None:
+        """router.go:855-903 sendPeer: drain this peer's priority queue
+        onto its connection; a stalled connection only blocks this peer."""
+        nid = conn.remote_id
+        with self._mtx:
+            pq = self._queues.get(nid)
+        if pq is None:
+            return
+        while not self._stopped.is_set():
+            item = pq.pop(timeout=0.5)
+            if item is None:
+                if pq.closed:
+                    return
+                continue
+            channel_id, msg = item
+            try:
+                conn.send(channel_id, msg)
+            except (ConnectionError, OSError) as e:
+                self._drop_peer(conn, e)
+                return
+
     def _route_out(self, env: Envelope) -> bool:
         with self._mtx:
             if env.broadcast:
-                conns = list(self._conns.values())
+                queues = list(self._queues.values())
             else:
-                c = self._conns.get(env.to_id)
-                conns = [c] if c is not None else []
-        ok = bool(conns)
-        for c in conns:
-            try:
-                if not c.send(env.channel_id, env.message):
-                    ok = False
-            except (ConnectionError, OSError):
-                self._drop_peer(c, None)
-                ok = False
+                q = self._queues.get(env.to_id)
+                queues = [q] if q is not None else []
+        ok = bool(queues)
+        for q in queues:
+            if not q.put(env.channel_id, env.message):
+                ok = False  # per-peer per-channel overflow drop (pqueue.go)
         return ok
 
     def connected(self) -> List[str]:
         with self._mtx:
             return list(self._conns)
 
-    def disconnect_peer(self, node_id: str) -> None:
+    def disconnect_peer(self, node_id: str) -> bool:
         """Sever a peer connection (evictions, test perturbations); the
-        peer manager will redial persistent peers."""
+        peer manager will redial persistent peers. Returns False when no
+        connection is registered for the node."""
         with self._mtx:
             conn = self._conns.get(node_id)
-        if conn is not None:
-            self._drop_peer(conn, None)
+        if conn is None:
+            return False
+        self._drop_peer(conn, None)
+        return True

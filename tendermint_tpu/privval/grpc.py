@@ -1,0 +1,149 @@
+"""Remote signer over gRPC.
+
+Reference parity: privval/grpc/ — the `tendermint.privval.PrivValidatorAPI`
+service (GetPubKey, SignVote, SignProposal), client (privval/grpc/client.go)
+and server (privval/grpc/server.go). Wire payloads reuse this framework's
+privval message fields; grpcio's generic handler API carries them as raw
+proto bytes (no generated stubs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..crypto import PubKey
+from ..crypto import ed25519 as _ed25519
+from ..types import Vote
+from ..types.proposal import Proposal
+from ..wire.proto import ProtoWriter, decode_message, field_bytes
+from . import FilePV, PrivValidator
+from .remote import RemoteSignerError
+
+SERVICE = "tendermint.privval.PrivValidatorAPI"
+_METHODS = ("GetPubKey", "SignVote", "SignProposal")
+
+
+def _require_grpc():
+    import grpc
+
+    return grpc
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+def _ok(field: int, payload: bytes) -> bytes:
+    w = ProtoWriter()
+    w.write_bytes(field, payload)
+    return w.bytes()
+
+
+def _err(msg: str) -> bytes:
+    w = ProtoWriter()
+    w.write_string(2, msg)
+    return w.bytes()
+
+
+class GRPCSignerServer:
+    """privval/grpc/server.go: serves a local FilePV."""
+
+    def __init__(self, pv: FilePV, address: str = "127.0.0.1:0"):
+        grpc = _require_grpc()
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pv = pv
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
+        pv_ = pv
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                try:
+                    service, method = details.method.lstrip("/").split("/", 1)
+                except ValueError:
+                    return None
+                if service != SERVICE or method not in _METHODS:
+                    return None
+
+                def unary(request: bytes, context) -> bytes:
+                    f = decode_message(request)
+                    if method == "GetPubKey":
+                        return _ok(1, pv_.get_pub_key().bytes())
+                    chain_id = field_bytes(f, 2).decode()
+                    try:
+                        if method == "SignVote":
+                            vote = Vote.decode(field_bytes(f, 1))
+                            return _ok(1, pv_.sign_vote(chain_id, vote).encode())
+                        proposal = Proposal.decode(field_bytes(f, 1))
+                        return _ok(1, pv_.sign_proposal(chain_id, proposal).encode())
+                    except ValueError as e:
+                        return _err(str(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=_identity,
+                    response_serializer=_identity,
+                )
+
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        host, _, port = address.rpartition(":")
+        self._port = self._server.add_insecure_port(f"{host or '127.0.0.1'}:{port}")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=1)
+
+
+class GRPCSignerClient(PrivValidator):
+    """privval/grpc/client.go: PrivValidator backed by the gRPC service."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        grpc = _require_grpc()
+        for prefix in ("grpc://", "tcp://"):
+            if address.startswith(prefix):
+                address = address[len(prefix):]
+        self._channel = grpc.insecure_channel(address)
+        self._timeout = timeout
+        self._calls = {
+            m: self._channel.unary_unary(
+                f"/{SERVICE}/{m}",
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            for m in _METHODS
+        }
+        self._pub: Optional[PubKey] = None
+
+    def _roundtrip(self, method: str, payload: bytes) -> bytes:
+        out = self._calls[method](payload, timeout=self._timeout)
+        f = decode_message(out)
+        if 2 in f:
+            raise RemoteSignerError(field_bytes(f, 2).decode())
+        return field_bytes(f, 1)
+
+    def get_pub_key(self) -> PubKey:
+        if self._pub is None:
+            raw = self._roundtrip("GetPubKey", b"")
+            self._pub = _ed25519.PubKey(raw)
+        return self._pub
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        w = ProtoWriter()
+        w.write_bytes(1, vote.encode())
+        w.write_string(2, chain_id)
+        return Vote.decode(self._roundtrip("SignVote", w.bytes()))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        w = ProtoWriter()
+        w.write_bytes(1, proposal.encode())
+        w.write_string(2, chain_id)
+        return Proposal.decode(self._roundtrip("SignProposal", w.bytes()))
+
+    def close(self) -> None:
+        self._channel.close()

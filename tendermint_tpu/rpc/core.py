@@ -164,6 +164,45 @@ class Environment:
     def genesis(self) -> dict:
         return {"genesis": json.loads(self._node.genesis.to_json())}
 
+    def genesis_chunked(self, chunk: int = 0) -> dict:
+        """env.GenesisChunked (routes.go:25): the genesis doc split into
+        base64 chunks for large-genesis chains. Chunks are computed once
+        and cached — this endpoint exists for very large documents."""
+        chunks = getattr(self, "_genesis_chunks", None)
+        if chunks is None:
+            data = self._node.genesis.to_json().encode()
+            size = 16 * 1024 * 1024  # internal/rpc/core/net.go genesisChunkSize
+            chunks = [data[i : i + size] for i in range(0, len(data), size)] or [b""]
+            self._genesis_chunks = chunks
+        chunk = int(chunk)
+        if not 0 <= chunk < len(chunks):
+            raise RPCError(
+                -32603,
+                f"there are {len(chunks)} chunks, but requested {chunk}",
+            )
+        return {
+            "chunk": str(chunk),
+            "total": str(len(chunks)),
+            "data": _b64(chunks[chunk]),
+        }
+
+    def remove_tx(self, txkey: str) -> dict:
+        """env.RemoveTx (routes.go:31): drop a tx from the mempool by key."""
+        import base64 as _base64
+
+        key = _base64.b64decode(txkey)
+        mp = self._node.mempool
+        with mp._mtx:
+            if key not in mp._tx_by_key:
+                raise RPCError(-32603, "transaction not found in the mempool")
+            mp._remove_tx(key)
+        return {}
+
+    def unsafe_flush_mempool(self) -> dict:
+        """env.UnsafeFlushMempool (routes.go:56-60, unsafe route)."""
+        self._node.mempool.flush()
+        return {}
+
     def abci_info(self) -> dict:
         res = self._node.proxy_app.info(abci.RequestInfo())
         return {
@@ -502,10 +541,14 @@ class Environment:
 
 # Method table (routes.go:12-50)
 ROUTES = [
-    "status", "health", "net_info", "genesis", "abci_info", "abci_query",
+    "status", "health", "net_info", "genesis", "genesis_chunked",
+    "abci_info", "abci_query",
     "block", "block_by_hash", "blockchain", "commit", "block_results",
     "validators", "consensus_params", "consensus_state", "dump_consensus_state",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "tx", "tx_search", "block_search", "num_unconfirmed_txs",
-    "unconfirmed_txs", "check_tx", "broadcast_evidence",
+    "unconfirmed_txs", "check_tx", "remove_tx", "broadcast_evidence",
 ]
+
+# routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured
+UNSAFE_ROUTES = ["unsafe_flush_mempool"]

@@ -13,7 +13,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qsl, urlparse
 
-from .core import Environment, ROUTES, RPCError
+from .core import Environment, ROUTES, UNSAFE_ROUTES, RPCError
 
 
 def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> bytes:
@@ -40,7 +40,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _call(self, method: str, params: dict, id_):
-        if method not in ROUTES:
+        allowed = method in ROUTES
+        if not allowed and method in UNSAFE_ROUTES:
+            # routes.go:56-60: unsafe routes mount only when configured
+            cfg = getattr(self.env._node, "config", None)
+            allowed = bool(cfg and cfg.rpc.unsafe)
+        if not allowed:
             return _rpc_response(
                 id_, error=RPCError(-32601, f"Method not found: {method}")
             )

@@ -101,8 +101,11 @@ class StateStore:
             self._save_validators(height - 1, state.last_validators, height - 1)
         self._save_validators(height, state.validators, height)
         self._save_validators(height + 1, state.next_validators, height + 1)
-        self._save_params(height, state.consensus_params,
-                          state.last_height_consensus_params_changed)
+        # full params checkpoint at `height`: after a statesync bootstrap
+        # the historical checkpoint last_height_consensus_params_changed
+        # points at does not exist locally, so a pointer-only record would
+        # dangle (store.go Bootstrap stores the params themselves too)
+        self._save_params(height, state.consensus_params, height)
         self._db.set(_KEY_STATE, _encode_state(state))
 
     # -- validators -----------------------------------------------------
@@ -113,6 +116,12 @@ class StateStore:
         if height == last_changed:
             w.write_message(2, vals.encode(), always=True)
         self._db.set(_validators_key(height), w.bytes())
+
+    def save_validators_at(self, height: int, vals: ValidatorSet) -> None:
+        """Checkpointed write for statesync backfill (reactor.go:504):
+        stores the full set at `height` so historical evidence over the
+        backfilled window can be verified."""
+        self._save_validators(height, vals, height)
 
     def load_validators(self, height: int) -> ValidatorSet:
         """store.go LoadValidators: walk back to the checkpoint then

@@ -31,6 +31,7 @@ from ..p2p.conn.mconnection import ChannelDescriptor
 from ..p2p.router import Router
 from ..state import State
 from ..types import Commit, Header, SignedHeader, ValidatorSet
+from ..types.params import ConsensusParams
 from ..types.block import BlockID
 from ..types.validation import verify_commit_light
 from ..version import BLOCK_PROTOCOL
@@ -40,6 +41,7 @@ from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to
 SNAPSHOT_CHANNEL = 0x60
 CHUNK_CHANNEL = 0x61
 LIGHT_BLOCK_CHANNEL = 0x62
+PARAMS_CHANNEL = 0x63  # reactor.go ParamsChannel
 
 # stateprovider.go:21-27: the light client behind the state provider uses
 # the node's trusting period; this default mirrors config's 14-day window.
@@ -58,8 +60,9 @@ CHUNK_DESC = ChannelDescriptor(
 LIGHT_BLOCK_DESC = ChannelDescriptor(
     id=LIGHT_BLOCK_CHANNEL, priority=5, recv_message_capacity=8 * 1024 * 1024
 )
+PARAMS_DESC = ChannelDescriptor(id=PARAMS_CHANNEL, priority=2)
 
-ALL_STATESYNC_DESCS = [SNAPSHOT_DESC, CHUNK_DESC, LIGHT_BLOCK_DESC]
+ALL_STATESYNC_DESCS = [SNAPSHOT_DESC, CHUNK_DESC, LIGHT_BLOCK_DESC, PARAMS_DESC]
 
 
 class SyncError(RuntimeError):
@@ -134,10 +137,12 @@ class StateSyncReactor:
         self._snap_ch = router.open_channel(SNAPSHOT_DESC)
         self._chunk_ch = router.open_channel(CHUNK_DESC)
         self._lb_ch = router.open_channel(LIGHT_BLOCK_DESC)
+        self._params_ch = router.open_channel(PARAMS_DESC)
         self._stopped = threading.Event()
         self._snapshots: Dict[tuple, _SnapshotInfo] = {}
         self._chunks: Dict[Tuple[int, int, int], bytes] = {}
         self._light_blocks: Dict[int, LightBlock] = {}
+        self._params: Dict[int, ConsensusParams] = {}
         self._mtx = threading.Lock()
 
     def start(self) -> None:
@@ -145,6 +150,7 @@ class StateSyncReactor:
             (self._snap_ch, self._handle_snapshot_msg),
             (self._chunk_ch, self._handle_chunk_msg),
             (self._lb_ch, self._handle_light_block_msg),
+            (self._params_ch, self._handle_params_msg),
         ):
             t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
             t.start()
@@ -239,6 +245,82 @@ class StateSyncReactor:
         )
 
     # -- client side: the sync (syncer.go:178 SyncAny) ---------------------
+
+    def _handle_params_msg(self, env) -> None:
+        """reactor.go:?? params channel: 1 request{1 height} ->
+        2 response{1 height, 2 params}; served from the state store."""
+        f = decode_message(env.message)
+        if 1 in f and self._serving and self._state_store is not None:
+            req = decode_message(field_bytes(f, 1))
+            height = to_signed64(field_int(req, 1))
+            try:
+                params = self._state_store.load_consensus_params(height)
+            except KeyError:
+                return
+            self._params_ch.send(
+                env.from_id, _enc(2, {1: height, 2: params.encode()})
+            )
+        elif 2 in f:
+            res = decode_message(field_bytes(f, 2))
+            height = to_signed64(field_int(res, 1))
+            with self._mtx:
+                self._params[height] = ConsensusParams.decode(field_bytes(res, 2))
+
+    def _fetch_params(self, height: int, timeout: float = 10.0) -> Optional[ConsensusParams]:
+        """syncer.go params fetch at the snapshot height (replacing the
+        round-2 genesis-params shortcut)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._mtx:
+                p = self._params.get(height)
+            if p is not None:
+                return p
+            self._params_ch.broadcast(_enc(1, {1: height}))
+            time.sleep(0.2)
+        return None
+
+    def backfill(self, state: State) -> int:
+        """reactor.go:504 backfill: after a snapshot restore, walk the
+        chain BACKWARDS from the snapshot height over the evidence window
+        (max_age_num_blocks / max_age_duration), hash-link-verifying each
+        header, and persist headers+commits+validator sets so historical
+        evidence can be verified. Returns the number of blocks stored."""
+        ev = state.consensus_params.evidence
+        stop_height = max(
+            state.initial_height, state.last_block_height - ev.max_age_num_blocks
+        )
+        stop_time_ns = (
+            state.last_block_time.seconds * 10**9
+            + state.last_block_time.nanos
+            - ev.max_age_duration_ns
+        )
+        current = self._load_local_light_block(state.last_block_height)
+        if current is None:
+            return 0
+        stored = 0
+        for h in range(state.last_block_height - 1, stop_height - 1, -1):
+            t_ns = (
+                current.signed_header.header.time.seconds * 10**9
+                + current.signed_header.header.time.nanos
+            )
+            if t_ns < stop_time_ns:
+                break  # time window exhausted (range() bounds the heights)
+            try:
+                lb = self._fetch_light_block(h)
+            except SyncError:
+                break
+            # hash-linkage: the verified child must point at this header
+            if current.signed_header.header.last_block_id.hash != lb.hash():
+                raise SyncError(f"backfill: hash mismatch at height {h}")
+            if lb.signed_header.header.validators_hash != lb.validators.hash():
+                raise SyncError(f"backfill: validator hash mismatch at {h}")
+            self._block_store.save_signed_header(
+                lb.signed_header, current.signed_header.header.last_block_id
+            )
+            self._state_store.save_validators_at(h, lb.validators)
+            stored += 1
+            current = lb
+        return stored
 
     def _fetch_light_block(self, height: int, timeout: float = 10.0) -> LightBlock:
         deadline = time.time() + timeout
@@ -416,6 +498,14 @@ class StateSyncReactor:
             nn_vals = self._verified_light_block(snap.height + 2, trusted).validators
         except SyncError:
             nn_vals = next_vals
+        # consensus params at the snapshot height from the params channel
+        # (reactor.go params fetch); genesis params only as a last resort
+        params = self._fetch_params(snap.height, timeout=5.0)
+        if params is not None:
+            params_height = snap.height
+        else:
+            params = genesis_state.consensus_params
+            params_height = genesis_state.initial_height
         state = State(
             version=genesis_state.version,
             chain_id=self._chain_id,
@@ -427,8 +517,8 @@ class StateSyncReactor:
             next_validators=nn_vals.copy(),
             last_validators=snap_block.validators.copy(),
             last_height_validators_changed=snap.height + 1,
-            consensus_params=genesis_state.consensus_params,
-            last_height_consensus_params_changed=genesis_state.initial_height,
+            consensus_params=params,
+            last_height_consensus_params_changed=params_height,
             last_results_hash=header_next.signed_header.header.last_results_hash,
             app_hash=trusted_app_hash,
         )

@@ -1,0 +1,1 @@
+"""Standalone tooling (tools/ in the reference tree)."""

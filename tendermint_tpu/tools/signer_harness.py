@@ -1,0 +1,154 @@
+"""Remote-signer conformance harness.
+
+Reference parity: tools/tm-signer-harness/main.go + internal/test_harness.go
+— a battery of acceptance tests any remote signer implementation (socket
+or gRPC) must pass before being trusted with a validator key:
+
+  1. PUBKEY:      the signer reports the expected public key
+  2. SIGN_VOTE:   a prevote and a precommit come back correctly signed
+  3. SIGN_PROPOSAL: a proposal comes back correctly signed
+  4. DOUBLE_SIGN: signing a conflicting vote at the same HRS is refused
+  5. HRS_REGRESSION: signing at a lower height/round/step is refused
+  6. TS_REPEAT:   re-signing the identical vote returns the stored
+                  signature (same-HRS timestamp rule)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from ..types import Vote
+from ..types.block import BlockID, PartSetHeader
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..wire.canonical import Timestamp
+
+
+@dataclass
+class HarnessResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclass
+class HarnessReport:
+    results: List[HarnessResult] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def add(self, name: str, ok: bool, detail: str = "") -> None:
+        self.results.append(HarnessResult(name, ok, detail))
+
+
+def _bid(tag: bytes) -> BlockID:
+    h = tag * 32
+    return BlockID(hash=h, part_set_header=PartSetHeader(total=1, hash=h))
+
+
+def run_harness(
+    signer,
+    chain_id: str = "signer-harness",
+    expected_pub_key=None,
+    base_height: int = 1_000_000,
+) -> HarnessReport:
+    """Run the conformance battery against a PrivValidator-shaped signer.
+    Uses a very high base height so a real validator state file is never
+    poisoned for live heights."""
+    rep = HarnessReport()
+    h = base_height
+
+    # 1. PUBKEY
+    try:
+        pk = signer.get_pub_key()
+        if expected_pub_key is not None:
+            rep.add(
+                "PUBKEY",
+                pk.bytes() == expected_pub_key.bytes(),
+                "reported key differs from expected",
+            )
+        else:
+            rep.add("PUBKEY", len(pk.bytes()) == 32)
+    except Exception as e:  # noqa: BLE001
+        rep.add("PUBKEY", False, str(e))
+        return rep  # nothing else can run without the key
+
+    # 2. SIGN_VOTE (prevote then precommit at the same height/round)
+    signed_pre: Optional[Vote] = None
+    for vtype, name in ((PREVOTE_TYPE, "SIGN_PREVOTE"), (PRECOMMIT_TYPE, "SIGN_PRECOMMIT")):
+        v = Vote(
+            type=vtype,
+            height=h,
+            round=0,
+            block_id=_bid(b"\x51"),
+            timestamp=Timestamp(seconds=1_700_000_000),
+            validator_address=pk.address(),
+            validator_index=0,
+        )
+        try:
+            sv = signer.sign_vote(chain_id, v)
+            ok = pk.verify_signature(sv.sign_bytes(chain_id), sv.signature)
+            rep.add(name, bool(ok), "" if ok else "signature does not verify")
+            if vtype == PRECOMMIT_TYPE:
+                signed_pre = sv
+        except Exception as e:  # noqa: BLE001
+            rep.add(name, False, str(e))
+
+    # 3. DOUBLE_SIGN: conflicting precommit at the already-signed HRS
+    if signed_pre is not None:
+        conflicting = replace(signed_pre, block_id=_bid(b"\x53"), signature=b"")
+        try:
+            signer.sign_vote(chain_id, conflicting)
+            rep.add("DOUBLE_SIGN_REFUSED", False, "conflicting vote was signed")
+        except Exception:  # noqa: BLE001 — refusal is the pass condition
+            rep.add("DOUBLE_SIGN_REFUSED", True)
+
+    # 4. TS_REPEAT: identical vote again -> stored signature returned
+    # (same-HRS timestamp rule; must run before the proposal moves HRS)
+    if signed_pre is not None:
+        again = replace(signed_pre, signature=b"")
+        try:
+            sv2 = signer.sign_vote(chain_id, again)
+            rep.add(
+                "TS_REPEAT",
+                sv2.signature == signed_pre.signature,
+                "stored signature was not returned for the identical vote",
+            )
+        except Exception as e:  # noqa: BLE001
+            rep.add("TS_REPEAT", False, str(e))
+
+    # 5. SIGN_PROPOSAL (next height so HRS moves forward)
+    try:
+        p = Proposal(
+            height=h + 1,
+            round=0,
+            pol_round=-1,
+            block_id=_bid(b"\x52"),
+            timestamp=Timestamp(seconds=1_700_000_100),
+        )
+        sp = signer.sign_proposal(chain_id, p)
+        ok = pk.verify_signature(sp.sign_bytes(chain_id), sp.signature)
+        rep.add("SIGN_PROPOSAL", bool(ok), "" if ok else "signature does not verify")
+    except Exception as e:  # noqa: BLE001
+        rep.add("SIGN_PROPOSAL", False, str(e))
+
+    # 6. HRS_REGRESSION: height strictly below the last signed one
+    low = Vote(
+        type=PREVOTE_TYPE,
+        height=h - 1,
+        round=0,
+        block_id=_bid(b"\x54"),
+        timestamp=Timestamp(seconds=1_700_000_000),
+        validator_address=pk.address(),
+        validator_index=0,
+    )
+    try:
+        signer.sign_vote(chain_id, low)
+        rep.add("HRS_REGRESSION_REFUSED", False, "regressed height was signed")
+    except Exception:  # noqa: BLE001
+        rep.add("HRS_REGRESSION_REFUSED", True)
+
+    return rep

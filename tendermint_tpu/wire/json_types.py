@@ -1,0 +1,132 @@
+"""JSON -> core-type decoding (the inverse of rpc/core.py's JSON shapes).
+
+Reference parity: libs/json + rpc/client response decoding — RFC3339
+times with nanosecond precision, hex-upper hashes, base64 keys and
+signatures. Used by the MBT conformance driver (tests/vectors/mbt) and
+the HTTP light-block provider.
+"""
+
+from __future__ import annotations
+
+import base64
+import calendar
+import re
+
+from ..crypto import ed25519
+from ..types.block import (
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+    SignedHeader,
+    Version,
+)
+from ..types.validator_set import Validator, ValidatorSet
+from .canonical import Timestamp
+
+_TIME_RE = re.compile(
+    r"^(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2}):(\d{2})(?:\.(\d+))?Z$"
+)
+
+
+def parse_time(s: str) -> Timestamp:
+    m = _TIME_RE.match(s)
+    if not m:
+        raise ValueError(f"bad RFC3339 time {s!r}")
+    y, mo, d, h, mi, sec = (int(m.group(i)) for i in range(1, 7))
+    frac = (m.group(7) or "").ljust(9, "0")
+    secs = calendar.timegm((y, mo, d, h, mi, sec, 0, 0, 0))
+    return Timestamp(seconds=secs, nanos=int(frac) if frac else 0)
+
+
+def _hex(v) -> bytes:
+    return bytes.fromhex(v) if v else b""
+
+
+def parse_block_id(d) -> BlockID:
+    if d is None:
+        return BlockID()
+    parts = d.get("parts") or d.get("part_set_header")
+    psh = (
+        PartSetHeader(total=int(parts["total"]), hash=_hex(parts["hash"]))
+        if parts
+        else PartSetHeader()
+    )
+    return BlockID(hash=_hex(d["hash"]), part_set_header=psh)
+
+
+def parse_header(d) -> Header:
+    return Header(
+        version=Version(
+            block=int(d["version"]["block"]), app=int(d["version"].get("app", 0))
+        ),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time=parse_time(d["time"]),
+        last_block_id=parse_block_id(d.get("last_block_id")),
+        last_commit_hash=_hex(d.get("last_commit_hash")),
+        data_hash=_hex(d.get("data_hash")),
+        validators_hash=_hex(d["validators_hash"]),
+        next_validators_hash=_hex(d["next_validators_hash"]),
+        consensus_hash=_hex(d["consensus_hash"]),
+        app_hash=_hex(d.get("app_hash")),
+        last_results_hash=_hex(d.get("last_results_hash")),
+        evidence_hash=_hex(d.get("evidence_hash")),
+        proposer_address=_hex(d["proposer_address"]),
+    )
+
+
+def parse_commit(d) -> Commit:
+    sigs = []
+    for s in d["signatures"]:
+        sigs.append(
+            CommitSig(
+                block_id_flag=int(s["block_id_flag"]),
+                validator_address=_hex(s.get("validator_address")),
+                timestamp=(
+                    parse_time(s["timestamp"])
+                    if s.get("timestamp")
+                    else Timestamp.zero()
+                ),
+                signature=(
+                    base64.b64decode(s["signature"]) if s.get("signature") else b""
+                ),
+            )
+        )
+    return Commit(
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=parse_block_id(d["block_id"]),
+        signatures=sigs,
+    )
+
+
+def parse_signed_header(d) -> SignedHeader:
+    return SignedHeader(
+        header=parse_header(d["header"]), commit=parse_commit(d["commit"])
+    )
+
+
+def parse_validator(v) -> Validator:
+    pk = v["pub_key"]
+    if pk.get("type") not in (None, "tendermint/PubKeyEd25519"):
+        raise ValueError(f"unsupported pubkey type {pk.get('type')!r}")
+    val = Validator.new(
+        ed25519.PubKey(base64.b64decode(pk["value"])), int(v["voting_power"])
+    )
+    if v.get("proposer_priority") is not None:
+        val.proposer_priority = int(v["proposer_priority"])
+    if v.get("address"):
+        want = _hex(v["address"])
+        if val.address != want:
+            raise ValueError("validator address does not match its pubkey")
+    return val
+
+
+def parse_validator_set(d) -> ValidatorSet:
+    """Order-preserving (hash commits to the given order)."""
+    vals = [parse_validator(v) for v in d["validators"]]
+    vs = ValidatorSet(validators=vals)
+    vs._update_total_voting_power()
+    return vs

@@ -40,7 +40,7 @@ FAST = ConsensusConfig(
 )
 
 
-def make_node(sks, idx, wal_path=None, tx_source=None):
+def make_node(sks, idx, wal_path=None, tx_source=None, proxy=None):
     """One in-process consensus node for validator idx."""
     doc = GenesisDoc(
         chain_id=CHAIN_ID,
@@ -51,7 +51,7 @@ def make_node(sks, idx, wal_path=None, tx_source=None):
     )
     state = make_genesis_state(doc)
     app = KVStoreApplication()
-    proxy = LocalClient(app)
+    proxy = proxy or LocalClient(app)
     sstore = StateStore(MemDB())
     sstore.save(state)
     bstore = BlockStore(MemDB())

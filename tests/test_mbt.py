@@ -11,128 +11,21 @@ SUCCESS / NOT_ENOUGH_TRUST / INVALID error taxonomy all have to line up
 for every step of every trace.
 """
 
-import base64
-import calendar
 import glob
 import json
 import os
-import re
 
 import pytest
 
 from tendermint_tpu.crypto import batch as cbatch
-from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.light import verifier
-from tendermint_tpu.types import Validator, ValidatorSet
-from tendermint_tpu.types.block import (
-    BlockID,
-    Commit,
-    CommitSig,
-    Header,
-    PartSetHeader,
-    SignedHeader,
-    Version,
+from tendermint_tpu.wire.json_types import (
+    parse_signed_header,
+    parse_time,
+    parse_validator_set as parse_valset,
 )
-from tendermint_tpu.wire.canonical import Timestamp
 
 VECTOR_DIR = os.path.join(os.path.dirname(__file__), "vectors", "mbt")
-
-_TIME_RE = re.compile(
-    r"^(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2}):(\d{2})(?:\.(\d+))?Z$"
-)
-
-
-def parse_time(s: str) -> Timestamp:
-    m = _TIME_RE.match(s)
-    assert m, f"bad RFC3339 time {s!r}"
-    y, mo, d, h, mi, sec = (int(m.group(i)) for i in range(1, 7))
-    frac = (m.group(7) or "").ljust(9, "0")
-    secs = calendar.timegm((y, mo, d, h, mi, sec, 0, 0, 0))
-    return Timestamp(seconds=secs, nanos=int(frac) if frac else 0)
-
-
-def _hex(v) -> bytes:
-    return bytes.fromhex(v) if v else b""
-
-
-def parse_block_id(d) -> BlockID:
-    if d is None:
-        return BlockID()
-    parts = d.get("parts") or d.get("part_set_header")
-    psh = (
-        PartSetHeader(total=int(parts["total"]), hash=_hex(parts["hash"]))
-        if parts
-        else PartSetHeader()
-    )
-    return BlockID(hash=_hex(d["hash"]), part_set_header=psh)
-
-
-def parse_header(d) -> Header:
-    return Header(
-        version=Version(
-            block=int(d["version"]["block"]), app=int(d["version"]["app"])
-        ),
-        chain_id=d["chain_id"],
-        height=int(d["height"]),
-        time=parse_time(d["time"]),
-        last_block_id=parse_block_id(d.get("last_block_id")),
-        last_commit_hash=_hex(d.get("last_commit_hash")),
-        data_hash=_hex(d.get("data_hash")),
-        validators_hash=_hex(d["validators_hash"]),
-        next_validators_hash=_hex(d["next_validators_hash"]),
-        consensus_hash=_hex(d["consensus_hash"]),
-        app_hash=_hex(d.get("app_hash")),
-        last_results_hash=_hex(d.get("last_results_hash")),
-        evidence_hash=_hex(d.get("evidence_hash")),
-        proposer_address=_hex(d["proposer_address"]),
-    )
-
-
-def parse_commit(d) -> Commit:
-    sigs = []
-    for s in d["signatures"]:
-        sigs.append(
-            CommitSig(
-                block_id_flag=int(s["block_id_flag"]),
-                validator_address=_hex(s.get("validator_address")),
-                timestamp=(
-                    parse_time(s["timestamp"])
-                    if s.get("timestamp")
-                    else Timestamp.zero()
-                ),
-                signature=(
-                    base64.b64decode(s["signature"]) if s.get("signature") else b""
-                ),
-            )
-        )
-    return Commit(
-        height=int(d["height"]),
-        round=int(d["round"]),
-        block_id=parse_block_id(d["block_id"]),
-        signatures=sigs,
-    )
-
-
-def parse_signed_header(d) -> SignedHeader:
-    return SignedHeader(header=parse_header(d["header"]), commit=parse_commit(d["commit"]))
-
-
-def parse_valset(d) -> ValidatorSet:
-    """Order-preserving: the Go driver unmarshals straight into
-    types.ValidatorSet without re-sorting, so the hash commits to the
-    vector's order."""
-    vals = []
-    for v in d["validators"]:
-        assert v["pub_key"]["type"] == "tendermint/PubKeyEd25519"
-        pk = ed25519.PubKey(base64.b64decode(v["pub_key"]["value"]))
-        val = Validator.new(pk, int(v["voting_power"]))
-        assert val.address == _hex(v["address"]), "address derivation mismatch"
-        if v.get("proposer_priority") is not None:
-            val.proposer_priority = int(v["proposer_priority"])
-        vals.append(val)
-    vs = ValidatorSet(validators=vals)
-    vs._update_total_voting_power()
-    return vs
 
 
 def trace_files():

@@ -197,3 +197,138 @@ class TestConsensusOverTCP:
                 r.stop()
         hashes = [s.load_block(2).hash() for s in stores]
         assert all(h == hashes[0] for h in hashes), "nodes diverged over TCP"
+
+
+class TestPeerLifecycle:
+    """peermanager.go:27-60 eviction/upgrade machinery + pqueue.go
+    priority routing + flowrate limiting."""
+
+    def test_errored_peer_evicted_and_banned(self):
+        from tendermint_tpu.p2p.peermanager import EVICT_SCORE
+
+        pm = PeerManager("self", ban_duration=5.0)
+        pm.add_address(PeerAddress("bad", "bad"))
+        assert pm.accepted("bad")
+        for _ in range(-EVICT_SCORE):
+            pm.errored("bad", ValueError("garbage"))
+        assert pm.evict_next() == "bad"
+        pm.disconnected("bad")
+        # banned: neither dialable nor re-admittable until the ban lapses
+        assert pm.is_banned("bad")
+        assert pm.dial_next() is None
+        assert not pm.accepted("bad")
+
+    def test_upgrade_displaces_worst_peer(self):
+        pm = PeerManager("self", max_connected=2)
+        assert pm.accepted("a") and pm.accepted("b")
+        # "a" misbehaves a little (score -2, above eviction threshold)
+        pm.errored("a", ValueError("x"), weight=2)
+        # a better candidate arrives while full: admitted, "a" queued
+        assert pm.accepted("c")
+        assert sorted(pm.connected_peers()) == ["a", "b", "c"]
+        assert pm.evict_next() == "a"
+
+    def test_persistent_peer_never_evicted(self):
+        pm = PeerManager("self")
+        pm.add_address(PeerAddress("p", "p"), persistent=True)
+        assert pm.accepted("p")
+        for _ in range(50):
+            pm.errored("p", ValueError("x"))
+        assert pm.evict_next() is None
+
+    def test_address_book_gc(self):
+        pm = PeerManager("self", max_peers=10)
+        for i in range(15):
+            pm.add_address(PeerAddress(f"n{i}", f"n{i}"))
+        assert pm.prune_addresses() == 5
+        assert len(pm.peers()) == 10
+
+    def test_router_evicts_garbage_peer_and_gossip_stays_flat(self):
+        """A peer that misbehaves repeatedly is dropped by the router's
+        eviction pump while a healthy peer's high-priority traffic keeps
+        flowing."""
+        from tendermint_tpu.p2p.peermanager import EVICT_SCORE
+
+        hub = new_memory_network()
+        keys = [NodeKey.generate(bytes([i + 41]) * 32) for i in range(3)]
+        ids = [k.node_id for k in keys]
+        hi = ChannelDescriptor(id=0x22, priority=6)  # vote gossip
+        routers, chans = [], []
+        for i in range(3):
+            t = MemoryTransport(hub, ids[i], keys[i].pub_key)
+            pm = PeerManager(ids[i])
+            r = Router(t, pm, ids[i])
+            chans.append(r.open_channel(hi))
+            routers.append(r)
+            r.start()
+        routers[0]._pm.add_address(PeerAddress(ids[1], ids[1]))
+        routers[0]._pm.add_address(PeerAddress(ids[2], ids[2]))
+        deadline = time.time() + 5
+        while time.time() < deadline and len(routers[0].connected()) < 2:
+            time.sleep(0.05)
+        assert len(routers[0].connected()) == 2
+        # peer 2 keeps sending garbage -> errored until eviction
+        for _ in range(-EVICT_SCORE + 2):
+            routers[0]._pm.errored(ids[2], ValueError("garbage"))
+        deadline = time.time() + 5
+        while time.time() < deadline and ids[2] in routers[0].connected():
+            time.sleep(0.05)
+        assert ids[2] not in routers[0].connected()
+        # healthy peer still delivers promptly
+        t0 = time.time()
+        chans[0].send(ids[1], b"vote")
+        env = chans[1].receive(timeout=5)
+        assert env.message == b"vote" and time.time() - t0 < 1.0
+        for r in routers:
+            r.stop()
+
+    def test_priority_channel_wins_per_peer_queue(self):
+        """pqueue semantics: with a peer's low-priority queue stuffed, a
+        high-priority message still goes out ahead of the backlog."""
+        from tendermint_tpu.p2p.router import _PeerQueue
+
+        lo = ChannelDescriptor(id=0x40, priority=1, send_queue_capacity=50)
+        hi = ChannelDescriptor(id=0x22, priority=6, send_queue_capacity=50)
+        pq = _PeerQueue({lo.id: lo, hi.id: hi})
+        for i in range(50):
+            assert pq.put(lo.id, b"bulk%d" % i)
+        assert not pq.put(lo.id, b"overflow")  # bounded: drops, not blocks
+        assert pq.dropped == 1
+        assert pq.put(hi.id, b"vote")
+        ch, msg = pq.pop(timeout=1)
+        assert ch == hi.id and msg == b"vote"  # vote jumps the bulk backlog
+        ch, _ = pq.pop(timeout=1)
+        assert ch == lo.id
+
+    def test_flowrate_limited_connection(self):
+        """flowrate cap: pushing ~30 kB through a 50 kB/s-limited
+        MConnection takes >= ~0.4s and the monitor sees the rate."""
+        import socket as _socket
+
+        from tendermint_tpu.p2p.conn.mconnection import MConnection
+        from tendermint_tpu.p2p.transport import _SockStream
+
+        a, b = _socket.socketpair()
+        got = []
+        done = threading.Event()
+
+        def on_recv(ch, msg):
+            got.append(msg)
+            if len(got) == 30:
+                done.set()
+
+        descs = [ChannelDescriptor(id=1, send_queue_capacity=64)]
+        ma = MConnection(_SockStream(a), descs, lambda c, m: None,
+                         lambda e: None, send_rate=50_000)
+        mb = MConnection(_SockStream(b), descs, on_recv, lambda e: None)
+        ma.start()
+        mb.start()
+        t0 = time.time()
+        for i in range(30):
+            assert ma.send(1, bytes(1000))
+        assert done.wait(10)
+        dt = time.time() - t0
+        assert dt >= 0.35, f"30kB at 50kB/s finished too fast: {dt:.2f}s"
+        assert ma.send_monitor.total() >= 30_000
+        ma.stop()
+        mb.stop()

@@ -133,6 +133,63 @@ class TestStateSync:
         assert fresh_sstore.load().last_block_height == snap_height
         assert fresh_sstore.load_validators(snap_height + 1).hash() == state.validators.hash()
         assert commit.height == snap_height
+        # consensus params were fetched at the snapshot height over the
+        # params channel, not defaulted from genesis (reactor.go params ch)
+        assert state.last_height_consensus_params_changed == snap_height
+        # bootstrap checkpoints the fetched params at the next height
+        assert fresh_sstore.load_consensus_params(snap_height + 1).block.max_bytes == \
+            state.consensus_params.block.max_bytes
+
+    def test_backfill_stores_evidence_window(self, snapshotting_chain):
+        """reactor.go:504 backfill: after restore, the historical window
+        of headers/commits/validator sets is fetched, hash-link-verified
+        and persisted so old-window evidence can be verified."""
+        app, proxy, src_sstore, src_bstore, doc = snapshotting_chain
+        hub = new_memory_network()
+        keys = [NodeKey.generate(bytes([i + 50]) * 32) for i in range(2)]
+        routers = []
+        for i in range(2):
+            t = MemoryTransport(hub, keys[i].node_id, keys[i].pub_key)
+            routers.append(Router(t, PeerManager(keys[i].node_id), keys[i].node_id))
+        server = StateSyncReactor(
+            routers[0], proxy, src_sstore, src_bstore, CHAIN_ID, serving=True
+        )
+        fresh_sstore = StateStore(MemDB())
+        fresh_bstore = BlockStore(MemDB())
+        client = StateSyncReactor(
+            routers[1], LocalClient(KVStoreApplication()), fresh_sstore,
+            fresh_bstore, CHAIN_ID, serving=False,
+        )
+        routers[0]._pm.add_address(PeerAddress(keys[1].node_id, keys[1].node_id))
+        for r in routers:
+            r.start()
+        server.start()
+        client.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not routers[1].connected():
+            time.sleep(0.05)
+
+        genesis_state = make_genesis_state(doc)
+        usable = [h for h in app._snapshots if h + 2 <= src_bstore.height()]
+        snap_height = max(usable)
+        trust_block = server._load_local_light_block(snap_height)
+        try:
+            state, _ = client.sync_any(
+                genesis_state, trust_height=snap_height,
+                trust_hash=trust_block.hash(), discovery_time=10.0,
+            )
+            stored = client.backfill(state)
+        finally:
+            server.stop()
+            client.stop()
+            for r in routers:
+                r.stop()
+        # whole window back to initial height is present and linked
+        assert stored == snap_height - 1, stored
+        for h in range(1, snap_height):
+            meta = fresh_bstore.load_block_meta(h)
+            assert meta is not None, f"missing backfilled header at {h}"
+            assert fresh_sstore.load_validators(h) is not None
 
 
 class _OfflineReactor(StateSyncReactor):
