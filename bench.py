@@ -251,6 +251,24 @@ def worker() -> None:
         print(f"# multicore host baseline failed: {e}", file=sys.stderr)
         host_mc = 0.0
 
+    # Print the core result NOW: the driver takes the LAST JSON line, so
+    # if a later (secondary) benchmark stalls past the worker timeout the
+    # headline number still stands.
+    partial = {
+        "metric": f"verify_commit_{n_sigs}",
+        "value": round(1.0 / dev_s, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(host_s / dev_s, 3),
+        "backend": backend_kind,
+        "kernel": "pallas" if use_pallas else "xla",
+        "host_sigs_per_s": round(1.0 / host_s, 1),
+        "host_multicore_sigs_per_s": round(host_mc, 1),
+        "sustained_sigs_per_s": round(sus_rate, 1),
+        "sustained_vs_baseline": round(sus_rate * host_s, 3),
+        "partial": True,
+    }
+    print(json.dumps(partial), flush=True)
+
     # BASELINE config #5: pipelined adjacent-header verification
     # (light/verifier.go VerifyAdjacent over a fetched range, signature
     # batches double-buffered on the device via ops.pipeline). A failure
@@ -260,6 +278,18 @@ def worker() -> None:
     except Exception as e:  # noqa: BLE001
         print(f"# pipelined-header bench failed: {e}", file=sys.stderr)
         hdr_rate = 0.0
+
+    # BASELINE config #4: mixed-curve batch (ed25519 device lane +
+    # sr25519 lane + secp256k1 host). Runs LAST: a hung sr25519 Mosaic
+    # compile can wedge the shared relay compile helper, so nothing
+    # downstream may depend on it (ops.mixed's watchdog falls back to the
+    # host lane after TM_TPU_SR_COMPILE_TIMEOUT).
+    mixed_rate = 0.0
+    if on_accel:
+        try:
+            mixed_rate = _bench_mixed_curve()
+        except Exception as e:  # noqa: BLE001
+            print(f"# mixed-curve bench failed: {e}", file=sys.stderr)
 
     out = {
         "metric": f"verify_commit_{n_sigs}",
@@ -273,6 +303,7 @@ def worker() -> None:
         "vs_host_multicore": round(1.0 / dev_s / host_mc, 3) if host_mc else 0.0,
         "sustained_sigs_per_s": round(sus_rate, 1),
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
+        "mixed_curve_sigs_per_s": round(mixed_rate, 1),
         "pipelined_headers_per_s": round(hdr_rate, 1),
     }
     print(json.dumps(out))
@@ -285,6 +316,37 @@ def worker() -> None:
         f"pipelined_headers={hdr_rate:.1f}/s",
         file=sys.stderr,
     )
+
+
+def _bench_mixed_curve() -> float:
+    """Mixed 2k set: 1024 ed25519 + 896 sr25519 + 128 secp256k1 through
+    ops.mixed.verify_mixed (sr25519 signing is pure-Python ~10 ms/sig, so
+    the set is sized to keep generation inside the worker budget)."""
+    from tendermint_tpu.crypto import ed25519, secp256k1, sr25519
+    from tendermint_tpu.ops.mixed import verify_mixed
+
+    entries = []
+    for i in range(1024):
+        sk = ed25519.gen_priv_key(i.to_bytes(32, "little"))
+        m = b"mx-ed-%d" % i
+        entries.append((sk.pub_key(), m, sk.sign(m)))
+    srk = sr25519.gen_priv_key(b"\x09" * 32)
+    for i in range(896):
+        m = b"mx-sr-%d" % i
+        entries.append((srk.pub_key(), m, srk.sign(m)))
+    sck = secp256k1.gen_priv_key()
+    for i in range(128):
+        m = b"mx-secp-%d" % i
+        entries.append((sck.pub_key(), m, sck.sign(m)))
+    import random
+
+    random.Random(5).shuffle(entries)
+    res = verify_mixed(entries)  # warm (compiles both device lanes)
+    assert all(res), "mixed batch must verify"
+    t0 = time.perf_counter()
+    res = verify_mixed(entries)
+    dt = time.perf_counter() - t0
+    return len(entries) / dt
 
 
 def _bench_pipelined_headers(on_accel: bool) -> float:

@@ -296,6 +296,194 @@ static PyObject *py_pack_bits_le(PyObject *, PyObject *args) {
   return out;
 }
 
+
+// --------------------------------------------------------------------------
+// Merlin transcripts on STROBE-128 / Keccak-f[1600] — the sr25519
+// (schnorrkel) challenge computation, which dominates host-side cost of
+// the device sr25519 lane (pure-Python merlin is ~3 ms/signature; this is
+// ~2 us). Mirrors crypto/_merlin.py bit-for-bit (differentially tested).
+
+namespace merlin {
+
+static const uint64_t RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static inline uint64_t rotl64(uint64_t v, int n) {
+  return n ? (v << n) | (v >> (64 - n)) : v;
+}
+
+static const int ROTC[5][5] = {{0, 36, 3, 41, 18},
+                               {1, 44, 10, 45, 2},
+                               {62, 6, 43, 15, 61},
+                               {28, 55, 25, 21, 56},
+                               {27, 20, 39, 8, 14}};
+
+static void keccak_f1600(uint8_t state[200]) {
+  uint64_t lanes[5][5];
+  for (int x = 0; x < 5; x++)
+    for (int y = 0; y < 5; y++)
+      memcpy(&lanes[x][y], state + 8 * (x + 5 * y), 8);
+  for (int r = 0; r < 24; r++) {
+    uint64_t c[5], d[5];
+    for (int x = 0; x < 5; x++)
+      c[x] = lanes[x][0] ^ lanes[x][1] ^ lanes[x][2] ^ lanes[x][3] ^ lanes[x][4];
+    for (int x = 0; x < 5; x++)
+      d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++) lanes[x][y] ^= d[x];
+    uint64_t b[5][5];
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        b[y][(2 * x + 3 * y) % 5] = rotl64(lanes[x][y], ROTC[x][y]);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        lanes[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y]);
+    lanes[0][0] ^= RC[r];
+  }
+  for (int x = 0; x < 5; x++)
+    for (int y = 0; y < 5; y++)
+      memcpy(state + 8 * (x + 5 * y), &lanes[x][y], 8);
+}
+
+static const int STROBE_R = 166;
+static const uint8_t F_I = 1, F_A = 1 << 1, F_C = 1 << 2, F_M = 1 << 4,
+                     F_K = 1 << 5;
+
+struct Strobe {
+  uint8_t state[200];
+  int pos, pos_begin;
+
+  void run_f() {
+    state[pos] ^= (uint8_t)pos_begin;
+    state[pos + 1] ^= 0x04;
+    state[STROBE_R + 1] ^= 0x80;
+    keccak_f1600(state);
+    pos = 0;
+    pos_begin = 0;
+  }
+
+  void absorb(const uint8_t *d, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+      state[pos] ^= d[i];
+      if (++pos == STROBE_R) run_f();
+    }
+  }
+
+  void squeeze(uint8_t *out, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+      out[i] = state[pos];
+      state[pos] = 0;
+      if (++pos == STROBE_R) run_f();
+    }
+  }
+
+  void begin_op(uint8_t flags) {
+    uint8_t old_begin = (uint8_t)pos_begin;
+    pos_begin = pos + 1;
+    uint8_t hdr[2] = {old_begin, flags};
+    absorb(hdr, 2);
+    if ((flags & (F_C | F_K)) && pos != 0) run_f();
+  }
+
+  void meta_ad(const uint8_t *d, size_t n, bool more) {
+    if (!more) begin_op(F_M | F_A);
+    absorb(d, n);
+  }
+
+  void ad(const uint8_t *d, size_t n) {
+    begin_op(F_A);
+    absorb(d, n);
+  }
+
+  void prf(uint8_t *out, size_t n) {
+    begin_op(F_I | F_A | F_C);
+    squeeze(out, n);
+  }
+
+  void init(const uint8_t *label, size_t n) {
+    memset(state, 0, 200);
+    const uint8_t hdr[6] = {1, STROBE_R + 2, 1, 0, 1, 12 * 8};
+    memcpy(state, hdr, 6);
+    memcpy(state + 6, "STROBEv1.0.2", 12);
+    keccak_f1600(state);
+    pos = 0;
+    pos_begin = 0;
+    meta_ad(label, n, false);
+  }
+};
+
+static void append_message(Strobe &s, const uint8_t *label, size_t ln,
+                           const uint8_t *msg, size_t mn) {
+  uint8_t le[4] = {(uint8_t)(mn & 0xff), (uint8_t)((mn >> 8) & 0xff),
+                   (uint8_t)((mn >> 16) & 0xff), (uint8_t)((mn >> 24) & 0xff)};
+  s.meta_ad(label, ln, false);
+  s.meta_ad(le, 4, true);
+  s.ad(msg, mn);
+}
+
+}  // namespace merlin
+
+// sr25519_challenges(ctx, pubs, rs, msgs) -> n x 64-byte challenge bytes.
+static PyObject *py_sr25519_challenges(PyObject *, PyObject *args) {
+  const char *ctx_buf, *pubs, *rs;
+  Py_ssize_t ctx_len, pubs_len, rs_len;
+  PyObject *msgs;
+  if (!PyArg_ParseTuple(args, "y#y#y#O", &ctx_buf, &ctx_len, &pubs, &pubs_len,
+                        &rs, &rs_len, &msgs))
+    return nullptr;
+  PyObject *seq = PySequence_Fast(msgs, "expected a sequence of messages");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (pubs_len != 32 * n || rs_len != 32 * n) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "pubs/rs must be n*32 bytes");
+    return nullptr;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, n * 64);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    char *m;
+    Py_ssize_t mlen;
+    if (PyBytes_AsStringAndSize(item, &m, &mlen) < 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    merlin::Strobe s;
+    s.init((const uint8_t *)"Merlin v1.0", 11);
+    merlin::append_message(s, (const uint8_t *)"dom-sep", 7,
+                           (const uint8_t *)"SigningContext", 14);
+    merlin::append_message(s, (const uint8_t *)"", 0, (const uint8_t *)ctx_buf,
+                           (size_t)ctx_len);
+    merlin::append_message(s, (const uint8_t *)"sign-bytes", 10,
+                           (const uint8_t *)m, (size_t)mlen);
+    merlin::append_message(s, (const uint8_t *)"proto-name", 10,
+                           (const uint8_t *)"Schnorr-sig", 11);
+    merlin::append_message(s, (const uint8_t *)"sign:pk", 7,
+                           (const uint8_t *)(pubs + 32 * i), 32);
+    merlin::append_message(s, (const uint8_t *)"sign:R", 6,
+                           (const uint8_t *)(rs + 32 * i), 32);
+    uint8_t le[4] = {64, 0, 0, 0};
+    s.meta_ad((const uint8_t *)"sign:c", 6, false);
+    s.meta_ad(le, 4, true);
+    s.prf(dst + 64 * i, 64);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
 static PyMethodDef Methods[] = {
     {"merkle_root", py_merkle_root, METH_VARARGS,
      "RFC-6962 merkle root of a list of byte strings"},
@@ -303,6 +491,8 @@ static PyMethodDef Methods[] = {
      "SHA-256 of each item, concatenated"},
     {"pack_le_limbs", py_pack_le_limbs, METH_VARARGS,
      "pack 32B LE encodings into 13-bit limb arrays"},
+    {"sr25519_challenges", py_sr25519_challenges, METH_VARARGS,
+     "Batch merlin signing-transcript challenges for sr25519 verification"},
     {"pack_bits_le", py_pack_bits_le, METH_VARARGS,
      "pack 32B LE scalars into transposed bit arrays"},
     {nullptr, nullptr, 0, nullptr}};

@@ -53,13 +53,12 @@ class GRPCServer:
 
     def __init__(self, app: abci.Application, address: str = "127.0.0.1:0"):
         grpc = _require_grpc()
+        from concurrent.futures import ThreadPoolExecutor
+
         from .client import LocalClient
 
         self._local = LocalClient(app)
-        self._server = grpc.server(
-            __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"])
-            .ThreadPoolExecutor(max_workers=8)
-        )
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=8))
 
         local = self._local
 

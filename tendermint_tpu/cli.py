@@ -387,7 +387,7 @@ def cmd_light(args) -> int:
         chain_id=args.chain_id,
         trust_options=opts,
         primary=primary,
-        witnesses=witnesses or [primary],
+        witnesses=witnesses,
         store=LightStore(MemDB()),
     )
     vc = VerifyingClient(HTTPClient(args.primary), client)

@@ -58,7 +58,11 @@ def create_batch_verifier(pk: PubKey) -> Optional[BatchVerifier]:
         if _device_verifier_factory is not None:
             return _device_verifier_factory()
         return Ed25519HostBatchVerifier()
-    # sr25519 batch lands with the sr25519 key type; secp256k1 never batches.
+    if pk.type() == "sr25519":
+        from ..ops.mixed import Sr25519DeviceBatchVerifier
+
+        return Sr25519DeviceBatchVerifier()
+    # secp256k1 never batches (batch.go:26-33)
     return None
 
 
@@ -66,4 +70,4 @@ def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
     """crypto/batch/batch.go:26-33."""
     if pk is None:
         return False
-    return pk.type() == _ed25519.KEY_TYPE
+    return pk.type() in (_ed25519.KEY_TYPE, "sr25519")

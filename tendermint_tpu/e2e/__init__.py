@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ..abci import KVStoreApplication
+from ..abci import PersistentKVStoreApplication
 from ..config import Config, ConsensusConfig
 from ..crypto import ed25519
 from ..node import Node, make_node
@@ -36,6 +36,7 @@ class NodeManifest:
     power: int = 10
     start_at: int = 0  # join later (block height)
     perturb: List[str] = field(default_factory=list)  # kill | restart | disconnect
+    misbehave: str = ""  # "double-prevote" -> equivocate (runner misbehaviors)
 
 
 @dataclass
@@ -114,13 +115,54 @@ class Testnet:
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         node = make_node(
             cfg,
-            app=KVStoreApplication(),
+            app=PersistentKVStoreApplication(),
             genesis=GenesisDoc.from_json(self._genesis_json),
             priv_validator=FilePV(sk) if m.mode == "validator" else None,
             node_key=NodeKey.generate((f"nk-{m.name}" * 8).encode()[:32]),
             with_rpc=True,
         )
         self.nodes[m.name] = _RunningNode(manifest=m, node=node, sk=sk, node_key=node.node_key)
+        if m.misbehave == "double-prevote" and m.mode == "validator":
+            self._install_equivocation(self.nodes[m.name])
+
+    def _install_equivocation(self, rn: "_RunningNode") -> None:
+        """Manifest misbehavior (runner's double-sign injection): the node
+        prevotes the real proposal AND a fabricated block every round; the
+        conflicting vote gossips out and must come back as committed
+        DuplicateVoteEvidence (checked by check_evidence_committed)."""
+        from ..types import Vote
+        from ..types.block import BlockID, PartSetHeader
+        from ..types.vote import PREVOTE_TYPE
+
+        cs = rn.node.consensus
+        orig = cs._do_prevote
+        chain_id = self.manifest.chain_id
+
+        def equivocating_prevote(cs_self, height, round_):
+            orig(height, round_)
+            addr = cs_self._priv_validator_pub_key.address()
+            idx, _ = cs_self.rs.validators.get_by_address(addr)
+            bid = BlockID(
+                hash=b"\x66" * 32,
+                part_set_header=PartSetHeader(total=1, hash=b"\x66" * 32),
+            )
+            evil = Vote(
+                type=PREVOTE_TYPE,
+                height=cs_self.rs.height,
+                round=cs_self.rs.round,
+                block_id=bid,
+                timestamp=cs_self._vote_time(),
+                validator_address=addr,
+                validator_index=idx,
+            )
+            sig = cs_self._priv_validator._priv_key.sign(evil.sign_bytes(chain_id))
+            evil = Vote(**{**evil.__dict__, "signature": sig})
+            # hand the conflicting vote to every peer (gossip shortcut)
+            for other in self.nodes.values():
+                if other.node.consensus is not cs_self:
+                    other.node.consensus.add_vote_msg(evil, peer_id="byz")
+
+        cs.do_prevote_override = equivocating_prevote
 
     # -- run (runner: Start/Load/Perturb/Wait) ----------------------------
 
@@ -208,6 +250,53 @@ class Testnet:
         vals0 = live[0].rpc.validators(1)
         for rn in live[1:]:
             assert rn.rpc.validators(1) == vals0
+
+    def check_evidence_committed(self, timeout: float = 30.0) -> dict:
+        """evidence_test.go: with a misbehaving node in the manifest, some
+        committed block must carry DuplicateVoteEvidence naming it."""
+        import time as _t
+
+        assert any(m.misbehave for m in self.manifest.nodes), "no misbehavior configured"
+        honest = next(
+            rn for rn in self.nodes.values() if not rn.manifest.misbehave
+        )
+        deadline = _t.time() + timeout
+        while _t.time() < deadline:
+            tip = int(honest.rpc.status()["sync_info"]["latest_block_height"])
+            for h in range(1, tip + 1):
+                blk = honest.rpc.block(h)
+                ev = blk["block"].get("evidence", {}).get("evidence") or []
+                if ev:
+                    return {"height": h, "evidence": ev}
+            _t.sleep(0.3)
+        raise AssertionError("no evidence committed within timeout")
+
+    def rotate_validator_power(self, name: str, power: int) -> None:
+        """Submit the kvstore validator-update tx (persistent_kvstore.go
+        "val:<b64 pubkey>!<power>") for node `name` via RPC."""
+        import base64 as _b64
+
+        rn = self.nodes[name]
+        pub = rn.sk.pub_key().bytes()
+        tx = b"val:" + _b64.b64encode(pub) + b"!" + str(power).encode()
+        next(iter(self.nodes.values())).rpc.broadcast_tx_sync(tx)
+
+    def check_validator_rotation(self, name: str, power: int, timeout: float = 30.0) -> None:
+        """After rotate_validator_power, every live node's validator set
+        reflects the new power."""
+        import time as _t
+
+        rn = self.nodes[name]
+        addr = rn.sk.pub_key().address().hex().upper()
+        deadline = _t.time() + timeout
+        while _t.time() < deadline:
+            tip = int(rn.rpc.status()["sync_info"]["latest_block_height"])
+            vals = rn.rpc.validators(tip)
+            for v in vals["validators"]:
+                if v["address"] == addr and int(v["voting_power"]) == power:
+                    return
+            _t.sleep(0.3)
+        raise AssertionError(f"validator {name} never rotated to power {power}")
 
     def benchmark(self) -> dict:
         """runner/benchmark.go:15-67: block interval stats."""

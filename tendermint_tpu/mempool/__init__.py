@@ -249,6 +249,15 @@ class TxMempool:
                 if not self._cfg.keep_invalid_txs_in_cache:
                     self._cache.remove(wtx.tx)
 
+    def remove_tx_by_key(self, key: bytes) -> bool:
+        """mempool.go RemoveTxByKey (public API used by the remove_tx
+        RPC): drop a tx by key; False if absent."""
+        with self._mtx:
+            if key not in self._tx_by_key:
+                return False
+            self._remove_tx(key)
+            return True
+
     def flush(self) -> None:
         with self._mtx:
             self._tx_by_key.clear()

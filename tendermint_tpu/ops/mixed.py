@@ -1,0 +1,146 @@
+"""Mixed-curve batch verification (BASELINE config #4).
+
+Reference parity: crypto/batch/batch.go:11-33 — batch verifiers exist for
+ed25519 and sr25519; secp256k1 never batches (batch.go:26-33). Here the
+two batchable curves each get a DEVICE lane (ops.pallas_verify /
+ops.pallas_sr25519) and secp256k1 falls back to per-signature host
+verification (OpenSSL ECDSA), mirroring the reference's split.
+
+verify_mixed() partitions one heterogeneous batch by key type, dispatches
+all lanes, and reassembles per-signature verdicts in input order.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..crypto import PubKey
+from ..crypto import ed25519 as _ed
+from ..crypto import secp256k1 as _secp
+from ..crypto import sr25519 as _sr
+from . import backend as _backend
+
+# Below this many sr25519 signatures the device round-trip loses to the
+# (pure-Python, ~10 ms/sig) host path only for very small counts; the
+# device wins early because host schnorr math is so slow.
+SR_DEVICE_THRESHOLD = int(os.environ.get("TM_TPU_SR_DEVICE_THRESHOLD", "8"))
+
+
+# First device call (the Mosaic compile) is time-boxed: a pathologically
+# slow or hung remote compile must not wedge the caller — on timeout the
+# process permanently falls back to the host path for sr25519.
+SR_COMPILE_TIMEOUT = float(os.environ.get("TM_TPU_SR_COMPILE_TIMEOUT", "300"))
+_sr_device_state = {"ok": None}  # None = untried, True/False decided
+
+
+def _host_sr_batch(entries) -> np.ndarray:
+    return np.asarray([_sr.verify(pk, m, s) for pk, m, s in entries], dtype=bool)
+
+
+def _verify_sr25519_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    if (
+        len(entries) < SR_DEVICE_THRESHOLD
+        or not _backend._use_pallas()
+        or _sr_device_state["ok"] is False
+    ):
+        return _host_sr_batch(entries)
+    import jax
+
+    from . import pallas_sr25519 as ps
+
+    interpret = jax.default_backend() != "tpu"
+
+    def run_chunks() -> np.ndarray:
+        out = []
+        i = 0
+        while i < len(entries):
+            chunk = entries[i : i + _backend.BUCKETS[-1]]
+            bucket = _backend._pallas_bucket(len(chunk))
+            args = ps.prepare_sr25519(chunk, bucket)
+            res = ps.verify_sr25519_compact(*args, interpret=interpret)
+            out.append(res[: len(chunk)])
+            i += len(chunk)
+        return np.concatenate(out)
+
+    if _sr_device_state["ok"]:
+        return run_chunks()
+
+    # first use: compile under a watchdog
+    import threading
+
+    holder: dict = {}
+
+    def attempt():
+        try:
+            holder["res"] = run_chunks()
+        except Exception as e:  # noqa: BLE001
+            holder["err"] = e
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(SR_COMPILE_TIMEOUT)
+    if "res" in holder:
+        _sr_device_state["ok"] = True
+        return holder["res"]
+    _sr_device_state["ok"] = False  # hung or failed: host from now on
+    return _host_sr_batch(entries)
+
+
+def verify_mixed(
+    entries: Sequence[Tuple[PubKey, bytes, bytes]],
+) -> List[bool]:
+    """entries: (PubKey, msg, sig) with heterogeneous key types. Returns
+    per-entry validity in input order; ed25519 and sr25519 ride their
+    device lanes, secp256k1 verifies per-signature on the host."""
+    lanes = {"ed25519": [], "sr25519": [], "secp256k1": [], "other": []}
+    order = []
+    for i, (pk, msg, sig) in enumerate(entries):
+        kind = pk.type() if pk.type() in lanes else "other"
+        order.append((kind, len(lanes[kind])))
+        lanes[kind].append((pk, msg, sig))
+
+    results = {}
+    if lanes["ed25519"]:
+        results["ed25519"] = _backend.verify_batch(
+            [(pk.bytes(), m, s) for pk, m, s in lanes["ed25519"]]
+        )
+    if lanes["sr25519"]:
+        results["sr25519"] = _verify_sr25519_batch(
+            [(pk.bytes(), m, s) for pk, m, s in lanes["sr25519"]]
+        )
+    if lanes["secp256k1"]:
+        results["secp256k1"] = np.asarray(
+            [pk.verify_signature(m, s) for pk, m, s in lanes["secp256k1"]],
+            dtype=bool,
+        )
+    if lanes["other"]:
+        results["other"] = np.asarray(
+            [pk.verify_signature(m, s) for pk, m, s in lanes["other"]],
+            dtype=bool,
+        )
+    return [bool(results[kind][j]) for kind, j in order]
+
+
+class Sr25519DeviceBatchVerifier:
+    """crypto.BatchVerifier for sr25519 on the device ristretto lane
+    (crypto/sr25519/batch.go parity)."""
+
+    def __init__(self):
+        self._entries: List[Tuple[bytes, bytes, bytes]] = []
+
+    def add(self, key, msg: bytes, sig: bytes) -> None:
+        if key.type() != _sr.KEY_TYPE:
+            raise TypeError("pubkey is not sr25519")
+        if len(sig) != _sr.SIGNATURE_SIZE:
+            raise ValueError("invalid signature length")
+        self._entries.append((key.bytes(), msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._entries:
+            return False, []
+        res = _verify_sr25519_batch(self._entries)
+        valid = [bool(v) for v in res]
+        return all(valid), valid

@@ -78,11 +78,39 @@ def _commit_json(c) -> dict:
     }
 
 
+def _evidence_json(raw: bytes) -> dict:
+    """One committed evidence item (oneof wire form -> typed JSON)."""
+    from ..types.evidence import DuplicateVoteEvidence, decode_evidence
+
+    try:
+        ev = decode_evidence(raw)
+    except (ValueError, KeyError):
+        return {"type": "unknown", "value": _b64(raw)}
+    if isinstance(ev, DuplicateVoteEvidence):
+        return {
+            "type": "tendermint/DuplicateVoteEvidence",
+            "value": {
+                "total_voting_power": str(ev.total_voting_power),
+                "validator_power": str(ev.validator_power),
+                "height": str(ev.height()),
+                "vote_a": {"validator_address": _hex(ev.vote_a.validator_address)},
+                "vote_b": {"validator_address": _hex(ev.vote_b.validator_address)},
+            },
+        }
+    return {
+        "type": "tendermint/LightClientAttackEvidence",
+        "value": {
+            "common_height": str(ev.common_height),
+            "total_voting_power": str(ev.total_voting_power),
+        },
+    }
+
+
 def _block_json(b) -> dict:
     return {
         "header": _header_json(b.header),
         "data": {"txs": [_b64(tx) for tx in b.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {"evidence": [_evidence_json(raw) for raw in b.evidence]},
         "last_commit": _commit_json(b.last_commit) if b.last_commit else None,
     }
 
@@ -191,11 +219,8 @@ class Environment:
         import base64 as _base64
 
         key = _base64.b64decode(txkey)
-        mp = self._node.mempool
-        with mp._mtx:
-            if key not in mp._tx_by_key:
-                raise RPCError(-32603, "transaction not found in the mempool")
-            mp._remove_tx(key)
+        if not self._node.mempool.remove_tx_by_key(key):
+            raise RPCError(-32603, "transaction not found in the mempool")
         return {}
 
     def unsafe_flush_mempool(self) -> dict:

@@ -43,7 +43,7 @@ class _Handler(BaseHTTPRequestHandler):
         allowed = method in ROUTES
         if not allowed and method in UNSAFE_ROUTES:
             # routes.go:56-60: unsafe routes mount only when configured
-            cfg = getattr(self.env._node, "config", None)
+            cfg = getattr(getattr(self.env, "_node", None), "config", None)
             allowed = bool(cfg and cfg.rpc.unsafe)
         if not allowed:
             return _rpc_response(

@@ -314,6 +314,21 @@ class StateSyncReactor:
                 raise SyncError(f"backfill: hash mismatch at height {h}")
             if lb.signed_header.header.validators_hash != lb.validators.hash():
                 raise SyncError(f"backfill: validator hash mismatch at {h}")
+            # the commit must actually commit THIS header with +2/3 of its
+            # validator set (reactor.go backfill verifies light blocks; a
+            # byzantine peer could otherwise attach garbage commits to the
+            # genuine hash-linked header)
+            try:
+                lb.signed_header.validate_basic(self._chain_id)
+                verify_commit_light(
+                    self._chain_id,
+                    lb.validators,
+                    lb.signed_header.commit.block_id,
+                    h,
+                    lb.signed_header.commit,
+                )
+            except ValueError as e:
+                raise SyncError(f"backfill: bad commit at height {h}: {e}") from e
             self._block_store.save_signed_header(
                 lb.signed_header, current.signed_header.header.last_block_id
             )

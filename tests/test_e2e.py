@@ -42,3 +42,37 @@ class TestE2E:
             assert found >= 1
         finally:
             net.stop()
+
+
+@pytest.mark.slow
+class TestE2EMisbehavior:
+    def test_double_sign_manifest_and_validator_rotation(self):
+        """runner misbehaviors + validator_test.go rotation: a manifest
+        double-prevote node's evidence is committed to a block, and a
+        kvstore val-update tx rotates voting power on every node."""
+        manifest = Manifest(
+            chain_id="e2e-byz",
+            nodes=[
+                NodeManifest(name="val0", power=10),
+                NodeManifest(name="val1", power=10),
+                NodeManifest(name="val2", power=10),
+                NodeManifest(name="byz0", power=1, misbehave="double-prevote"),
+            ],
+            load_tx_count=0,
+            wait_blocks=3,
+        )
+        net = Testnet(manifest)
+        net.setup()
+        net.start()
+        try:
+            net.wait_for_height(2, timeout=90)
+            found = net.check_evidence_committed(timeout=60)
+            assert found["evidence"], found
+            ev = found["evidence"][0]
+            assert ev["type"] == "tendermint/DuplicateVoteEvidence", ev
+            # validator rotation: bump val2's power via the app
+            net.rotate_validator_power("val2", 14)
+            net.check_validator_rotation("val2", 14, timeout=60)
+            net.check_invariants()
+        finally:
+            net.stop()

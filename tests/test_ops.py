@@ -212,11 +212,18 @@ class TestFreshImportUnderTrace:
             "assert bool(res[0]), 'signature must verify'\n"
             "print('OK')\n"
         )
+        env = dict(os.environ)
+        # must be scrubbed at SPAWN time: the axon sitecustomize dials the
+        # TPU relay at interpreter start (before the -c code runs), and a
+        # busy/hung relay would hang this CPU-only child at import
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
         out = subprocess.run(
             [sys.executable, "-c", code],
             capture_output=True,
             text=True,
             timeout=300,
+            env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
         assert out.returncode == 0, out.stderr[-2000:]

@@ -1,5 +1,7 @@
 """sr25519 (schnorrkel/ristretto255) — reference crypto/sr25519 parity."""
 
+import os
+
 import pytest
 
 from tendermint_tpu.crypto import _ristretto as R
@@ -81,3 +83,127 @@ class TestSr25519:
     def test_address(self):
         pk = sr25519.gen_priv_key(bytes([4]) * 32).pub_key()
         assert len(pk.address()) == 20
+
+
+class TestNativeMerlin:
+    """native/tm_native.cpp sr25519_challenges must match the pure-Python
+    merlin transcript bit-for-bit (the host half of the device lane)."""
+
+    def test_challenges_match_pure_python(self):
+        from tendermint_tpu.crypto.sr25519 import (
+            SIGNING_CTX,
+            _signing_transcript,
+            gen_priv_key,
+        )
+        from tendermint_tpu.native import load
+
+        nat = load()
+        if nat is None:
+            import pytest
+
+            pytest.skip("no native toolchain")
+        sk = gen_priv_key(b"\x31" * 32)
+        pub = sk.pub_key().bytes()
+        msgs, rss, want = [], [], []
+        for i in range(6):
+            msg = b"nm-%d" % i + b"y" * (i * 13 % 50)
+            sig = sk.sign(msg)
+            t = _signing_transcript(msg)
+            t.append_message(b"proto-name", b"Schnorr-sig")
+            t.append_message(b"sign:pk", pub)
+            t.append_message(b"sign:R", sig[:32])
+            want.append(t.challenge_bytes(b"sign:c", 64))
+            msgs.append(msg)
+            rss.append(sig[:32])
+        got = nat.sr25519_challenges(
+            SIGNING_CTX, pub * len(msgs), b"".join(rss), msgs
+        )
+        assert all(
+            got[64 * i : 64 * (i + 1)] == want[i] for i in range(len(msgs))
+        )
+
+
+class TestSr25519Prep:
+    def test_prepare_flags(self):
+        from tendermint_tpu.crypto.sr25519 import gen_priv_key
+        from tendermint_tpu.ops.pallas_sr25519 import prepare_sr25519
+
+        sk = gen_priv_key(b"\x32" * 32)
+        msg = b"prep"
+        sig = sk.sign(msg)
+        pub = sk.pub_key().bytes()
+        entries = [
+            (pub, msg, sig),
+            (pub, msg, sig[:63] + bytes([sig[63] & 0x7F])),  # no v1 marker
+            (
+                pub,
+                msg,
+                sig[:32]
+                + bytes(
+                    b | (0x80 if i == 31 else 0)
+                    for i, b in enumerate(
+                        __import__(
+                            "tendermint_tpu.crypto._edwards", fromlist=["L"]
+                        ).L.__add__(1).to_bytes(32, "little")
+                    )
+                ),
+            ),  # s = L + 1
+            (b"\xff" * 32, msg, sig),  # non-canonical A encoding
+        ]
+        a_t, r_t, s_t, k_t, aok, rok, sok = prepare_sr25519(entries, 8)
+        assert sok[0, 0] == 1 and aok[0, 0] == 1 and rok[0, 0] == 1
+        assert sok[0, 1] == 0  # missing marker
+        assert sok[0, 2] == 0  # s >= L
+        assert aok[0, 3] == 0  # A >= p
+        # padding lanes admissible
+        assert sok[0, 4:].all() and aok[0, 4:].all() and rok[0, 4:].all()
+        # s had the marker stripped
+        assert s_t[31, 0] == sig[63] & 0x7F
+
+    def test_mixed_dispatch_host_lanes(self):
+        """verify_mixed partitions by key type and agrees with per-curve
+        verification (device lanes off -> host paths)."""
+        import os
+
+        from tendermint_tpu.crypto import ed25519, secp256k1, sr25519
+        from tendermint_tpu.ops import backend, mixed
+
+        backend._use_pallas.cache_clear()
+        os.environ["TM_TPU_PALLAS"] = "0"
+        try:
+            entries = []
+            ed = ed25519.gen_priv_key(b"\x33" * 32)
+            entries.append((ed.pub_key(), b"m1", ed.sign(b"m1")))
+            sr = sr25519.gen_priv_key(b"\x34" * 32)
+            entries.append((sr.pub_key(), b"m2", sr.sign(b"m2")))
+            sc = secp256k1.gen_priv_key()
+            entries.append((sc.pub_key(), b"m3", sc.sign(b"m3")))
+            bad = sr.sign(b"m4")
+            entries.append((sr.pub_key(), b"tampered", bad))
+            res = mixed.verify_mixed(entries)
+            assert res == [True, True, True, False]
+        finally:
+            del os.environ["TM_TPU_PALLAS"]
+            backend._use_pallas.cache_clear()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("TM_TPU_SR_INTERPRET"),
+    reason="sr25519 pallas interpret differential takes ~3 min of XLA "
+    "compile (set TM_TPU_SR_INTERPRET=1 to run; validated in round 3)",
+)
+class TestSr25519DeviceLane:
+    def test_interpret_differential(self):
+        from tendermint_tpu.crypto import sr25519
+        from tendermint_tpu.ops import pallas_sr25519 as ps
+
+        sk = sr25519.gen_priv_key(b"\x01" * 32)
+        msg = b"m"
+        sig = sk.sign(msg)
+        pub = sk.pub_key().bytes()
+        entries = [(pub, msg, sig), (pub, b"bad", sig)]
+        expect = [sr25519.verify(p, m, s) for p, m, s in entries]
+        args = ps.prepare_sr25519(entries, 8)
+        res = ps.verify_sr25519_compact(*args, block=8, interpret=True)
+        assert res[:2].tolist() == expect
+        assert res[2:].all(), "padding lanes (ristretto identity) must verify"

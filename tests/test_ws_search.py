@@ -114,3 +114,42 @@ class TestTxSearch:
         assert int(hits2["total_count"]) >= 1
         blocks = rpc.call("block_search", query=f"block.height='{height}'")
         assert int(blocks["total_count"]) >= 1
+
+
+class TestQueryGrammar:
+    """pubsub query grammar parity (libs/pubsub/query/query.peg):
+    EXISTS / CONTAINS / ordering comparisons through the kv sink search,
+    not just equality."""
+
+    def _sink(self):
+        from tendermint_tpu.db import MemDB
+        from tendermint_tpu.indexer import KVSink
+
+        class _R:
+            code = 0
+            data = b""
+            log = ""
+            gas_wanted = 0
+            gas_used = 0
+
+        sink = KVSink(MemDB())
+        sink.index_tx(5, 0, b"tx-a", _R(), {"transfer.amount": ["100"], "transfer.to": ["alice-addr"]})
+        sink.index_tx(6, 0, b"tx-b", _R(), {"transfer.amount": ["250"], "transfer.to": ["bob-addr"]})
+        sink.index_tx(7, 0, b"tx-c", _R(), {"mint.amount": ["9"]})
+        return sink
+
+    def test_exists(self):
+        sink = self._sink()
+        out = sink.search_txs("transfer.amount EXISTS")
+        assert {r["height"] for r in out} == {5, 6}
+
+    def test_contains(self):
+        sink = self._sink()
+        out = sink.search_txs("transfer.to CONTAINS 'bob'")
+        assert [r["height"] for r in out] == [6]
+
+    def test_ordering_comparisons(self):
+        sink = self._sink()
+        assert [r["height"] for r in sink.search_txs("transfer.amount > 150")] == [6]
+        assert [r["height"] for r in sink.search_txs("transfer.amount <= 100")] == [5]
+        assert [r["height"] for r in sink.search_txs("tx.height >= 6 AND transfer.amount EXISTS")] == [6]
