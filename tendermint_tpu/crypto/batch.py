@@ -58,7 +58,9 @@ def create_batch_verifier(pk: PubKey) -> Optional[BatchVerifier]:
         if _device_verifier_factory is not None:
             return _device_verifier_factory()
         return Ed25519HostBatchVerifier()
-    if pk.type() == "sr25519":
+    from . import sr25519 as _sr25519
+
+    if pk.type() == _sr25519.KEY_TYPE:
         from ..ops.mixed import Sr25519DeviceBatchVerifier
 
         return Sr25519DeviceBatchVerifier()
@@ -70,4 +72,6 @@ def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
     """crypto/batch/batch.go:26-33."""
     if pk is None:
         return False
-    return pk.type() in (_ed25519.KEY_TYPE, "sr25519")
+    from . import sr25519 as _sr25519
+
+    return pk.type() in (_ed25519.KEY_TYPE, _sr25519.KEY_TYPE)

@@ -261,13 +261,15 @@ class Testnet:
             rn for rn in self.nodes.values() if not rn.manifest.misbehave
         )
         deadline = _t.time() + timeout
+        scanned = 0  # evidence can't appear retroactively in old heights
         while _t.time() < deadline:
             tip = int(honest.rpc.status()["sync_info"]["latest_block_height"])
-            for h in range(1, tip + 1):
+            for h in range(scanned + 1, tip + 1):
                 blk = honest.rpc.block(h)
                 ev = blk["block"].get("evidence", {}).get("evidence") or []
                 if ev:
                     return {"height": h, "evidence": ev}
+            scanned = tip
             _t.sleep(0.3)
         raise AssertionError("no evidence committed within timeout")
 

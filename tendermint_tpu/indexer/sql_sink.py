@@ -1,0 +1,157 @@
+"""SQL event sink — the psql sink re-designed over DB-API.
+
+Reference parity: internal/state/indexer/sink/psql/ (psql.go + schema.sql)
+— blocks / tx_results / events / attributes tables with the
+`event_attributes` convenience view semantics. Instead of binding to one
+driver, this sink takes ANY DB-API 2.0 connection factory: `psycopg2`
+against a real PostgreSQL in production, stdlib `sqlite3` in tests and
+single-node deployments (the schema below is written in the dialect
+subset both accept).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+from . import Sink
+
+_SCHEMA = [
+    """CREATE TABLE IF NOT EXISTS blocks (
+        rowid      INTEGER PRIMARY KEY,
+        height     BIGINT NOT NULL,
+        chain_id   VARCHAR NOT NULL,
+        created_at VARCHAR NOT NULL,
+        UNIQUE (height, chain_id)
+    )""",
+    """CREATE TABLE IF NOT EXISTS tx_results (
+        rowid      INTEGER PRIMARY KEY,
+        block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+        tx_index   INTEGER NOT NULL,
+        created_at VARCHAR NOT NULL,
+        tx_hash    VARCHAR NOT NULL,
+        tx_result  BLOB NOT NULL,
+        UNIQUE (block_id, tx_index)
+    )""",
+    """CREATE TABLE IF NOT EXISTS events (
+        rowid    INTEGER PRIMARY KEY,
+        block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+        tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+        type     VARCHAR NOT NULL
+    )""",
+    """CREATE TABLE IF NOT EXISTS attributes (
+        event_id  BIGINT NOT NULL REFERENCES events(rowid),
+        key       VARCHAR NOT NULL,
+        composite_key VARCHAR NOT NULL,
+        value     VARCHAR NULL
+    )""",
+    "CREATE INDEX IF NOT EXISTS idx_blocks_height_chain ON blocks(height, chain_id)",
+    "CREATE INDEX IF NOT EXISTS idx_attributes_composite ON attributes(composite_key, value)",
+]
+
+
+def _utc() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class SQLSink(Sink):
+    """psql.EventSink analog over a DB-API connection."""
+
+    def __init__(self, connect: Callable, chain_id: str):
+        self._conn = connect() if callable(connect) else connect
+        self._chain_id = chain_id
+        self._mtx = threading.Lock()
+        cur = self._conn.cursor()
+        for stmt in _SCHEMA:
+            cur.execute(stmt)
+        self._conn.commit()
+
+    # -- helpers ---------------------------------------------------------
+
+    def _block_rowid(self, cur, height: int) -> int:
+        cur.execute(
+            "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
+            (height, self._chain_id),
+        )
+        row = cur.fetchone()
+        if row:
+            return row[0]
+        cur.execute(
+            "INSERT INTO blocks (height, chain_id, created_at) VALUES (?, ?, ?)",
+            (height, self._chain_id, _utc()),
+        )
+        return cur.lastrowid
+
+    def _insert_events(self, cur, block_id: int, tx_id, events: Dict[str, List[str]]):
+        """events come pre-flattened as {"type.attr": [values]} (the
+        eventbus composite-key form); split back into type/key rows like
+        psql.go insertEvents."""
+        for composite, values in events.items():
+            etype, _, key = composite.partition(".")
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) VALUES (?, ?, ?)",
+                (block_id, tx_id, etype),
+            )
+            event_id = cur.lastrowid
+            for v in values:
+                cur.execute(
+                    "INSERT INTO attributes (event_id, key, composite_key, value)"
+                    " VALUES (?, ?, ?, ?)",
+                    (event_id, key, composite, v),
+                )
+
+    # -- Sink interface ---------------------------------------------------
+
+    def index_block(self, height: int, events: Dict[str, List[str]]) -> None:
+        with self._mtx:
+            cur = self._conn.cursor()
+            block_id = self._block_rowid(cur, height)
+            self._insert_events(cur, block_id, None, events)
+            self._conn.commit()
+
+    def index_tx(self, height: int, index: int, tx: bytes, result, events) -> None:
+        from ..types.tx import tx_hash
+
+        with self._mtx:
+            cur = self._conn.cursor()
+            block_id = self._block_rowid(cur, height)
+            cur.execute(
+                "SELECT rowid FROM tx_results WHERE block_id = ? AND tx_index = ?",
+                (block_id, index),
+            )
+            if cur.fetchone():
+                self._conn.commit()
+                return
+            cur.execute(
+                "INSERT INTO tx_results (block_id, tx_index, created_at, tx_hash,"
+                " tx_result) VALUES (?, ?, ?, ?, ?)",
+                (block_id, index, _utc(), tx_hash(tx).hex().upper(), tx),
+            )
+            tx_id = cur.lastrowid
+            self._insert_events(cur, block_id, tx_id, events)
+            self._conn.commit()
+
+    # -- queries (psql has none server-side; these aid tests/tools) -------
+
+    def tx_count(self) -> int:
+        with self._mtx:
+            cur = self._conn.cursor()
+            cur.execute("SELECT COUNT(*) FROM tx_results")
+            return cur.fetchone()[0]
+
+    def find_tx_hashes_by_event(self, composite_key: str, value: str) -> List[str]:
+        with self._mtx:
+            cur = self._conn.cursor()
+            cur.execute(
+                "SELECT DISTINCT t.tx_hash FROM tx_results t"
+                " JOIN events e ON e.tx_id = t.rowid"
+                " JOIN attributes a ON a.event_id = e.rowid"
+                " WHERE a.composite_key = ? AND a.value = ?",
+                (composite_key, value),
+            )
+            return [r[0] for r in cur.fetchall()]
+
+    def close(self) -> None:
+        with self._mtx:
+            self._conn.close()

@@ -32,8 +32,13 @@ SR_DEVICE_THRESHOLD = int(os.environ.get("TM_TPU_SR_DEVICE_THRESHOLD", "8"))
 # First device call (the Mosaic compile) is time-boxed: a pathologically
 # slow or hung remote compile must not wedge the caller — on timeout the
 # process permanently falls back to the host path for sr25519.
-SR_COMPILE_TIMEOUT = float(os.environ.get("TM_TPU_SR_COMPILE_TIMEOUT", "300"))
 _sr_device_state = {"ok": None}  # None = untried, True/False decided
+
+
+def _sr_compile_timeout() -> float:
+    """Read at call time so bench.py can tighten the budget after this
+    module is already imported."""
+    return float(os.environ.get("TM_TPU_SR_COMPILE_TIMEOUT", "300"))
 
 
 def _host_sr_batch(entries) -> np.ndarray:
@@ -81,7 +86,7 @@ def _verify_sr25519_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarr
 
     t = threading.Thread(target=attempt, daemon=True)
     t.start()
-    t.join(SR_COMPILE_TIMEOUT)
+    t.join(_sr_compile_timeout())
     if "res" in holder:
         _sr_device_state["ok"] = True
         return holder["res"]

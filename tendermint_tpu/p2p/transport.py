@@ -73,6 +73,8 @@ class _SockStream:
 class MConnConnection(Connection):
     """transport_mconn.go MConnConnection."""
 
+    _cls_close_mtx = threading.Lock()
+
     def __init__(
         self,
         sock: socket.socket,
@@ -122,13 +124,53 @@ class MConnConnection(Connection):
             raise ConnectionError(str(self._err) if self._err else "connection closed")
         return ch, msg
 
+    # set by the accepting transport to release its ConnTracker slot
+    on_close = None
+
     def close(self) -> None:
         self._mconn.stop()
+        # atomic single-shot release: concurrent close() (router error path
+        # vs node shutdown) must not double-decrement the ConnTracker
+        with MConnConnection._cls_close_mtx:
+            cb, self.on_close = self.on_close, None
+        if cb is not None:
+            cb()
         # wake any blocked receiver so the router drops this peer promptly
         try:
             self._recv_q.put_nowait((-1, b""))
         except queue.Full:
             pass
+
+
+class ConnTracker:
+    """internal/p2p/conn_tracker.go: caps concurrent inbound connections
+    per source IP (anti-monopolization) — AddConn refuses above the
+    per-IP limit; RemoveConn on close."""
+
+    def __init__(self, max_per_ip: int = 8):
+        self._max = max_per_ip
+        self._mtx = threading.Lock()
+        self._by_ip: dict = {}
+
+    def add(self, ip: str) -> bool:
+        with self._mtx:
+            n = self._by_ip.get(ip, 0)
+            if n >= self._max:
+                return False
+            self._by_ip[ip] = n + 1
+            return True
+
+    def remove(self, ip: str) -> None:
+        with self._mtx:
+            n = self._by_ip.get(ip, 0)
+            if n <= 1:
+                self._by_ip.pop(ip, None)
+            else:
+                self._by_ip[ip] = n - 1
+
+    def count(self, ip: str) -> int:
+        with self._mtx:
+            return self._by_ip.get(ip, 0)
 
 
 class MConnTransport:
@@ -139,6 +181,7 @@ class MConnTransport:
         local_priv: PrivKey,
         channel_descs: List[ChannelDescriptor],
         node_info=None,
+        max_conns_per_ip: int = 8,
     ):
         self._priv = local_priv
         self._descs = channel_descs
@@ -147,6 +190,7 @@ class MConnTransport:
         self._accept_q: "queue.Queue[MConnConnection]" = queue.Queue(maxsize=64)
         self._closed = False
         self.listen_addr: str = ""
+        self._tracker = ConnTracker(max_conns_per_ip)
 
     def listen(self, addr: str) -> None:
         host, _, port = addr.rpartition(":")
@@ -161,18 +205,28 @@ class MConnTransport:
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
-                sock, _ = self._listener.accept()
+                sock, addr = self._listener.accept()
             except OSError:
                 return
+            ip = addr[0] if addr else ""
+            if not self._tracker.add(ip):
+                # conn_tracker.go: per-IP inbound cap exceeded
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             threading.Thread(
-                target=self._handshake_accepted, args=(sock,), daemon=True
+                target=self._handshake_accepted, args=(sock, ip), daemon=True
             ).start()
 
-    def _handshake_accepted(self, sock: socket.socket) -> None:
+    def _handshake_accepted(self, sock: socket.socket, ip: str) -> None:
         try:
             conn = MConnConnection(sock, self._priv, self._descs, self._node_info)
+            conn.on_close = lambda: self._tracker.remove(ip)
             self._accept_q.put(conn)
         except Exception:  # noqa: BLE001 — failed handshakes are dropped
+            self._tracker.remove(ip)
             try:
                 sock.close()
             except OSError:

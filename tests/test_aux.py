@@ -201,3 +201,44 @@ class TestCLI:
         open(marker, "w").write("x")
         assert main(["--home", home, "unsafe-reset-all"]) == 0
         assert not os.path.exists(marker)
+
+
+class TestSQLSink:
+    """psql sink parity (internal/state/indexer/sink/psql + schema.sql)
+    over DB-API — exercised here on sqlite3; production plugs a psycopg2
+    connection factory."""
+
+    def _sink(self):
+        import sqlite3
+
+        from tendermint_tpu.indexer.sql_sink import SQLSink
+
+        return SQLSink(lambda: sqlite3.connect(":memory:"), "sql-chain")
+
+    def test_blocks_txs_events_roundtrip(self):
+        sink = self._sink()
+        sink.index_block(1, {"block.proposer": ["aa"]})
+
+        class _R:
+            code = 0
+
+        sink.index_tx(1, 0, b"tx-1", _R(), {"transfer.to": ["alice"]})
+        sink.index_tx(1, 1, b"tx-2", _R(), {"transfer.to": ["bob"]})
+        # idempotent re-index (same block/index)
+        sink.index_tx(1, 1, b"tx-2", _R(), {"transfer.to": ["bob"]})
+        assert sink.tx_count() == 2
+        from tendermint_tpu.types.tx import tx_hash
+
+        found = sink.find_tx_hashes_by_event("transfer.to", "alice")
+        assert found == [tx_hash(b"tx-1").hex().upper()]
+        sink.close()
+
+    def test_multi_block_unique_constraint(self):
+        sink = self._sink()
+        for h in (1, 2, 3):
+            sink.index_block(h, {"k.a": [str(h)]})
+            sink.index_block(h, {"k.b": [str(h)]})  # same height, more events
+        cur = sink._conn.cursor()
+        cur.execute("SELECT COUNT(*) FROM blocks")
+        assert cur.fetchone()[0] == 3
+        sink.close()

@@ -169,6 +169,7 @@ class TestSr25519Prep:
         from tendermint_tpu.ops import backend, mixed
 
         backend._use_pallas.cache_clear()
+        prior = os.environ.get("TM_TPU_PALLAS")
         os.environ["TM_TPU_PALLAS"] = "0"
         try:
             entries = []
@@ -183,7 +184,10 @@ class TestSr25519Prep:
             res = mixed.verify_mixed(entries)
             assert res == [True, True, True, False]
         finally:
-            del os.environ["TM_TPU_PALLAS"]
+            if prior is None:
+                del os.environ["TM_TPU_PALLAS"]
+            else:
+                os.environ["TM_TPU_PALLAS"] = prior
             backend._use_pallas.cache_clear()
 
 
