@@ -45,9 +45,19 @@ def _host_sr_batch(entries) -> np.ndarray:
     return np.asarray([_sr.verify(pk, m, s) for pk, m, s in entries], dtype=bool)
 
 
+def _sr_device_enabled() -> bool:
+    """The sr25519 DEVICE lane is opt-in (TM_TPU_SR_DEVICE=1): its Mosaic
+    compile has been observed to hang the shared remote compile helper,
+    which poisons the relay for every subsequent process on the host (see
+    ops/pallas_sr25519 STATUS). The kernels are differentially validated;
+    flip the default once the toolchain compiles them."""
+    return os.environ.get("TM_TPU_SR_DEVICE", "0") == "1"
+
+
 def _verify_sr25519_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     if (
         len(entries) < SR_DEVICE_THRESHOLD
+        or not _sr_device_enabled()
         or not _backend._use_pallas()
         or _sr_device_state["ok"] is False
     ):
