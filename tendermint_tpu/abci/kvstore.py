@@ -28,12 +28,18 @@ class KVStoreApplication(BaseApplication):
 
     SNAPSHOT_CHUNK_SIZE = 65536
 
-    def __init__(self, db: Optional[DB] = None, snapshot_interval: int = 0):
+    def __init__(
+        self,
+        db: Optional[DB] = None,
+        snapshot_interval: int = 0,
+        snapshot_keep: int = 3,
+    ):
         self._db = db or MemDB()
         self._height = 0
         self._app_hash = b""
         self._size = 0
         self._snapshot_interval = snapshot_interval
+        self._snapshot_keep = max(snapshot_keep, 1)
         self._snapshots: dict = {}  # height -> (chunks: List[bytes], hash)
         self._restore_buf: list = []
         self._restoring: Optional[abci.Snapshot] = None
@@ -118,8 +124,8 @@ class KVStoreApplication(BaseApplication):
             for i in range(0, max(len(blob), 1), self.SNAPSHOT_CHUNK_SIZE)
         ] or [b""]
         self._snapshots[self._height] = (chunks, hashlib.sha256(blob).digest())
-        # keep only the 3 newest snapshots
-        for h in sorted(self._snapshots)[:-3]:
+        # bounded retention (kvstore keeps only the newest few)
+        for h in sorted(self._snapshots)[: -self._snapshot_keep]:
             del self._snapshots[h]
 
     def list_snapshots(self) -> abci.ResponseListSnapshots:

@@ -180,6 +180,11 @@ class BlockSyncReactor:
         self._on_caught_up = on_caught_up
         self._pool = BlockPool(initial_state.last_block_height + 1)
         self._stopped = threading.Event()
+        # serving (answering block/status requests) continues for the
+        # node's lifetime; CONSUMING (requesting + applying) stops when
+        # consensus takes over (node.go switchToConsensus)
+        self._consuming = threading.Event()
+        self._consuming.set()
         self._threads = []
 
     @property
@@ -199,6 +204,17 @@ class BlockSyncReactor:
     def stop(self) -> None:
         self._stopped.set()
 
+    def stop_consuming(self) -> None:
+        """Stop requesting/applying blocks; keep serving peers."""
+        self._consuming.clear()
+
+    def reset_to_state(self, state) -> None:
+        """Re-point the pool after statesync restored a later state —
+        otherwise the pool would re-request (and re-apply) from genesis
+        against an app that is already at the snapshot height."""
+        self._state = state
+        self._pool = BlockPool(state.last_block_height + 1)
+
     # -- loops ----------------------------------------------------------
 
     def _status_loop(self) -> None:
@@ -211,6 +227,9 @@ class BlockSyncReactor:
 
     def _request_loop(self) -> None:
         while not self._stopped.is_set():
+            if not self._consuming.is_set():
+                time.sleep(0.2)
+                continue
             for height, peer_id in self._pool.next_requests().items():
                 self._ch.send(peer_id, _enc(1, {1: height}))
             time.sleep(0.05)
@@ -264,6 +283,9 @@ class BlockSyncReactor:
         caught_up_reported = False
         spec = None  # (height, valset_hash, future) of a pre-verification
         while not self._stopped.is_set():
+            if not self._consuming.is_set():
+                time.sleep(0.2)
+                continue
             first, second = self._pool.peek_two_blocks()
             if first is None or second is None:
                 if (

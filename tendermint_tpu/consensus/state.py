@@ -861,6 +861,16 @@ class ConsensusState(BaseService):
     # ------------------------------------------------------------------
     # WAL replay (replay.go:96-160 catchupReplay)
 
+    def catch_up_to_state(self, state: State) -> None:
+        """node.go:323-343 switchToConsensus: adopt a state advanced by
+        statesync/blocksync BEFORE the state machine starts (safe while
+        commit_round == -1), and rebuild LastCommit from the stored seen
+        commit so proposing can resume."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("cannot catch up a running consensus state")
+        self._update_to_state(state)
+        self._reconstruct_last_commit()
+
     def _reconstruct_last_commit(self) -> None:
         """state.go:518-543 reconstructLastCommit: after a restart the
         in-memory precommit VoteSet for the last committed height is gone;

@@ -59,6 +59,7 @@ class Node:
     mempool_reactor: Optional[MempoolReactor] = None
     evidence_reactor: Optional[EvidenceReactor] = None
     blocksync_reactor: Optional[BlockSyncReactor] = None
+    statesync_reactor: object = None
     pex_reactor: object = None
     rpc_server: object = None
     proxy_app: object = None
@@ -67,22 +68,141 @@ class Node:
     _started: bool = False
 
     def start(self) -> None:
-        """OnStart (node.go:490-560)."""
+        """OnStart (node.go:490-560) + startup-mode selection
+        (node.go:217-247,323-343): statesync -> blocksync -> consensus."""
         if self.indexer_service is not None:
             self.indexer_service.start()
         if self.router is not None:
             self.router.start()
         for r in (self.mempool_reactor, self.evidence_reactor,
-                  self.consensus_reactor, self.pex_reactor):
+                  self.consensus_reactor, self.pex_reactor,
+                  self.statesync_reactor):
             if r is not None:
                 r.start()
         from ..config import MODE_SEED as _seed
 
         if self.config.base.mode != _seed:
-            self.consensus.start()
+            if self._should_state_sync():
+                threading.Thread(target=self._run_state_sync, daemon=True).start()
+            elif self._should_block_sync():
+                self._start_blocksync_then_consensus()
+            else:
+                # straight to consensus — still SERVE blocksync requests
+                # so peers can catch up from this node
+                if self.blocksync_reactor is not None:
+                    self.blocksync_reactor.stop_consuming()
+                    self.blocksync_reactor.start()
+                self.consensus.start()
         if self.rpc_server is not None:
             self.rpc_server.start()
         self._started = True
+
+    # -- startup-mode selection (node.go:217-247) ------------------------
+
+    def _should_state_sync(self) -> bool:
+        cfg = self.config.statesync
+        return bool(
+            self.statesync_reactor is not None
+            and cfg.enable
+            and cfg.trust_hash
+            and cfg.trust_height > 0
+            and self.block_store.height() == 0
+        )
+
+    def _should_block_sync(self) -> bool:
+        """Route through blocksync only when there are peers to sync from
+        (pool.is_caught_up needs at least one reporting peer; a loner
+        node must start consensus directly)."""
+        return bool(
+            self.blocksync_reactor is not None
+            and self.config.blocksync.enable
+            and self.config.p2p.persistent_peers
+        )
+
+    def _run_state_sync(self) -> None:
+        """syncer.SyncAny + backfill, then hand off (node.go:323-343).
+        ANY failure (bad trust hash, sync errors) must fall through to the
+        next startup mode — a dead daemon thread would leave the node
+        serving RPC but never progressing."""
+        from ..state import make_genesis_state
+        from ..statesync import SyncError
+
+        cfg = self.config.statesync
+        synced_state = None
+        try:
+            genesis_state = make_genesis_state(self.genesis)
+            trust_hash = cfg.trust_hash.lower().removeprefix("0x")
+            state, _commit = self.statesync_reactor.sync_any(
+                genesis_state,
+                trust_height=cfg.trust_height,
+                trust_hash=bytes.fromhex(trust_hash),
+                discovery_time=cfg.discovery_time_ms / 1000.0,
+                chunk_timeout=cfg.chunk_request_timeout_ms / 1000.0,
+            )
+            try:
+                self.statesync_reactor.backfill(state)
+            except SyncError:
+                pass  # best effort (evidence window may be unservable)
+            self.consensus.catch_up_to_state(state)
+            synced_state = state
+        except SyncError as e:
+            print(f"state sync failed: {e}; falling back", flush=True)
+        except Exception as e:  # noqa: BLE001 — e.g. malformed trust hash
+            print(f"state sync aborted: {e}; falling back", flush=True)
+        if synced_state is not None and self.blocksync_reactor is not None:
+            # re-point the pool at the restored height: re-requesting from
+            # genesis would re-apply old blocks against the restored app
+            self.blocksync_reactor.reset_to_state(synced_state)
+        if self._should_block_sync():
+            self._start_blocksync_then_consensus()
+        else:
+            if self.blocksync_reactor is not None:
+                self.blocksync_reactor.stop_consuming()
+                self.blocksync_reactor.start()
+            self.consensus.start()
+
+    def _start_blocksync_then_consensus(self) -> None:
+        """Catch up over the blocksync channel, then switch to consensus
+        when the pool reports caught-up; a watchdog switches anyway when
+        blocksync makes no progress (this node may BE the tip, or its
+        peers may be unable to serve)."""
+        switch_mtx = threading.Lock()
+        switched = threading.Event()
+
+        def switch(state) -> None:
+            # single-shot under a lock: on_caught_up and the watchdog can
+            # race at the deadline boundary
+            with switch_mtx:
+                if switched.is_set():
+                    return
+                switched.set()
+            self.blocksync_reactor.stop_consuming()
+            try:
+                self.consensus.catch_up_to_state(state)
+            except RuntimeError:
+                return  # already running (defensive)
+            self.consensus.start()
+
+        self.blocksync_reactor._on_caught_up = switch
+        self.blocksync_reactor.start()
+
+        def watchdog() -> None:
+            # refresh on PROGRESS (height advancing), not on peer
+            # presence: a stalled peer must not postpone consensus forever
+            last_height = self.block_store.height()
+            deadline = time.time() + 10.0
+            hard_deadline = time.time() + 120.0
+            while time.time() < min(deadline, hard_deadline):
+                if switched.is_set():
+                    return
+                h = self.block_store.height()
+                if h > last_height:
+                    last_height = h
+                    deadline = time.time() + 10.0
+                time.sleep(0.25)
+            switch(self.blocksync_reactor._state)
+
+        threading.Thread(target=watchdog, daemon=True).start()
 
     def stop(self) -> None:
         if self.rpc_server is not None:
@@ -92,7 +212,8 @@ class Node:
         if self.config.base.mode != _seed:
             self.consensus.stop()
         for r in (self.consensus_reactor, self.mempool_reactor,
-                  self.evidence_reactor, self.blocksync_reactor, self.pex_reactor):
+                  self.evidence_reactor, self.blocksync_reactor,
+                  self.statesync_reactor, self.pex_reactor):
             if r is not None:
                 r.stop()
         if self.router is not None:
@@ -240,6 +361,8 @@ def make_node(
                 addr = addr[len(prefix):]
         transport.listen(addr)
     pex_reactor = None
+    blocksync_reactor = None
+    statesync_reactor = None
     if transport is not None:
         pm_db = MemDB() if not home else _db("peers")
         peer_manager = PeerManager(
@@ -252,6 +375,25 @@ def make_node(
                 mempool, router, broadcast=config.mempool.broadcast
             )
             evidence_reactor = EvidenceReactor(evidence_pool, router)
+            if config.blocksync.enable:
+                blocksync_reactor = BlockSyncReactor(
+                    router, block_store, block_exec, state
+                )
+            # the statesync reactor always SERVES snapshots/light blocks/
+            # params (reactor.go runs in every full node); RESTORING via
+            # sync_any only happens when configured (Node.start)
+            from ..statesync import StateSyncReactor
+
+            if True:
+
+                statesync_reactor = StateSyncReactor(
+                    router,
+                    query_conn,
+                    state_store,
+                    block_store,
+                    genesis.chain_id,
+                    serving=True,
+                )
         if config.p2p.pex:
             from ..p2p.pex import PexReactor
 
@@ -290,6 +432,8 @@ def make_node(
         proxy_app=query_conn,
     )
     node.pex_reactor = pex_reactor
+    node.blocksync_reactor = blocksync_reactor
+    node.statesync_reactor = statesync_reactor
     node.indexer_service = indexer_service
     node.tx_index_sink = tx_index_sink
     if with_rpc and config.rpc.laddr:

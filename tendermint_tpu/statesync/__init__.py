@@ -171,11 +171,19 @@ class StateSyncReactor:
 
     # -- server side ------------------------------------------------------
 
+    RECENT_SNAPSHOTS = 10  # reactor.go recentSnapshots
+
     def _handle_snapshot_msg(self, env) -> None:
         f = decode_message(env.message)
         if 1 in f and self._serving:  # snapshots_request
             res = self._conn.list_snapshots()
-            for s in res.snapshots[:10]:
+            # NEWEST first, capped (reactor.go recentSnapshots): apps with
+            # bounded retention prune old snapshots, so advertising
+            # oldest-first steers the syncer toward soon-to-vanish ones
+            advertised = sorted(
+                res.snapshots, key=lambda s: (-s.height, s.format)
+            )[: self.RECENT_SNAPSHOTS]
+            for s in advertised:
                 self._snap_ch.send(
                     env.from_id,
                     _enc(2, {1: s.height, 2: s.format, 3: s.chunks, 4: s.hash, 5: s.metadata}),
@@ -377,25 +385,39 @@ class StateSyncReactor:
         trusted: Dict[int, LightBlock] = {trust_height: root}
 
         # 2. discover snapshots
-        deadline = time.time() + discovery_time
-        while time.time() < deadline:
-            self._snap_ch.broadcast(_enc(1))
+        # Multiple discovery rounds with a FRESH snapshot list each time
+        # (syncer.go re-discovers as peers advertise): serving apps retain
+        # only their newest snapshots, so a fast-moving chain can prune a
+        # snapshot between our discovery and the chunk fetch — stale
+        # candidates must not doom the whole sync.
+        discovered_any = False
+        failed: set = set()  # (height, format, hash) keys that already failed
+        for _round in range(3):
             with self._mtx:
-                if self._snapshots:
-                    break
-            time.sleep(0.2)
-        with self._mtx:
-            candidates = sorted(
-                self._snapshots.values(), key=lambda s: (-s.height, s.format)
-            )
-        if not candidates:
+                self._snapshots.clear()
+            # wait the FULL discovery window (syncer.go waits
+            # discoveryTime): grabbing the first response would bias
+            # toward whatever snapshot message lands first, not the best
+            deadline = time.time() + discovery_time
+            while time.time() < deadline:
+                self._snap_ch.broadcast(_enc(1))
+                time.sleep(min(0.2, max(deadline - time.time(), 0.01)))
+            with self._mtx:
+                candidates = sorted(
+                    self._snapshots.values(), key=lambda s: (-s.height, s.format)
+                )
+            discovered_any = discovered_any or bool(candidates)
+            fresh = [c for c in candidates if c.key() not in failed]
+            if not fresh:
+                break  # only known-bad snapshots left: re-trying won't help
+            for snap in fresh:
+                try:
+                    return self._sync_one(genesis_state, snap, chunk_timeout, trusted)
+                except SyncError:
+                    failed.add(snap.key())
+                    continue
+        if not discovered_any:
             raise SyncError("no snapshots discovered")
-
-        for snap in candidates:
-            try:
-                return self._sync_one(genesis_state, snap, chunk_timeout, trusted)
-            except SyncError:
-                continue
         raise SyncError("all discovered snapshots failed")
 
     def _verified_light_block(

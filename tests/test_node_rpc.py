@@ -159,3 +159,110 @@ class TestHandshakeReplay:
         # the replayed app has the tx
         q = conn.query(abci_t.RequestQuery(data=b"persist", path="/key"))
         assert q.value == b"1"
+
+
+class TestNodeStartupModes:
+    """node.go:217-247,323-343 startup-mode selection: a fresh node with
+    statesync configured restores from a peer's snapshot, backfills, and
+    switches to consensus; blocksync hands off to consensus when caught
+    up (covered via TCP e2e in test_e2e_proc)."""
+
+    def test_statesync_node_restores_and_joins(self):
+        import time
+
+        from tendermint_tpu.abci import KVStoreApplication
+        from tendermint_tpu.config import Config
+        from tendermint_tpu.consensus.state import ConsensusState  # noqa: F401
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.node import make_node
+        from tendermint_tpu.p2p import MemoryTransport, NodeKey, PeerAddress, new_memory_network
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tendermint_tpu.wire.canonical import Timestamp
+
+        hub = new_memory_network()
+        sk = ed25519.gen_priv_key(bytes([77]) * 32)
+        doc = GenesisDoc(
+            chain_id="ss-node-chain",
+            genesis_time=Timestamp(seconds=1_700_000_000),
+            validators=[GenesisValidator(address=b"", pub_key=sk.pub_key(), power=10)],
+        )
+
+        def node_cfg():
+            cfg = Config()
+            cfg.base.home = ""
+            cfg.base.db_backend = "memdb"
+            from tests.test_consensus import FAST
+
+            cfg.consensus = FAST
+            cfg.p2p.laddr = ""
+            cfg.rpc.laddr = ""
+            return cfg
+
+        # validator node producing snapshots
+        nk_a = NodeKey.generate(bytes([78]) * 32)
+        from tendermint_tpu.privval import FilePV
+
+        node_a = make_node(
+            node_cfg(),
+            # generous retention: the FAST test chain outruns the default
+            # keep-3 window before the syncing node can fetch chunks
+            app=KVStoreApplication(snapshot_interval=2, snapshot_keep=100),
+            genesis=doc,
+            priv_validator=FilePV(sk),
+            node_key=nk_a,
+            transport=MemoryTransport(hub, nk_a.node_id, nk_a.pub_key),
+        )
+        node_a.start()
+        try:
+            node_a.wait_for_height(6, timeout=60)
+            # trust root: a snapshot height the serving node can prove
+            snaps = node_a.proxy_app.list_snapshots().snapshots
+            assert snaps
+            snap_h = max(
+                s.height for s in snaps
+                if s.height + 2 <= node_a.block_store.height()
+            )
+            trust = node_a.statesync_reactor._load_local_light_block(snap_h)
+
+            # fresh statesyncing node
+            nk_b = NodeKey.generate(bytes([79]) * 32)
+            cfg_b = node_cfg()
+            cfg_b.statesync.enable = True
+            cfg_b.statesync.trust_height = snap_h
+            cfg_b.statesync.trust_hash = trust.hash().hex()
+            cfg_b.statesync.discovery_time_ms = 1500
+            node_b = make_node(
+                cfg_b,
+                app=KVStoreApplication(),
+                genesis=doc,
+                node_key=nk_b,
+                transport=MemoryTransport(hub, nk_b.node_id, nk_b.pub_key),
+            )
+            node_b.router._pm.add_address(PeerAddress(nk_a.node_id, nk_a.node_id))
+            node_a.router._pm.add_address(PeerAddress(nk_b.node_id, nk_b.node_id))
+            node_b.start()
+            try:
+                deadline = time.time() + 90
+                while time.time() < deadline:
+                    if node_b.consensus.committed_state.last_block_height > snap_h:
+                        break
+                    time.sleep(0.2)
+                st = node_b.consensus.committed_state
+                assert st.last_block_height >= snap_h, (
+                    st.last_block_height, snap_h
+                )
+                # discriminate REAL statesync from a consensus-catchup
+                # fallback: only the sync path plants the params
+                # checkpoint at the restored snapshot height (the syncer
+                # picks the NEWEST advertised snapshot, at/above snap_h)
+                restored_h = st.last_height_consensus_params_changed
+                assert restored_h >= snap_h, (
+                    "node fell back to consensus catchup instead of "
+                    "restoring a snapshot"
+                )
+                # the restored header was planted in the block store
+                assert node_b.block_store.load_block_meta(restored_h) is not None
+            finally:
+                node_b.stop()
+        finally:
+            node_a.stop()
