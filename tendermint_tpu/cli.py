@@ -402,6 +402,41 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_signer_harness(args) -> int:
+    """signer-harness (tools/tm-signer-harness): run the conformance
+    battery against a remote signer (gRPC address or socket listen
+    address) or a local FilePV key file."""
+    from .tools.signer_harness import run_harness
+
+    expected = None
+    if args.expect_key_file:
+        from .privval import FilePV
+
+        pv = FilePV.load(args.expect_key_file, args.expect_key_file + ".state")
+        expected = pv.get_pub_key()
+    if args.grpc:
+        from .privval.grpc import GRPCSignerClient
+
+        signer = GRPCSignerClient(args.grpc)
+    elif args.listen:
+        from .privval.remote import SignerClient
+
+        print(f"waiting for the signer to dial {args.listen} ...", flush=True)
+        signer = SignerClient(args.listen)
+    else:
+        from .privval import FilePV
+
+        if not args.key_file:
+            print("one of --grpc, --listen or --key-file is required", file=sys.stderr)
+            return 2
+        signer = FilePV.load(args.key_file, args.key_file + ".state")
+    rep = run_harness(signer, chain_id=args.chain_id, expected_pub_key=expected)
+    for r in rep.results:
+        print(f"{'PASS' if r.ok else 'FAIL'}  {r.name}" + (f"  ({r.detail})" if r.detail else ""))
+    print("OVERALL:", "PASS" if rep.passed else "FAIL")
+    return 0 if rep.passed else 1
+
+
 def cmd_rollback(args) -> int:
     from .db import backend as db_backend
     from .state.rollback import rollback_state
@@ -489,6 +524,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trusted-hash", default="")
     sp.add_argument("--trusting-period", default=str(14 * 24 * 3600))
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp = sub.add_parser("signer-harness")
+    sp.add_argument("--grpc", default="", help="gRPC signer address")
+    sp.add_argument("--listen", default="", help="listen addr a socket signer dials")
+    sp.add_argument("--key-file", default="", help="local FilePV key file")
+    sp.add_argument("--expect-key-file", default="")
+    sp.add_argument("--chain-id", default="signer-harness")
     sub.add_parser("rollback")
     sub.add_parser("inspect")
     sub.add_parser("unsafe-reset-all")
@@ -509,6 +550,7 @@ COMMANDS = {
     "key-migrate": cmd_key_migrate,
     "reindex-event": cmd_reindex_event,
     "light": cmd_light,
+    "signer-harness": cmd_signer_harness,
     "rollback": cmd_rollback,
     "inspect": cmd_inspect,
     "unsafe-reset-all": cmd_reset_unsafe,
