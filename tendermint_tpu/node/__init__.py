@@ -66,6 +66,7 @@ class Node:
     indexer_service: object = None
     tx_index_sink: object = None
     _started: bool = False
+    _stopping: threading.Event = field(default_factory=threading.Event)
 
     def start(self) -> None:
         """OnStart (node.go:490-560) + startup-mode selection
@@ -129,6 +130,8 @@ class Node:
 
         cfg = self.config.statesync
         synced_state = None
+        if self._stopping.is_set():
+            return
         try:
             genesis_state = make_genesis_state(self.genesis)
             trust_hash = cfg.trust_hash.lower().removeprefix("0x")
@@ -153,6 +156,8 @@ class Node:
             # re-point the pool at the restored height: re-requesting from
             # genesis would re-apply old blocks against the restored app
             self.blocksync_reactor.reset_to_state(synced_state)
+        if self._stopping.is_set():
+            return
         if self._should_block_sync():
             self._start_blocksync_then_consensus()
         else:
@@ -171,9 +176,10 @@ class Node:
 
         def switch(state) -> None:
             # single-shot under a lock: on_caught_up and the watchdog can
-            # race at the deadline boundary
+            # race at the deadline boundary; a stopped node must never be
+            # resurrected by a late handoff
             with switch_mtx:
-                if switched.is_set():
+                if switched.is_set() or self._stopping.is_set():
                     return
                 switched.set()
             self.blocksync_reactor.stop_consuming()
@@ -193,7 +199,7 @@ class Node:
             deadline = time.time() + 10.0
             hard_deadline = time.time() + 120.0
             while time.time() < min(deadline, hard_deadline):
-                if switched.is_set():
+                if switched.is_set() or self._stopping.is_set():
                     return
                 h = self.block_store.height()
                 if h > last_height:
@@ -205,6 +211,7 @@ class Node:
         threading.Thread(target=watchdog, daemon=True).start()
 
     def stop(self) -> None:
+        self._stopping.set()  # cancels pending startup-mode handoffs
         if self.rpc_server is not None:
             self.rpc_server.stop()
         from ..config import MODE_SEED as _seed
