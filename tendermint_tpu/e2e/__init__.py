@@ -167,14 +167,37 @@ class Testnet:
     # -- run (runner: Start/Load/Perturb/Wait) ----------------------------
 
     def start(self) -> None:
+        """Start every node with start_at == 0; late joiners (start_at > 0,
+        runner/start.go wait-then-start) are launched by start_late_joiners
+        once the network reaches their height and catch up via blocksync."""
         for rn in self.nodes.values():
+            if rn.manifest.start_at == 0:
+                rn.node.start()
+                rn.rpc = HTTPClient(rn.node.rpc_server.listen_addr)
+
+    def start_late_joiners(self, timeout: float = 60.0) -> None:
+        pending = [rn for rn in self.nodes.values() if rn.rpc is None]
+        for rn in sorted(pending, key=lambda r: r.manifest.start_at):
+            # wait on any node that is actually running (the first pick may
+            # have been killed by a prior perturb()); with none running the
+            # joiner starts immediately and produces/syncs on its own
+            gate = next(
+                (
+                    o
+                    for o in self.nodes.values()
+                    if o.rpc is not None and "kill" not in o.manifest.perturb
+                ),
+                None,
+            )
+            if gate is not None and rn.manifest.start_at > 0:
+                gate.node.wait_for_height(rn.manifest.start_at, timeout=timeout)
             rn.node.start()
             rn.rpc = HTTPClient(rn.node.rpc_server.listen_addr)
 
     def load_transactions(self) -> List[bytes]:
         """runner/load.go: submit load via RPC round-robin."""
         txs = []
-        rns = list(self.nodes.values())
+        rns = [rn for rn in self.nodes.values() if rn.rpc is not None]
         for i in range(self.manifest.load_tx_count):
             tx = f"load-{i}=v{i}".encode()
             rn = rns[i % len(rns)]
@@ -211,8 +234,7 @@ class Testnet:
 
     def wait_for_height(self, height: int, timeout: float = 120.0) -> None:
         deadline = time.time() + timeout
-        live = [rn for rn in self.nodes.values() if "kill" not in rn.manifest.perturb]
-        for rn in live:
+        for rn in self._live():
             remaining = max(deadline - time.time(), 0.1)
             rn.node.wait_for_height(height, timeout=remaining)
 
@@ -223,12 +245,17 @@ class Testnet:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _live(self):
+        return [
+            rn
+            for rn in self.nodes.values()
+            if rn.rpc is not None and "kill" not in rn.manifest.perturb
+        ]
+
     # -- invariants (test/e2e/tests, RPC-only black box) -------------------
 
     def check_invariants(self) -> None:
-        live = [
-            rn for rn in self.nodes.values() if "kill" not in rn.manifest.perturb
-        ]
+        live = self._live()
         heights = {}
         for rn in live:
             st = rn.rpc.status()
@@ -236,7 +263,8 @@ class Testnet:
         min_h = min(heights.values())
         # block_test.go: all nodes agree on every height up to min
         reference_hashes = {}
-        for h in range(1, min_h + 1):
+        first = self.manifest.initial_height
+        for h in range(first, min_h + 1):
             for rn in live:
                 blk = rn.rpc.block(h)
                 bh = blk["block_id"]["hash"]
@@ -247,9 +275,9 @@ class Testnet:
                 else:
                     reference_hashes[h] = bh
         # validator_test.go: validator sets consistent
-        vals0 = live[0].rpc.validators(1)
+        vals0 = live[0].rpc.validators(first)
         for rn in live[1:]:
-            assert rn.rpc.validators(1) == vals0
+            assert rn.rpc.validators(first) == vals0
 
     def check_evidence_committed(self, timeout: float = 30.0) -> dict:
         """evidence_test.go: with a misbehaving node in the manifest, some
@@ -257,9 +285,7 @@ class Testnet:
         import time as _t
 
         assert any(m.misbehave for m in self.manifest.nodes), "no misbehavior configured"
-        honest = next(
-            rn for rn in self.nodes.values() if not rn.manifest.misbehave
-        )
+        honest = next(rn for rn in self._live() if not rn.manifest.misbehave)
         deadline = _t.time() + timeout
         scanned = 0  # evidence can't appear retroactively in old heights
         while _t.time() < deadline:
@@ -281,7 +307,7 @@ class Testnet:
         rn = self.nodes[name]
         pub = rn.sk.pub_key().bytes()
         tx = b"val:" + _b64.b64encode(pub) + b"!" + str(power).encode()
-        next(iter(self.nodes.values())).rpc.broadcast_tx_sync(tx)
+        self._live()[0].rpc.broadcast_tx_sync(tx)
 
     def check_validator_rotation(self, name: str, power: int, timeout: float = 30.0) -> None:
         """After rotate_validator_power, every live node's validator set
@@ -302,11 +328,11 @@ class Testnet:
 
     def benchmark(self) -> dict:
         """runner/benchmark.go:15-67: block interval stats."""
-        rn = next(iter(self.nodes.values()))
+        rn = self._live()[0]
         st = rn.rpc.status()
         last = int(st["sync_info"]["latest_block_height"])
         times = []
-        for h in range(1, last + 1):
+        for h in range(self.manifest.initial_height, last + 1):
             blk = rn.rpc.block(h)
             t = blk["block"]["header"]["time"]
             times.append(t)

@@ -1,0 +1,134 @@
+"""Randomized testnet-manifest generator.
+
+Reference parity: test/e2e/generator/generate.go — the nightly sweep
+generates manifests over a Cartesian product of global options (topology,
+initial height) with per-node randomized choices (mode, start height,
+perturbations, misbehavior) drawn from weighted distributions. This build
+keeps the same shape but emits `e2e.Manifest` objects the in-process
+`Testnet` runner consumes directly (the docker/ABCI-transport/database
+axes collapse: one process, memdb, builtin app).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+from . import Manifest, NodeManifest
+
+
+class weighted_choice(Dict[str, int]):
+    """generate.go weightedChoice: pick a key with probability
+    proportional to its integer weight."""
+
+    def choose(self, r: random.Random):
+        total = sum(self.values())
+        x = r.randrange(total)
+        for k, w in sorted(self.items()):
+            x -= w
+            if x < 0:
+                return k
+        raise AssertionError("unreachable")
+
+
+class uniform_choice(list):
+    """generate.go uniformChoice."""
+
+    def choose(self, r: random.Random):
+        return r.choice(self)
+
+
+class prob_set_choice(Dict[str, float]):
+    """generate.go probSetChoice: include each key independently with its
+    probability."""
+
+    def choose(self, r: random.Random) -> List[str]:
+        return [k for k, p in sorted(self.items()) if r.random() <= p]
+
+
+TOPOLOGIES = uniform_choice(["single", "quad", "large"])
+INITIAL_HEIGHTS = uniform_choice([1, 1000])
+NODE_POWERS = uniform_choice([10, 50, 100])
+PERTURBATIONS = prob_set_choice(
+    {"disconnect": 0.1, "restart": 0.1, "kill": 0.05}
+)
+MISBEHAVIORS = weighted_choice({"": 90, "double-prevote": 10})
+START_AT_PROB = 0.2  # late joiner exercising blocksync catch-up
+
+
+def generate(r: random.Random, min_size: int = 1, max_size: int = 0) -> List[Manifest]:
+    """Generate one manifest per topology x initial-height combination
+    (generate.go Generate), filtered to [min_size, max_size)."""
+    manifests = []
+    for topology in TOPOLOGIES:
+        for initial_height in INITIAL_HEIGHTS:
+            m = _generate_testnet(r, topology, initial_height)
+            if len(m.nodes) < min_size:
+                continue
+            if max_size and len(m.nodes) >= max_size:
+                continue
+            manifests.append(m)
+    return manifests
+
+
+def _generate_testnet(r: random.Random, topology: str, initial_height: int) -> Manifest:
+    if topology == "single":
+        n_validators, n_fulls = 1, 0
+    elif topology == "quad":
+        n_validators, n_fulls = 4, 0
+    else:  # large: 5-8 validators, 1-2 full nodes (scaled-down
+        # generate.go "large": in-process threads, not 32 containers)
+        n_validators, n_fulls = 5 + r.randrange(4), 1 + r.randrange(2)
+
+    manifest = Manifest(
+        chain_id=f"gen-{topology}-{initial_height}",
+        initial_height=initial_height,
+        load_tx_count=10,
+        wait_blocks=4,
+        nodes=[],
+    )
+    misbehave_used = False
+    for i in range(n_validators):
+        misbehave = ""
+        # at most one equivocator, never in a 1- or 2-validator net (it
+        # would halt: >1/3 byzantine power)
+        if n_validators >= 4 and not misbehave_used:
+            misbehave = MISBEHAVIORS.choose(r)
+            misbehave_used = bool(misbehave)
+        manifest.nodes.append(
+            NodeManifest(
+                name=f"validator{i:02d}",
+                mode="validator",
+                power=NODE_POWERS.choose(r),
+                perturb=[] if misbehave else PERTURBATIONS.choose(r),
+                misbehave=misbehave,
+            )
+        )
+    for i in range(n_fulls):
+        start_at = 0
+        if r.random() <= START_AT_PROB:
+            # join once the chain has blocks to sync (generate.go derives
+            # startAt from initialHeight the same way)
+            start_at = initial_height + 2
+        manifest.nodes.append(
+            NodeManifest(
+                name=f"full{i:02d}",
+                mode="full",
+                start_at=start_at,
+                # a late joiner is not running when perturb() fires, so
+                # perturbing it would start it early and break start_at
+                perturb=[] if start_at else PERTURBATIONS.choose(r),
+            )
+        )
+    # a net that loses >1/3 of its voting power to kill perturbations
+    # cannot reach the 2/3 quorum and halts; strip kills (highest power
+    # first) until the surviving power clears the threshold
+    vals = [n for n in manifest.nodes if n.mode == "validator"]
+    total = sum(n.power for n in vals)
+    for n in sorted(vals, key=lambda n: -n.power):
+        alive = sum(v.power for v in vals if "kill" not in v.perturb)
+        if alive * 3 > total * 2:
+            break
+        if "kill" in n.perturb:
+            n.perturb = [p for p in n.perturb if p != "kill"]
+    return manifest

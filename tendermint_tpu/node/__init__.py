@@ -67,6 +67,11 @@ class Node:
     tx_index_sink: object = None
     _started: bool = False
     _stopping: threading.Event = field(default_factory=threading.Event)
+    # serializes startup-mode handoffs against stop(): a handoff holds it
+    # across the _stopping check AND consensus.start(), and stop() sets
+    # _stopping under it, so a late handoff can never resurrect consensus
+    # on a node whose reactors were already torn down
+    _handoff_mtx: threading.Lock = field(default_factory=threading.Lock)
 
     def start(self) -> None:
         """OnStart (node.go:490-560) + startup-mode selection
@@ -156,38 +161,42 @@ class Node:
             # re-point the pool at the restored height: re-requesting from
             # genesis would re-apply old blocks against the restored app
             self.blocksync_reactor.reset_to_state(synced_state)
-        if self._stopping.is_set():
-            return
-        if self._should_block_sync():
+        with self._handoff_mtx:
+            if self._stopping.is_set():
+                return
+            if self._should_block_sync():
+                start_blocksync = True
+            else:
+                start_blocksync = False
+                if self.blocksync_reactor is not None:
+                    self.blocksync_reactor.stop_consuming()
+                    self.blocksync_reactor.start()
+                self.consensus.start()
+        if start_blocksync:
             self._start_blocksync_then_consensus()
-        else:
-            if self.blocksync_reactor is not None:
-                self.blocksync_reactor.stop_consuming()
-                self.blocksync_reactor.start()
-            self.consensus.start()
 
     def _start_blocksync_then_consensus(self) -> None:
         """Catch up over the blocksync channel, then switch to consensus
         when the pool reports caught-up; a watchdog switches anyway when
         blocksync makes no progress (this node may BE the tip, or its
         peers may be unable to serve)."""
-        switch_mtx = threading.Lock()
         switched = threading.Event()
 
         def switch(state) -> None:
-            # single-shot under a lock: on_caught_up and the watchdog can
-            # race at the deadline boundary; a stopped node must never be
-            # resurrected by a late handoff
-            with switch_mtx:
+            # single-shot under the node handoff lock: on_caught_up and
+            # the watchdog can race at the deadline boundary, and stop()
+            # sets _stopping under the same lock — holding it across
+            # consensus.start() closes the check-then-start TOCTOU window
+            with self._handoff_mtx:
                 if switched.is_set() or self._stopping.is_set():
                     return
                 switched.set()
-            self.blocksync_reactor.stop_consuming()
-            try:
-                self.consensus.catch_up_to_state(state)
-            except RuntimeError:
-                return  # already running (defensive)
-            self.consensus.start()
+                self.blocksync_reactor.stop_consuming()
+                try:
+                    self.consensus.catch_up_to_state(state)
+                except RuntimeError:
+                    return  # already running (defensive)
+                self.consensus.start()
 
         self.blocksync_reactor._on_caught_up = switch
         self.blocksync_reactor.start()
@@ -211,7 +220,11 @@ class Node:
         threading.Thread(target=watchdog, daemon=True).start()
 
     def stop(self) -> None:
-        self._stopping.set()  # cancels pending startup-mode handoffs
+        # set under the handoff lock: any in-flight handoff either finishes
+        # starting consensus before we proceed (and gets stopped below), or
+        # observes _stopping and aborts
+        with self._handoff_mtx:
+            self._stopping.set()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         from ..config import MODE_SEED as _seed

@@ -76,3 +76,74 @@ class TestE2EMisbehavior:
             net.check_invariants()
         finally:
             net.stop()
+
+
+class TestGenerator:
+    def test_generate_manifests_deterministic(self):
+        import random
+
+        from tendermint_tpu.e2e import generator
+
+        r1 = random.Random(42)
+        r2 = random.Random(42)
+        ms1 = generator.generate(r1)
+        ms2 = generator.generate(r2)
+        assert [m.chain_id for m in ms1] == [m.chain_id for m in ms2]
+        assert ms1 == ms2
+        # 3 topologies x 2 initial heights
+        assert len(ms1) == 6
+        for m in ms1:
+            vals = [n for n in m.nodes if n.mode == "validator"]
+            assert vals, m.chain_id
+            # surviving (non-killed) power must keep the 2/3 quorum
+            total = sum(n.power for n in vals)
+            alive = sum(n.power for n in vals if "kill" not in n.perturb)
+            assert alive * 3 > total * 2
+            # late joiners are never perturbed (they are not running when
+            # perturb() fires) and gate on the chain's initial height
+            for n in m.nodes:
+                if n.start_at:
+                    assert not n.perturb
+                    assert n.start_at > m.initial_height
+            # at most one equivocator, never below 4 validators
+            byz = [n for n in m.nodes if n.misbehave]
+            assert len(byz) <= 1
+            if byz:
+                assert len(vals) >= 4
+
+    def test_generate_size_filter(self):
+        import random
+
+        from tendermint_tpu.e2e import generator
+
+        ms = generator.generate(random.Random(7), min_size=4)
+        assert ms and all(len(m.nodes) >= 4 for m in ms)
+
+
+@pytest.mark.slow
+class TestLateJoiner:
+    def test_full_node_joins_late_and_syncs(self):
+        """runner/start.go: a start_at node launches once the chain passes
+        its height and catches up (blocksync) to the running network."""
+        manifest = Manifest(
+            chain_id="e2e-late",
+            nodes=[
+                NodeManifest(name="val0"),
+                NodeManifest(name="val1"),
+                NodeManifest(name="full-late", mode="full", start_at=3),
+            ],
+            load_tx_count=4,
+            wait_blocks=3,
+        )
+        net = Testnet(manifest)
+        net.setup()
+        net.start()
+        try:
+            assert net.nodes["full-late"].rpc is None
+            net.start_late_joiners(timeout=90)
+            assert net.nodes["full-late"].rpc is not None
+            net.wait_for_height(5, timeout=120)
+            net.nodes["full-late"].node.wait_for_height(5, timeout=120)
+            net.check_invariants()
+        finally:
+            net.stop()
