@@ -95,6 +95,7 @@ class Testnet:
             self._build_node(i, m, sks[m.name])
         # full mesh of persistent peers
         for name, rn in self.nodes.items():
+            entries = []
             for other, orn in self.nodes.items():
                 if other != name and orn.node.router is not None and rn.node.router is not None:
                     rn.node.router._pm.add_address(
@@ -104,6 +105,17 @@ class Testnet:
                         ),
                         persistent=True,
                     )
+                    entries.append(
+                        f"{orn.node_key.node_id}@{orn.node.router._transport.listen_addr}"
+                    )
+            # full nodes record the mesh in config too so
+            # _should_block_sync routes them through the real
+            # blocksync->consensus handoff (late joiners catch up over
+            # the blocksync channel, not consensus gossip); validators
+            # skip it to start consensus at genesis without the
+            # caught-up wait
+            if rn.manifest.mode != "validator":
+                rn.node.config.p2p.persistent_peers = ",".join(entries)
 
     def _build_node(self, i: int, m: NodeManifest, sk) -> None:
         cfg = Config()

@@ -40,10 +40,15 @@ class uniform_choice(list):
 
 class prob_set_choice(Dict[str, float]):
     """generate.go probSetChoice: include each key independently with its
-    probability."""
+    probability. kill and restart are mutually exclusive (restart implies
+    a kill; a node with both would be rebuilt by perturb() and end up
+    running while every downstream liveness check assumes it dead)."""
 
     def choose(self, r: random.Random) -> List[str]:
-        return [k for k, p in sorted(self.items()) if r.random() <= p]
+        picks = [k for k, p in sorted(self.items()) if r.random() <= p]
+        if "kill" in picks and "restart" in picks:
+            picks.remove("kill")
+        return picks
 
 
 TOPOLOGIES = uniform_choice(["single", "quad", "large"])
@@ -99,7 +104,10 @@ def _generate_testnet(r: random.Random, topology: str, initial_height: int) -> M
             NodeManifest(
                 name=f"validator{i:02d}",
                 mode="validator",
-                power=NODE_POWERS.choose(r),
+                # the equivocator gets the minimum power so its share
+                # stays below 1/3 regardless of the other draws (>=4
+                # validators of >=10 each bounds it at 10/40 = 25%)
+                power=min(NODE_POWERS) if misbehave else NODE_POWERS.choose(r),
                 perturb=[] if misbehave else PERTURBATIONS.choose(r),
                 misbehave=misbehave,
             )

@@ -71,7 +71,7 @@ class Node:
     # across the _stopping check AND consensus.start(), and stop() sets
     # _stopping under it, so a late handoff can never resurrect consensus
     # on a node whose reactors were already torn down
-    _handoff_mtx: threading.Lock = field(default_factory=threading.Lock)
+    _handoff_mtx: threading.RLock = field(default_factory=threading.RLock)
 
     def start(self) -> None:
         """OnStart (node.go:490-560) + startup-mode selection
@@ -198,8 +198,11 @@ class Node:
                     return  # already running (defensive)
                 self.consensus.start()
 
-        self.blocksync_reactor._on_caught_up = switch
-        self.blocksync_reactor.start()
+        with self._handoff_mtx:
+            if self._stopping.is_set():
+                return  # stop() won the race before blocksync began
+            self.blocksync_reactor._on_caught_up = switch
+            self.blocksync_reactor.start()
 
         def watchdog() -> None:
             # refresh on PROGRESS (height advancing), not on peer

@@ -117,6 +117,36 @@ def _pack_rows(entries, bucket: int):
     return pub, r_enc, s_enc
 
 
+def _challenges(r_enc: np.ndarray, pub: np.ndarray, msgs) -> bytes:
+    """Batch challenge scalars k_i = SHA512(R_i||A_i||M_i) mod L, 32B LE
+    each. Native C helper when built (one call for the whole batch — the
+    per-sig Python loop measured ~50% of end-to-end batch time on a loaded
+    host); hashlib fallback otherwise."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None and hasattr(native, "ed25519_challenges"):
+        return native.ed25519_challenges(
+            np.ascontiguousarray(r_enc).tobytes(),
+            np.ascontiguousarray(pub).tobytes(),
+            msgs,
+        )
+    r_b = np.ascontiguousarray(r_enc).tobytes()
+    p_b = np.ascontiguousarray(pub).tobytes()
+    return b"".join(
+        (
+            int.from_bytes(
+                hashlib.sha512(
+                    r_b[32 * i : 32 * i + 32] + p_b[32 * i : 32 * i + 32] + m
+                ).digest(),
+                "little",
+            )
+            % L
+        ).to_bytes(32, "little")
+        for i, m in enumerate(msgs)
+    )
+
+
 def _s_below_l(s_enc: np.ndarray, n: int, bucket: int) -> np.ndarray:
     """Vectorized s < L check (RFC 8032 scalar range): big-endian
     lexicographic compare against L. Padding lanes pass (s = 0)."""
@@ -145,15 +175,7 @@ def prepare_batch(
     k_enc = np.zeros((bucket, 32), dtype=np.uint8)
     s_ok = _s_below_l(s_enc, n, bucket)
     if n:
-        ks = b"".join(
-            (
-                int.from_bytes(
-                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
-                )
-                % L
-            ).to_bytes(32, "little")
-            for pk, msg, sig in entries
-        )
+        ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
         k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
 
     a_sign = (pub[:, 31] >> 7).astype(np.int32)

@@ -347,27 +347,17 @@ def verify_compact(a_t, r_t, s_t, k_t, s_ok_t, block: int = 0, interpret: bool =
 
 def prepare_compact(entries, bucket: int):
     """(pub32, msg, sig64) triples -> compact batch-minor kernel args.
-    Host work: one SHA-512 per sig for k (hashlib, C-speed), s<L check,
-    two transposes. Padding lanes verify trivially (A=R=identity, s=k=0)."""
-    import hashlib
-
-    from ..crypto._edwards import L
-    from .backend import _pack_rows, _s_below_l
+    Host work: one SHA-512 per sig for k (native batch helper when built,
+    else hashlib), s<L check, two transposes. Padding lanes verify
+    trivially (A=R=identity, s=k=0)."""
+    from .backend import _challenges, _pack_rows, _s_below_l
 
     n = len(entries)
     pub, r_enc, s_enc = _pack_rows(entries, bucket)  # (bucket, 32) uint8 each
     s_ok = _s_below_l(s_enc, n, bucket)
     k_enc = np.zeros((bucket, 32), dtype=np.uint8)
     if n:
-        ks = b"".join(
-            (
-                int.from_bytes(
-                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
-                )
-                % L
-            ).to_bytes(32, "little")
-            for pk, msg, sig in entries
-        )
+        ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
         k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
     return (
         np.ascontiguousarray(pub.T),

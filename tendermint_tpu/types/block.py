@@ -343,6 +343,7 @@ class Commit:
     signatures: List[CommitSig] = field(default_factory=list)
 
     _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+    _sb_tpl: Optional[dict] = field(default=None, repr=False, compare=False)
 
     def hash(self) -> bytes:
         if self._hash is None:
@@ -355,16 +356,27 @@ class Commit:
         return len(self.signatures)
 
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
-        """Canonical sign bytes of the vote at idx (types/block.go:816-819)."""
+        """Canonical sign bytes of the vote at idx (types/block.go:816-819).
+
+        Only the timestamp differs across a commit's signatures (for a
+        given BlockIDFlag), so the constant fields are encoded once per
+        (chain_id, flag) and reused — the 10k-signature batch path walks
+        this for every lane."""
         cs = self.signatures[idx]
-        return _canon.canonical_vote_sign_bytes(
-            chain_id=chain_id,
-            msg_type=_canon.SIGNED_MSG_TYPE_PRECOMMIT,
-            height=self.height,
-            round_=self.round,
-            block_id=cs.block_id(self.block_id).canonical(),
-            timestamp=cs.timestamp,
-        )
+        if self._sb_tpl is None:
+            self._sb_tpl = {}
+        key = (chain_id, cs.block_id_flag)
+        tpl = self._sb_tpl.get(key)
+        if tpl is None:
+            tpl = _canon.canonical_vote_template(
+                chain_id=chain_id,
+                msg_type=_canon.SIGNED_MSG_TYPE_PRECOMMIT,
+                height=self.height,
+                round_=self.round,
+                block_id=cs.block_id(self.block_id).canonical(),
+            )
+            self._sb_tpl[key] = tpl
+        return _canon.compose_vote_sign_bytes(tpl, cs.timestamp)
 
     def encode(self) -> bytes:
         w = ProtoWriter()

@@ -104,6 +104,38 @@ def canonical_vote_sign_bytes(
     return marshal_delimited(w.bytes())
 
 
+def canonical_vote_template(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: Optional[CanonicalBlockID],
+) -> tuple:
+    """Split the CanonicalVote encoding around its only per-signature field
+    (the timestamp, field 5): (prefix = fields 1-4, suffix = field 6).
+    compose_vote_sign_bytes(tpl, ts) == canonical_vote_sign_bytes(...) for
+    every timestamp — a commit's 10k sign-bytes share one template
+    (types/block.go:816-819 rebuilds the whole message per signature; the
+    batch path here amortizes everything but the timestamp)."""
+    w = ProtoWriter()
+    w.write_varint(1, msg_type)
+    w.write_sfixed64(2, height)
+    w.write_sfixed64(3, round_)
+    if block_id is not None:
+        w.write_message(4, encode_canonical_block_id(block_id), always=True)
+    prefix = w.bytes()
+    w2 = ProtoWriter()
+    w2.write_string(6, chain_id)
+    return prefix, w2.bytes()
+
+
+def compose_vote_sign_bytes(tpl: tuple, timestamp: Timestamp) -> bytes:
+    prefix, suffix = tpl
+    w = ProtoWriter()
+    w.write_message(5, encode_timestamp(timestamp), always=True)
+    return marshal_delimited(prefix + w.bytes() + suffix)
+
+
 def canonical_proposal_sign_bytes(
     chain_id: str,
     height: int,

@@ -54,3 +54,62 @@ class TestNative:
             nat._module, nat._tried = None, False
         assert (backend._pack_le_limbs(enc) == pure_limbs).all()
         assert (backend._bits_253(enc) == pure_bits).all()
+
+    def test_ed25519_challenges_differential(self):
+        """Native k = SHA512(R||A||M) mod L vs hashlib/bigint, on both the
+        OpenSSL one-shot path and the scalar fallback (no_ossl=True),
+        including SHA-512 block-boundary message lengths."""
+        import hashlib
+
+        from tendermint_tpu.crypto._edwards import L
+        from tendermint_tpu.native import load
+
+        m = load()
+        if m is None:
+            import pytest
+
+            pytest.skip("no native toolchain")
+        rng = random.Random(11)
+        lens = [0, 47, 63, 64, 65, 111, 112, 113, 127, 128, 129, 255, 256, 300]
+        n = len(lens) + 100
+        rs = rng.randbytes(32 * n)
+        pubs = rng.randbytes(32 * n)
+        msgs = [bytes(ln) for ln in lens] + [
+            rng.randbytes(rng.randrange(0, 200)) for _ in range(100)
+        ]
+        for no_ossl in (False, True):
+            out = m.ed25519_challenges(rs, pubs, msgs, no_ossl)
+            for i in range(n):
+                expect = (
+                    int.from_bytes(
+                        hashlib.sha512(
+                            rs[32 * i : 32 * i + 32]
+                            + pubs[32 * i : 32 * i + 32]
+                            + msgs[i]
+                        ).digest(),
+                        "little",
+                    )
+                    % L
+                ).to_bytes(32, "little")
+                assert out[32 * i : 32 * i + 32] == expect, (no_ossl, i)
+
+    def test_challenges_backend_fallback_parity(self):
+        """ops.backend._challenges: native and pure-Python agree."""
+        import os
+
+        import tendermint_tpu.native as nat
+        from tendermint_tpu.ops import backend
+
+        rng = random.Random(13)
+        n = 40
+        r_enc = np.frombuffer(rng.randbytes(32 * n), dtype=np.uint8).reshape(n, 32).copy()
+        pub = np.frombuffer(rng.randbytes(32 * n), dtype=np.uint8).reshape(n, 32).copy()
+        msgs = [rng.randbytes(50 + i) for i in range(n)]
+        os.environ["TM_TPU_NO_NATIVE"] = "1"
+        nat._module, nat._tried = None, False
+        try:
+            pure = backend._challenges(r_enc, pub, msgs)
+        finally:
+            os.environ.pop("TM_TPU_NO_NATIVE")
+            nat._module, nat._tried = None, False
+        assert backend._challenges(r_enc, pub, msgs) == pure

@@ -194,3 +194,42 @@ class TestCanonicalDifferential:
 
         assert to_signed64(fields[1][0][1]) == canonical.GO_ZERO_TIME_SECONDS
         assert 2 not in fields
+
+
+def test_vote_sign_bytes_template_parity():
+    """Commit.vote_sign_bytes's per-(chain_id, flag) template must produce
+    byte-identical output to the direct CanonicalVote encoding for every
+    timestamp and flag combination."""
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from tendermint_tpu.wire import canonical as canon
+
+    bid = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=3, hash=b"\xbb" * 32))
+    sigs = [
+        CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT if i % 3 else BLOCK_ID_FLAG_NIL,
+            validator_address=bytes([i]) * 20,
+            timestamp=canon.Timestamp(seconds=1_600_000_000 + 977 * i, nanos=i * 13),
+            signature=b"s" * 64,
+        )
+        for i in range(12)
+    ]
+    for height, round_ in ((1, 0), (1 << 40, 7)):
+        commit = Commit(height=height, round=round_, block_id=bid, signatures=list(sigs))
+        for chain_id in ("chain-a", ""):
+            for idx, cs in enumerate(commit.signatures):
+                direct = canon.canonical_vote_sign_bytes(
+                    chain_id=chain_id,
+                    msg_type=canon.SIGNED_MSG_TYPE_PRECOMMIT,
+                    height=commit.height,
+                    round_=commit.round,
+                    block_id=cs.block_id(commit.block_id).canonical(),
+                    timestamp=cs.timestamp,
+                )
+                assert commit.vote_sign_bytes(chain_id, idx) == direct, (chain_id, idx)
