@@ -40,12 +40,20 @@ class uniform_choice(list):
 
 class prob_set_choice(Dict[str, float]):
     """generate.go probSetChoice: include each key independently with its
-    probability. kill and restart are mutually exclusive (restart implies
-    a kill; a node with both would be rebuilt by perturb() and end up
-    running while every downstream liveness check assumes it dead)."""
+    probability."""
 
     def choose(self, r: random.Random) -> List[str]:
-        picks = [k for k, p in sorted(self.items()) if r.random() <= p]
+        return [k for k, p in sorted(self.items()) if r.random() <= p]
+
+
+class _perturbation_choice(prob_set_choice):
+    """Perturbation draw: kill and restart are mutually exclusive
+    (restart implies a kill; a node with both would be rebuilt by
+    perturb() and end up running while every downstream liveness check
+    assumes it dead)."""
+
+    def choose(self, r: random.Random) -> List[str]:
+        picks = super().choose(r)
         if "kill" in picks and "restart" in picks:
             picks.remove("kill")
         return picks
@@ -54,7 +62,7 @@ class prob_set_choice(Dict[str, float]):
 TOPOLOGIES = uniform_choice(["single", "quad", "large"])
 INITIAL_HEIGHTS = uniform_choice([1, 1000])
 NODE_POWERS = uniform_choice([10, 50, 100])
-PERTURBATIONS = prob_set_choice(
+PERTURBATIONS = _perturbation_choice(
     {"disconnect": 0.1, "restart": 0.1, "kill": 0.05}
 )
 MISBEHAVIORS = weighted_choice({"": 90, "double-prevote": 10})

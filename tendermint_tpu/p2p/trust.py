@@ -1,0 +1,279 @@
+"""Peer trust metric — PID-style reliability scoring with faded memories.
+
+Reference parity: internal/p2p/trust/ (metric.go, store.go; the math is
+specified in the reference's ADR-006). A metric blends three components:
+
+  trust = P_weight * proportional + I_weight * history + weighted_derivative
+
+- proportional: good/(good+bad) for the CURRENT interval (1.0 when empty)
+- history (integral): weighted mean of past interval values, newer
+  intervals weighted by 0.8^i ("optimistic" weights), with logarithmic
+  "faded memories" so a 14-day window needs only ~log2(intervals) slots
+- derivative: (proportional - history), counted only when NEGATIVE
+  (gamma1=0, gamma2=1) so sudden misbehavior bites immediately while
+  improvement must be earned through history
+
+This build drives interval advancement explicitly (advance()) or by
+elapsed wall-time (tick()), instead of a goroutine+ticker; the math is
+interval-count-based either way, so scores match the reference for the
+same event/interval sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+# metric.go:16-25
+DERIVATIVE_GAMMA1 = 0.0  # weight when current behavior >= history
+DERIVATIVE_GAMMA2 = 1.0  # weight when current behavior < history
+HISTORY_DATA_WEIGHT = 0.8
+
+# config.go DefaultConfig
+DEFAULT_PROPORTIONAL_WEIGHT = 0.4
+DEFAULT_INTEGRAL_WEIGHT = 0.6
+DEFAULT_TRACKING_WINDOW_S = 14 * 24 * 60 * 60.0  # 14 days
+DEFAULT_INTERVAL_S = 60.0
+
+
+def _interval_to_history_offset(interval: int) -> int:
+    """metric.go:407: the ith interval lives at history index
+    floor(log2(i)) from the end (2^m intervals in m slots)."""
+    return int(math.floor(math.log2(interval)))
+
+
+class TrustMetric:
+    """metric.go Metric."""
+
+    def __init__(
+        self,
+        proportional_weight: float = DEFAULT_PROPORTIONAL_WEIGHT,
+        integral_weight: float = DEFAULT_INTEGRAL_WEIGHT,
+        tracking_window_s: float = DEFAULT_TRACKING_WINDOW_S,
+        interval_s: float = DEFAULT_INTERVAL_S,
+    ):
+        self._mtx = threading.Lock()
+        self.proportional_weight = proportional_weight
+        self.integral_weight = integral_weight
+        self.interval_s = interval_s
+        self.max_intervals = int(tracking_window_s / interval_s)
+        self.history_max_size = _interval_to_history_offset(self.max_intervals) + 1
+        self.num_intervals = 0
+        self.history: List[float] = []
+        self.history_weights: List[float] = []
+        self.history_weight_sum = 0.0
+        self.history_value = 1.0  # perfect history so far
+        self.good = 0.0
+        self.bad = 0.0
+        self.paused = False
+        self._last_tick = time.monotonic()
+
+    # -- events (metric.go BadEvents/GoodEvents) -------------------------
+
+    def bad_events(self, num: int = 1) -> None:
+        with self._mtx:
+            self._unpause()
+            self.bad += num
+
+    def good_events(self, num: int = 1) -> None:
+        with self._mtx:
+            self._unpause()
+            self.good += num
+
+    def pause(self) -> None:
+        """History stops evolving until the next recorded event."""
+        with self._mtx:
+            self.paused = True
+
+    # -- scores ----------------------------------------------------------
+
+    def trust_value(self) -> float:
+        with self._mtx:
+            return self._calc_trust_value()
+
+    def trust_score(self) -> int:
+        """0..100 (metric.go TrustScore)."""
+        return int(math.floor(self.trust_value() * 100))
+
+    # -- interval advancement -------------------------------------------
+
+    def tick(self) -> None:
+        """Advance by however many whole intervals of wall time elapsed
+        (replaces the reference's ticker goroutine). The elapsed-interval
+        bookkeeping happens under the lock so concurrent tickers cannot
+        double-advance."""
+        now = time.monotonic()
+        with self._mtx:
+            n = int((now - self._last_tick) / self.interval_s)
+            if n <= 0:
+                return
+            self._last_tick += n * self.interval_s
+        for _ in range(n):
+            self.advance()
+
+    def advance(self) -> None:
+        """metric.go NextTimeInterval."""
+        with self._mtx:
+            if self.paused:
+                return
+            new_hist = self._calc_trust_value()
+            self.history.append(new_hist)
+            if len(self.history) > self.history_max_size:
+                self.history = self.history[-self.history_max_size :]
+            if self.num_intervals < self.max_intervals:
+                self.num_intervals += 1
+                wk = HISTORY_DATA_WEIGHT**self.num_intervals
+                self.history_weights.append(wk)
+                self.history_weight_sum += wk
+            self._update_faded_memory()
+            self.history_value = self._calc_history_value()
+            self.good = 0.0
+            self.bad = 0.0
+
+    # -- persistence (store.go / MetricHistoryJSON) ----------------------
+
+    def history_dict(self) -> dict:
+        with self._mtx:
+            return {"intervals": self.num_intervals, "history": list(self.history)}
+
+    def history_json(self) -> str:
+        return json.dumps(self.history_dict())
+
+    def init_from_json(self, data: str) -> None:
+        """metric.go Init: restore a saved history. Inconsistent blobs
+        (interval count unsupported by the history list — a truncated or
+        corrupt write) are clamped rather than trusted: every faded-memory
+        offset the restored interval count implies must be addressable."""
+        hist = json.loads(data)
+        n = min(int(hist.get("intervals", 0)), self.max_intervals)
+        h = [float(x) for x in hist.get("history", [])][-self.history_max_size :]
+        while n > 0 and (
+            not h
+            or (n > 1 and _interval_to_history_offset(n - 1) >= len(h))
+        ):
+            n -= 1
+        with self._mtx:
+            if n == 0:
+                self.num_intervals = 0
+                self.history = []
+                self.history_weights = []
+                self.history_weight_sum = 0.0
+                self.history_value = 1.0
+                return
+            self.num_intervals = n
+            self.history = h
+            self.history_weights = [
+                HISTORY_DATA_WEIGHT**i for i in range(1, n + 1)
+            ]
+            self.history_weight_sum = sum(self.history_weights)
+            self.history_value = self._calc_history_value()
+
+    # -- private (metric.go:320-405) -------------------------------------
+
+    def _unpause(self) -> None:
+        if self.paused:
+            self.good = 0.0
+            self.bad = 0.0
+            self.paused = False
+
+    def _proportional_value(self) -> float:
+        total = self.good + self.bad
+        return self.good / total if total > 0 else 1.0
+
+    def _calc_trust_value(self) -> float:
+        p = self._proportional_value()
+        d = p - self.history_value
+        weight = DERIVATIVE_GAMMA2 if d < 0 else DERIVATIVE_GAMMA1
+        tv = (
+            self.proportional_weight * p
+            + self.integral_weight * self.history_value
+            + weight * d
+        )
+        return max(tv, 0.0)
+
+    def _calc_history_value(self) -> float:
+        hv = 0.0
+        for i in range(self.num_intervals):
+            hv += self._faded_memory_value(i) * self.history_weights[i]
+        return hv / self.history_weight_sum if self.history_weight_sum else 1.0
+
+    def _faded_memory_value(self, interval: int) -> float:
+        first = len(self.history) - 1
+        if interval == 0:
+            return self.history[first]
+        return self.history[first - _interval_to_history_offset(interval)]
+
+    def _update_faded_memory(self) -> None:
+        """Faded memories: merge pairs, spreading older data out
+        (metric.go:390-405)."""
+        size = len(self.history)
+        if size < 2:
+            return
+        end = size - 1
+        for count in range(1, size):
+            i = end - count
+            x = 2.0**count
+            self.history[i] = ((self.history[i] * (x - 1)) + self.history[i + 1]) / x
+
+
+class TrustMetricStore:
+    """store.go Store: per-peer metrics with optional persistence into a
+    DB-like object (get/set of the JSON blob under one key)."""
+
+    _KEY = b"trustMetricStore"
+
+    def __init__(self, db=None, **metric_kwargs):
+        self._mtx = threading.Lock()
+        self._db = db
+        self._kwargs = metric_kwargs
+        self.metrics: Dict[str, TrustMetric] = {}
+        if db is not None:
+            self._load()
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self.metrics)
+
+    def get_peer_trust_metric(self, peer_id: str) -> TrustMetric:
+        with self._mtx:
+            m = self.metrics.get(peer_id)
+            if m is None:
+                m = TrustMetric(**self._kwargs)
+                self.metrics[peer_id] = m
+            return m
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        """store.go PeerDisconnected: pause the metric so history stops
+        evolving while the peer is away."""
+        with self._mtx:
+            m = self.metrics.get(peer_id)
+        if m is not None:
+            m.pause()
+
+    def save(self) -> None:
+        if self._db is None:
+            return
+        with self._mtx:
+            blob = json.dumps(
+                {pid: m.history_dict() for pid, m in self.metrics.items()}
+            )
+        self._db.set(self._KEY, blob.encode())
+
+    def _load(self) -> None:
+        raw: Optional[bytes] = self._db.get(self._KEY)
+        if not raw:
+            return
+        try:
+            peers = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        for pid, hist in peers.items():
+            m = TrustMetric(**self._kwargs)
+            try:
+                m.init_from_json(json.dumps(hist))
+            except (ValueError, TypeError, AttributeError):
+                continue  # corrupt entry: start the peer fresh
+            self.metrics[pid] = m

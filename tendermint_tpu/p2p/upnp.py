@@ -1,0 +1,251 @@
+"""UPnP NAT traversal — SSDP discovery + WANIPConnection port mapping.
+
+Reference parity: internal/p2p/upnp/ (upnp.go Discover/AddPortMapping/
+DeletePortMapping/GetExternalAddress; probe.go Probe/Capabilities). The
+protocol: an SSDP M-SEARCH multicast finds the gateway's description URL,
+the description XML names the WANIPConnection control endpoint, and SOAP
+POSTs drive the IGD actions.
+
+Discovery and HTTP endpoints are injectable (ssdp_addr / socket factory)
+so the full flow is testable against an in-process fake gateway — the
+probe in this environment has no real multicast route.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import xml.sax.saxutils
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional, Tuple
+from urllib.parse import urljoin, urlparse
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+WAN_SERVICE_RE = re.compile(
+    r"urn:(?P<domain>[\w.-]+):service:WANIPConnection:1"
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+def _msearch_message() -> bytes:
+    # upnp.go:58-64
+    return (
+        "M-SEARCH * HTTP/1.1\r\n"
+        "HOST: 239.255.255.250:1900\r\n"
+        "ST: ssdp:all\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        "MX: 2\r\n\r\n"
+    ).encode()
+
+
+def parse_ssdp_response(data: bytes) -> Optional[str]:
+    """Location URL from an SSDP response advertising an
+    InternetGatewayDevice (upnp.go:74-112)."""
+    text = data.decode("utf-8", "replace")
+    if "InternetGatewayDevice" not in text:
+        return None
+    for line in text.split("\r\n"):
+        name, _, value = line.partition(":")
+        if name.strip().lower() == "location":
+            return value.strip()
+    return None
+
+
+def discover(
+    timeout: float = 3.0, ssdp_addr: Tuple[str, int] = SSDP_ADDR, attempts: int = 3
+) -> "UPnPNAT":
+    """upnp.go:39 Discover: multicast M-SEARCH, follow the gateway's
+    Location to its description XML, resolve the WANIPConnection control
+    URL."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout / attempts)
+    try:
+        for _ in range(attempts):
+            sock.sendto(_msearch_message(), ssdp_addr)
+            try:
+                data, _ = sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            loc = parse_ssdp_response(data)
+            if loc is None:
+                continue
+            control_url, domain = get_service_url(loc)
+            local_ip = _local_ip_for(loc)
+            return UPnPNAT(control_url=control_url, urn_domain=domain, local_ip=local_ip)
+        raise UPnPError("UPnP port discovery failed")
+    finally:
+        sock.close()
+
+
+def _local_ip_for(root_url: str) -> str:
+    """The local interface address routing to the gateway
+    (upnp.go:179 localIPv4)."""
+    host = urlparse(root_url).hostname or "127.0.0.1"
+    port = urlparse(root_url).port or 80
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host, port))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def get_service_url(root_url: str) -> Tuple[str, str]:
+    """Fetch the device description and return (control URL, urn domain)
+    for WANIPConnection:1 (upnp.go:204-258)."""
+    with urllib.request.urlopen(root_url, timeout=5) as resp:
+        tree = ET.parse(resp)
+    # namespace-agnostic walk (gateways vary)
+    def local(tag: str) -> str:
+        return tag.rsplit("}", 1)[-1]
+
+    for service in tree.iter():
+        if local(service.tag) != "service":
+            continue
+        st = ctl = None
+        for child in service:
+            if local(child.tag) == "serviceType":
+                st = (child.text or "").strip()
+            elif local(child.tag) == "controlURL":
+                ctl = (child.text or "").strip()
+        if st and ctl:
+            m = WAN_SERVICE_RE.fullmatch(st)
+            if m:
+                return urljoin(root_url, ctl), m.group("domain")
+    raise UPnPError("no WANIPConnection service in device description")
+
+
+def _soap_request(url: str, function: str, body: str, domain: str) -> bytes:
+    """upnp.go:260 soapRequest."""
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        "<s:Body>" + body + "</s:Body></s:Envelope>"
+    )
+    req = urllib.request.Request(
+        url,
+        data=envelope.encode(),
+        headers={
+            "Content-Type": "text/xml; charset=\"utf-8\"",
+            "SOAPAction": f'"urn:{domain}:service:WANIPConnection:1#{function}"',
+            "Connection": "Close",
+        },
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise UPnPError(f"SOAP {function} failed: HTTP {e.code}") from e
+
+
+@dataclass
+class UPnPNAT:
+    """upnp.go upnpNAT (the NAT interface implementation)."""
+
+    control_url: str
+    urn_domain: str
+    local_ip: str
+
+    def get_external_address(self) -> str:
+        """upnp.go:301,336 GetExternalAddress."""
+        body = (
+            f'<u:GetExternalIPAddress xmlns:u="urn:{self.urn_domain}:'
+            'service:WANIPConnection:1"/>'
+        )
+        resp = _soap_request(
+            self.control_url, "GetExternalIPAddress", body, self.urn_domain
+        )
+        m = re.search(
+            rb"<NewExternalIPAddress>\s*([^<\s]+)\s*</NewExternalIPAddress>", resp
+        )
+        if not m:
+            raise UPnPError("gateway returned no external IP")
+        return m.group(1).decode()
+
+    def add_port_mapping(
+        self,
+        protocol: str,
+        external_port: int,
+        internal_port: int,
+        description: str,
+        lease_duration_s: int = 0,
+    ) -> int:
+        """upnp.go:348 AddPortMapping; returns the mapped external port."""
+        body = (
+            f'<u:AddPortMapping xmlns:u="urn:{self.urn_domain}:service:WANIPConnection:1">'
+            "<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol.upper()}</NewProtocol>"
+            f"<NewInternalPort>{internal_port}</NewInternalPort>"
+            f"<NewInternalClient>{self.local_ip}</NewInternalClient>"
+            "<NewEnabled>1</NewEnabled>"
+            "<NewPortMappingDescription>"
+            + xml.sax.saxutils.escape(description)
+            + "</NewPortMappingDescription>"
+            f"<NewLeaseDuration>{lease_duration_s}</NewLeaseDuration>"
+            "</u:AddPortMapping>"
+        )
+        _soap_request(self.control_url, "AddPortMapping", body, self.urn_domain)
+        return external_port
+
+    def delete_port_mapping(self, protocol: str, external_port: int) -> None:
+        """upnp.go:384 DeletePortMapping."""
+        body = (
+            f'<u:DeletePortMapping xmlns:u="urn:{self.urn_domain}:service:WANIPConnection:1">'
+            "<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol.upper()}</NewProtocol>"
+            "</u:DeletePortMapping>"
+        )
+        _soap_request(self.control_url, "DeletePortMapping", body, self.urn_domain)
+
+
+@dataclass
+class Capabilities:
+    """probe.go Capabilities."""
+
+    port_mapping: bool = False
+    hairpin: bool = False
+
+
+def probe(
+    int_port: int = 8001,
+    ext_port: int = 8001,
+    timeout: float = 3.0,
+    ssdp_addr: Tuple[str, int] = SSDP_ADDR,
+) -> Capabilities:
+    """probe.go:84 Probe: discover the gateway, map a port, check the
+    external address, then clean up. Hairpin (dialing your own external
+    address) is reported false unless the loopback dial succeeds."""
+    caps = Capabilities()
+    nat = discover(timeout=timeout, ssdp_addr=ssdp_addr)
+    ext_ip = nat.get_external_address()
+    nat.add_port_mapping("tcp", ext_port, int_port, "tendermint-probe", 0)
+    caps.port_mapping = True
+    # hairpin test needs a real local listener on int_port for the
+    # gateway to forward back to (probe.go:16 makeUPNPListener dials
+    # only after listening)
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("", int_port))
+        listener.listen(1)
+        s = socket.create_connection((ext_ip, ext_port), timeout=1)
+        s.close()
+        caps.hairpin = True
+    except OSError:
+        pass
+    finally:
+        listener.close()
+        try:
+            nat.delete_port_mapping("tcp", ext_port)
+        except UPnPError:
+            pass
+    return caps

@@ -92,16 +92,12 @@ def canonical_vote_sign_bytes(
     """VoteSignBytes (types/vote.go:84-101): delimited CanonicalVote.
 
     block_id must already be canonicalized: None iff the vote's BlockID is
-    zero (types/canonical.go:18-34)."""
-    w = ProtoWriter()
-    w.write_varint(1, msg_type)
-    w.write_sfixed64(2, height)
-    w.write_sfixed64(3, round_)
-    if block_id is not None:
-        w.write_message(4, encode_canonical_block_id(block_id), always=True)
-    w.write_message(5, encode_timestamp(timestamp), always=True)
-    w.write_string(6, chain_id)
-    return marshal_delimited(w.bytes())
+    zero (types/canonical.go:18-34). Implemented via the template split so
+    there is exactly one encoder for the cached and direct paths."""
+    return compose_vote_sign_bytes(
+        canonical_vote_template(chain_id, msg_type, height, round_, block_id),
+        timestamp,
+    )
 
 
 def canonical_vote_template(

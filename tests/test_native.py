@@ -105,11 +105,15 @@ class TestNative:
         r_enc = np.frombuffer(rng.randbytes(32 * n), dtype=np.uint8).reshape(n, 32).copy()
         pub = np.frombuffer(rng.randbytes(32 * n), dtype=np.uint8).reshape(n, 32).copy()
         msgs = [rng.randbytes(50 + i) for i in range(n)]
+        prior = os.environ.get("TM_TPU_NO_NATIVE")
         os.environ["TM_TPU_NO_NATIVE"] = "1"
         nat._module, nat._tried = None, False
         try:
             pure = backend._challenges(r_enc, pub, msgs)
         finally:
-            os.environ.pop("TM_TPU_NO_NATIVE")
+            if prior is None:
+                os.environ.pop("TM_TPU_NO_NATIVE")
+            else:
+                os.environ["TM_TPU_NO_NATIVE"] = prior
             nat._module, nat._tried = None, False
         assert backend._challenges(r_enc, pub, msgs) == pure

@@ -1,0 +1,268 @@
+"""Trust metric (internal/p2p/trust) and UPnP (internal/p2p/upnp) parity
+tests — the metric against the reference's documented math, UPnP against
+an in-process fake IGD gateway (SSDP responder + SOAP endpoint)."""
+
+import http.server
+import socket
+import threading
+
+import pytest
+
+from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+from tendermint_tpu.p2p import upnp
+
+
+class TestTrustMetric:
+    def test_perfect_history_stays_at_one(self):
+        m = TrustMetric()
+        for _ in range(10):
+            m.good_events(5)
+            m.advance()
+        assert m.trust_score() == 100
+
+    def test_proportional_drop_on_bad_events(self):
+        """metric_test.go TestTrustMetricScores: all-bad current interval
+        with perfect history -> P=0, I=1: 0.4*0 + 0.6*1 + 1.0*(0-1) < 0
+        clamps to 0... with partial bad the derivative bites."""
+        m = TrustMetric()
+        m.good_events(1)
+        assert m.trust_score() == 100
+        m.bad_events(10)
+        # proportional = 1/11, derivative negative with gamma2=1
+        assert m.trust_score() < 50
+
+    def test_trust_value_formula(self):
+        """Hand-check one step: history_value=1 initially; with good=3,
+        bad=1 -> P=0.75, d=-0.25 -> tv = 0.4*0.75 + 0.6*1 - 0.25 = 0.65."""
+        m = TrustMetric()
+        m.good_events(3)
+        m.bad_events(1)
+        assert abs(m.trust_value() - 0.65) < 1e-9
+
+    def test_history_recovery(self):
+        """After bad intervals, sustained good behavior recovers the
+        score (integral component with optimistic weights)."""
+        m = TrustMetric()
+        for _ in range(3):
+            m.bad_events(10)
+            m.advance()
+        low = m.trust_value()
+        for _ in range(30):
+            m.good_events(10)
+            m.advance()
+        assert m.trust_value() > low
+        assert m.trust_value() > 0.9
+
+    def test_faded_memory_window(self):
+        """History storage stays logarithmic in the interval count
+        (metric.go intervalToHistoryOffset)."""
+        m = TrustMetric(tracking_window_s=1024 * 60.0, interval_s=60.0)
+        for _ in range(200):
+            m.good_events(1)
+            m.advance()
+        assert len(m.history) <= m.history_max_size
+        assert m.history_max_size == 11  # floor(log2(1024)) + 1
+
+    def test_pause_freezes_history(self):
+        m = TrustMetric()
+        m.good_events(5)
+        m.advance()
+        m.pause()
+        before = m.num_intervals
+        m.advance()  # paused: no-op
+        assert m.num_intervals == before
+        m.bad_events(1)  # unpauses and clears counters
+        assert not m.paused
+
+    def test_store_persistence_roundtrip(self):
+        class MemDB(dict):
+            def get(self, k):
+                return dict.get(self, k)
+
+            def set(self, k, v):
+                self[k] = v
+
+        db = MemDB()
+        store = TrustMetricStore(db=db)
+        m = store.get_peer_trust_metric("peer-a")
+        for _ in range(5):
+            m.good_events(2)
+            m.bad_events(1)
+            m.advance()
+        val = m.trust_value()
+        store.save()
+        store2 = TrustMetricStore(db=db)
+        assert store2.size() == 1
+        m2 = store2.get_peer_trust_metric("peer-a")
+        # restored history reproduces the same history value
+        assert abs(m2.history_value - m.history_value) < 1e-9
+        assert abs(m2.trust_value() - val) < 0.5  # fresh interval counters
+
+    def test_corrupt_persisted_blob_tolerated(self):
+        """Truncated/inconsistent saved histories must not crash startup:
+        intervals claimed without supporting history data are clamped."""
+        import json
+
+        class MemDB(dict):
+            def get(self, k):
+                return dict.get(self, k)
+
+            def set(self, k, v):
+                self[k] = v
+
+        db = MemDB()
+        db.set(
+            TrustMetricStore._KEY,
+            json.dumps(
+                {
+                    "empty-hist": {"intervals": 5, "history": []},
+                    "short-hist": {"intervals": 9, "history": [0.5]},
+                    "not-a-dict": 42,
+                    "ok": {"intervals": 1, "history": [0.75]},
+                }
+            ).encode(),
+        )
+        store = TrustMetricStore(db=db)
+        # every loadable peer restores; none crash
+        m = store.get_peer_trust_metric("empty-hist")
+        assert m.num_intervals == 0 and m.trust_score() == 100
+        m2 = store.get_peer_trust_metric("short-hist")
+        assert m2.num_intervals >= 1  # clamped to what [0.5] supports
+        assert 0.0 <= m2.trust_value() <= 1.0
+        m3 = store.get_peer_trust_metric("ok")
+        assert abs(m3.history_value - 0.75) < 1e-9
+
+    def test_concurrent_tick_single_advance(self):
+        import threading as th
+
+        m = TrustMetric(interval_s=0.01)
+        m.good_events(1)
+        import time as _t
+
+        _t.sleep(0.02)
+        before = m.num_intervals
+        ts = [th.Thread(target=m.tick) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        # elapsed interval consumed exactly once per boundary crossing
+        assert m.num_intervals >= before + 1
+
+    def test_disconnected_peer_paused(self):
+        store = TrustMetricStore()
+        m = store.get_peer_trust_metric("p")
+        store.peer_disconnected("p")
+        assert m.paused
+
+
+DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+  <device>
+    <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+    <deviceList><device>
+      <deviceType>urn:schemas-upnp-org:device:WANDevice:1</deviceType>
+      <deviceList><device>
+        <deviceType>urn:schemas-upnp-org:device:WANConnectionDevice:1</deviceType>
+        <serviceList><service>
+          <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+          <controlURL>/ctl/IPConn</controlURL>
+        </service></serviceList>
+      </device></deviceList>
+    </device></deviceList>
+  </device>
+</root>"""
+
+
+class _FakeGateway(http.server.BaseHTTPRequestHandler):
+    actions = []
+
+    def do_GET(self):
+        body = DESC_XML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        payload = self.rfile.read(length).decode()
+        action = self.headers.get("SOAPAction", "")
+        type(self).actions.append((action, payload))
+        if "GetExternalIPAddress" in action:
+            body = (
+                b"<s:Envelope><s:Body><u:GetExternalIPAddressResponse>"
+                b"<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+                b"</u:GetExternalIPAddressResponse></s:Body></s:Envelope>"
+            )
+        else:
+            body = b"<s:Envelope><s:Body/></s:Envelope>"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def fake_gateway():
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _FakeGateway)
+    _FakeGateway.actions = []
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    # SSDP responder on localhost UDP
+    ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ssdp.bind(("127.0.0.1", 0))
+    loc = f"http://127.0.0.1:{httpd.server_address[1]}/rootDesc.xml"
+
+    def responder():
+        try:
+            data, addr = ssdp.recvfrom(2048)
+            if b"M-SEARCH" in data:
+                resp = (
+                    "HTTP/1.1 200 OK\r\n"
+                    "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n"
+                    f"LOCATION: {loc}\r\n\r\n"
+                ).encode()
+                ssdp.sendto(resp, addr)
+        except OSError:
+            pass
+
+    rt = threading.Thread(target=responder, daemon=True)
+    rt.start()
+    yield ssdp.getsockname(), httpd.server_address[1]
+    httpd.shutdown()
+    ssdp.close()
+
+
+class TestUPnP:
+    def test_discover_and_map(self, fake_gateway):
+        ssdp_addr, _ = fake_gateway
+        nat = upnp.discover(timeout=3.0, ssdp_addr=ssdp_addr, attempts=1)
+        assert nat.urn_domain == "schemas-upnp-org"
+        assert nat.control_url.endswith("/ctl/IPConn")
+        assert nat.get_external_address() == "203.0.113.7"
+        assert nat.add_port_mapping("tcp", 26656, 26656, "tendermint") == 26656
+        nat.delete_port_mapping("tcp", 26656)
+        acts = [a for a, _ in _FakeGateway.actions]
+        assert any("GetExternalIPAddress" in a for a in acts)
+        assert any("AddPortMapping" in a for a in acts)
+        assert any("DeletePortMapping" in a for a in acts)
+        # the SOAP body carries the internal client and lease fields
+        add_payload = next(p for a, p in _FakeGateway.actions if "AddPortMapping" in a)
+        assert "<NewInternalClient>" in add_payload
+        assert "<NewLeaseDuration>0</NewLeaseDuration>" in add_payload
+
+    def test_parse_ssdp_rejects_non_gateway(self):
+        resp = b"HTTP/1.1 200 OK\r\nST: upnp:rootdevice\r\nLOCATION: http://x/\r\n\r\n"
+        assert upnp.parse_ssdp_response(resp) is None
+
+    def test_discover_timeout(self):
+        sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sink.bind(("127.0.0.1", 0))  # never answers
+        try:
+            with pytest.raises(upnp.UPnPError):
+                upnp.discover(timeout=0.3, ssdp_addr=sink.getsockname(), attempts=1)
+        finally:
+            sink.close()
