@@ -636,6 +636,29 @@ static void append_message(Strobe &s, const uint8_t *label, size_t ln,
 }  // namespace merlin
 
 // sr25519_challenges(ctx, pubs, rs, msgs) -> n x 64-byte challenge bytes.
+// Shared schnorrkel signing-transcript framing (consensus-critical label
+// sequence) -> the 64-byte "sign:c" challenge. Used by both the
+// challenge-only and full-verify lanes so the framing cannot diverge.
+static void sr25519_challenge_64(const uint8_t *ctx, size_t ctx_len,
+                                 const uint8_t *msg, size_t msg_len,
+                                 const uint8_t *pub, const uint8_t *r,
+                                 uint8_t out[64]) {
+  merlin::Strobe s;
+  s.init((const uint8_t *)"Merlin v1.0", 11);
+  merlin::append_message(s, (const uint8_t *)"dom-sep", 7,
+                         (const uint8_t *)"SigningContext", 14);
+  merlin::append_message(s, (const uint8_t *)"", 0, ctx, ctx_len);
+  merlin::append_message(s, (const uint8_t *)"sign-bytes", 10, msg, msg_len);
+  merlin::append_message(s, (const uint8_t *)"proto-name", 10,
+                         (const uint8_t *)"Schnorr-sig", 11);
+  merlin::append_message(s, (const uint8_t *)"sign:pk", 7, pub, 32);
+  merlin::append_message(s, (const uint8_t *)"sign:R", 6, r, 32);
+  uint8_t le[4] = {64, 0, 0, 0};
+  s.meta_ad((const uint8_t *)"sign:c", 6, false);
+  s.meta_ad(le, 4, true);
+  s.prf(out, 64);
+}
+
 static PyObject *py_sr25519_challenges(PyObject *, PyObject *args) {
   const char *ctx_buf, *pubs, *rs;
   Py_ssize_t ctx_len, pubs_len, rs_len;
@@ -666,28 +689,380 @@ static PyObject *py_sr25519_challenges(PyObject *, PyObject *args) {
       Py_DECREF(out);
       return nullptr;
     }
-    merlin::Strobe s;
-    s.init((const uint8_t *)"Merlin v1.0", 11);
-    merlin::append_message(s, (const uint8_t *)"dom-sep", 7,
-                           (const uint8_t *)"SigningContext", 14);
-    merlin::append_message(s, (const uint8_t *)"", 0, (const uint8_t *)ctx_buf,
-                           (size_t)ctx_len);
-    merlin::append_message(s, (const uint8_t *)"sign-bytes", 10,
-                           (const uint8_t *)m, (size_t)mlen);
-    merlin::append_message(s, (const uint8_t *)"proto-name", 10,
-                           (const uint8_t *)"Schnorr-sig", 11);
-    merlin::append_message(s, (const uint8_t *)"sign:pk", 7,
-                           (const uint8_t *)(pubs + 32 * i), 32);
-    merlin::append_message(s, (const uint8_t *)"sign:R", 6,
-                           (const uint8_t *)(rs + 32 * i), 32);
-    uint8_t le[4] = {64, 0, 0, 0};
-    s.meta_ad((const uint8_t *)"sign:c", 6, false);
-    s.meta_ad(le, 4, true);
-    s.prf(dst + 64 * i, 64);
+    sr25519_challenge_64((const uint8_t *)ctx_buf, (size_t)ctx_len,
+                         (const uint8_t *)m, (size_t)mlen,
+                         (const uint8_t *)(pubs + 32 * i),
+                         (const uint8_t *)(rs + 32 * i), dst + 64 * i);
   }
   Py_DECREF(seq);
   return out;
 }
+
+// --------------------------------------------------------------------------
+// GF(2^255-19) + edwards25519 + ristretto255 — the native sr25519
+// verification lane (crypto/sr25519/: schnorrkel R == [s]B - [k]A). The
+// pure-Python crypto/_ristretto.py is the differential oracle; formulas
+// mirror crypto/_edwards.py (add-2008-hwcd-3 / dbl-2008-hwcd, a=-1).
+
+namespace ed {
+
+typedef uint64_t fe[5];  // radix-2^51
+static const uint64_t MASK51 = 0x7ffffffffffffULL;
+
+static const fe D_FE = {0x34dca135978a3ULL, 0x1a8283b156ebdULL, 0x5e7a26001c029ULL, 0x739c663a03cbbULL, 0x52036cee2b6ffULL};
+static const fe D2_FE = {0x69b9426b2f159ULL, 0x35050762add7aULL, 0x3cf44c0038052ULL, 0x6738cc7407977ULL, 0x2406d9dc56dffULL};
+static const fe SQRT_M1_FE = {0x61b274a0ea0b0ULL, 0xd5a5fc8f189dULL, 0x7ef5e9cbd0c60ULL, 0x78595a6804c9eULL, 0x2b8324804fc1dULL};
+static const fe BASE_X_FE = {0x62d608f25d51aULL, 0x412a4b4f6592aULL, 0x75b7171a4b31dULL, 0x1ff60527118feULL, 0x216936d3cd6e5ULL};
+static const fe BASE_Y_FE = {0x6666666666658ULL, 0x4ccccccccccccULL, 0x1999999999999ULL, 0x3333333333333ULL, 0x6666666666666ULL};
+static const fe BASE_T_FE = {0x68ab3a5b7dda3ULL, 0xeea2a5eadbbULL, 0x2af8df483c27eULL, 0x332b375274732ULL, 0x67875f0fd78b7ULL};
+static const uint8_t POW_P58_BYTES[32] = {
+    0xfd, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x0f};
+
+static void fe_copy(fe h, const fe a) { memcpy(h, a, sizeof(fe)); }
+static void fe_zero(fe h) { memset(h, 0, sizeof(fe)); }
+static void fe_one(fe h) { fe_zero(h); h[0] = 1; }
+
+static void fe_add(fe h, const fe a, const fe b) {
+  for (int i = 0; i < 5; i++) h[i] = a[i] + b[i];
+}
+
+// h = a - b; adds 2p per limb to stay positive (inputs < 2^52)
+static void fe_sub(fe h, const fe a, const fe b) {
+  static const uint64_t TWO_P[5] = {0xfffffffffffdaULL, 0xffffffffffffeULL,
+                                    0xffffffffffffeULL, 0xffffffffffffeULL,
+                                    0xffffffffffffeULL};
+  for (int i = 0; i < 5; i++) h[i] = a[i] + TWO_P[i] - b[i];
+}
+
+// carry-propagate so every limb < 2^51 (values stay mod p)
+static void fe_carry(fe h) {
+  uint64_t c;
+  for (int r = 0; r < 2; r++) {
+    c = h[0] >> 51; h[0] &= MASK51; h[1] += c;
+    c = h[1] >> 51; h[1] &= MASK51; h[2] += c;
+    c = h[2] >> 51; h[2] &= MASK51; h[3] += c;
+    c = h[3] >> 51; h[3] &= MASK51; h[4] += c;
+    c = h[4] >> 51; h[4] &= MASK51; h[0] += c * 19;
+  }
+}
+
+static void fe_mul(fe h, const fe a, const fe b) {
+  unsigned __int128 t[5] = {0, 0, 0, 0, 0};
+  for (int i = 0; i < 5; i++) {
+    for (int j = 0; j < 5; j++) {
+      int k = i + j;
+      unsigned __int128 prod = (unsigned __int128)a[i] * b[j];
+      if (k >= 5) {
+        k -= 5;
+        prod *= 19;
+      }
+      t[k] += prod;
+    }
+  }
+  // carry chain (each t[i] < ~2^115, fits)
+  uint64_t r[5];
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 5; i++) {
+    t[i] += c;
+    r[i] = (uint64_t)(t[i] & MASK51);
+    c = t[i] >> 51;
+  }
+  r[0] += (uint64_t)(c * 19);
+  memcpy(h, r, sizeof r);
+  fe_carry(h);
+}
+
+static void fe_sq(fe h, const fe a) { fe_mul(h, a, a); }
+
+// canonical little-endian bytes (full reduction)
+static void fe_tobytes(uint8_t out[32], const fe a) {
+  fe t;
+  fe_copy(t, a);
+  fe_carry(t);
+  // final conditional subtract p (possibly twice)
+  for (int rep = 0; rep < 2; rep++) {
+    uint64_t borrow_p[5] = {0x7ffffffffffedULL, MASK51, MASK51, MASK51, MASK51};
+    bool ge = true;
+    for (int i = 4; i >= 0; i--) {
+      if (t[i] > borrow_p[i]) break;
+      if (t[i] < borrow_p[i]) { ge = false; break; }
+    }
+    if (!ge) break;
+    uint64_t borrow = 0;
+    for (int i = 0; i < 5; i++) {
+      uint64_t d = t[i] - borrow_p[i] - borrow;
+      borrow = (t[i] < borrow_p[i] + borrow) ? 1 : 0;
+      t[i] = d & MASK51;
+    }
+  }
+  uint64_t w0 = t[0] | (t[1] << 51);
+  uint64_t w1 = (t[1] >> 13) | (t[2] << 38);
+  uint64_t w2 = (t[2] >> 26) | (t[3] << 25);
+  uint64_t w3 = (t[3] >> 39) | (t[4] << 12);
+  uint64_t ws[4] = {w0, w1, w2, w3};
+  for (int i = 0; i < 4; i++)
+    for (int b = 0; b < 8; b++) out[8 * i + b] = (uint8_t)(ws[i] >> (8 * b));
+}
+
+static void fe_frombytes(fe h, const uint8_t in[32]) {
+  uint64_t w[4];
+  for (int i = 0; i < 4; i++) {
+    w[i] = 0;
+    for (int b = 0; b < 8; b++) w[i] |= (uint64_t)in[8 * i + b] << (8 * b);
+  }
+  h[0] = w[0] & MASK51;
+  h[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+  h[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+  h[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+  h[4] = (w[3] >> 12) & MASK51;  // drops bit 255
+}
+
+static bool fe_is_negative(const fe a) {
+  uint8_t b[32];
+  fe_tobytes(b, a);
+  return b[0] & 1;
+}
+
+static bool fe_is_zero(const fe a) {
+  uint8_t b[32];
+  fe_tobytes(b, a);
+  for (int i = 0; i < 32; i++)
+    if (b[i]) return false;
+  return true;
+}
+
+static bool fe_eq(const fe a, const fe b) {
+  fe d;
+  fe_sub(d, a, b);
+  return fe_is_zero(d);
+}
+
+static void fe_neg(fe h, const fe a) {
+  fe z;
+  fe_zero(z);
+  fe_sub(h, z, a);
+  fe_carry(h);
+}
+
+// a^((p-5)/8) by square-and-multiply over the constant exponent
+static void fe_pow_p58(fe h, const fe a) {
+  fe result, base;
+  fe_one(result);
+  fe_copy(base, a);
+  for (int bit = 0; bit < 252; bit++) {
+    if ((POW_P58_BYTES[bit >> 3] >> (bit & 7)) & 1) fe_mul(result, result, base);
+    if (bit != 251) fe_sq(base, base);
+  }
+  fe_copy(h, result);
+}
+
+// _edwards._sqrt_ratio: r with v*r^2 == u, or false (r undefined)
+static bool fe_sqrt_ratio(fe r, const fe u, const fe v) {
+  fe v3, v7, t, uv7, pw;
+  fe_sq(v3, v);
+  fe_mul(v3, v3, v);       // v^3
+  fe_sq(v7, v3);
+  fe_mul(v7, v7, v);       // v^7
+  fe_mul(uv7, u, v7);
+  fe_pow_p58(pw, uv7);     // (u v^7)^((p-5)/8)
+  fe_mul(t, u, v3);
+  fe_mul(r, t, pw);        // u v^3 (u v^7)^((p-5)/8)
+  fe check;
+  fe_sq(check, r);
+  fe_mul(check, check, v);  // v r^2
+  if (fe_eq(check, u)) return true;
+  fe nu;
+  fe_neg(nu, u);
+  if (fe_eq(check, nu)) {
+    fe_mul(r, r, SQRT_M1_FE);
+    return true;
+  }
+  return false;
+}
+
+// _ristretto._invsqrt: (was_square, 1/sqrt(u)); u=0 -> (true, 0)
+static bool fe_invsqrt(fe r, const fe u) {
+  if (fe_is_zero(u)) {
+    fe_zero(r);
+    return true;
+  }
+  fe one;
+  fe_one(one);
+  if (fe_sqrt_ratio(r, one, u)) return true;
+  // not a square: r = sqrt(i/u) (decode rejects via ok=false anyway)
+  fe_sqrt_ratio(r, SQRT_M1_FE, u);
+  return false;
+}
+
+struct point {
+  fe x, y, z, t;
+};
+
+static void pt_identity(point &p) {
+  fe_zero(p.x);
+  fe_one(p.y);
+  fe_one(p.z);
+  fe_zero(p.t);
+}
+
+// add-2008-hwcd-3, a=-1 (crypto/_edwards.py point_add)
+static void pt_add(point &h, const point &p, const point &q) {
+  fe a, b, c, d, e, f, g, hh, t1, t2;
+  fe_sub(t1, p.y, p.x);
+  fe_sub(t2, q.y, q.x);
+  fe_carry(t1);
+  fe_carry(t2);
+  fe_mul(a, t1, t2);
+  fe_add(t1, p.y, p.x);
+  fe_add(t2, q.y, q.x);
+  fe_carry(t1);
+  fe_carry(t2);
+  fe_mul(b, t1, t2);
+  fe_mul(c, p.t, D2_FE);
+  fe_mul(c, c, q.t);
+  fe_mul(d, p.z, q.z);
+  fe_add(d, d, d);
+  fe_carry(d);
+  fe_sub(e, b, a);
+  fe_sub(f, d, c);
+  fe_add(g, d, c);
+  fe_add(hh, b, a);
+  fe_carry(e);
+  fe_carry(f);
+  fe_carry(g);
+  fe_carry(hh);
+  fe_mul(h.x, e, f);
+  fe_mul(h.y, g, hh);
+  fe_mul(h.z, f, g);
+  fe_mul(h.t, e, hh);
+}
+
+// dbl-2008-hwcd, a=-1 (crypto/_edwards.py point_double)
+static void pt_double(point &h, const point &p) {
+  fe a, b, c, d, e, f, g, hh, t1;
+  fe_sq(a, p.x);
+  fe_sq(b, p.y);
+  fe_sq(c, p.z);
+  fe_add(c, c, c);
+  fe_carry(c);
+  fe_neg(d, a);
+  fe_add(t1, p.x, p.y);
+  fe_carry(t1);
+  fe_sq(e, t1);
+  fe_sub(e, e, a);
+  fe_sub(e, e, b);
+  fe_carry(e);
+  fe_add(g, d, b);
+  fe_carry(g);
+  fe_sub(f, g, c);
+  fe_carry(f);
+  fe_sub(hh, d, b);
+  fe_carry(hh);
+  fe_mul(h.x, e, f);
+  fe_mul(h.y, g, hh);
+  fe_mul(h.z, f, g);
+  fe_mul(h.t, e, hh);
+}
+
+static void pt_neg(point &h, const point &p) {
+  fe_neg(h.x, p.x);
+  fe_copy(h.y, p.y);
+  fe_copy(h.z, p.z);
+  fe_neg(h.t, p.t);
+}
+
+// 4-bit fixed-window scalar multiply: scalar is 32 LE bytes (< L)
+static void pt_scalar_mul(point &h, const uint8_t scalar[32], const point &p) {
+  point table[16];
+  pt_identity(table[0]);
+  table[1] = p;
+  for (int i = 2; i < 16; i++) pt_add(table[i], table[i - 1], p);
+  pt_identity(h);
+  bool started = false;
+  for (int i = 63; i >= 0; i--) {
+    int nib = (scalar[i >> 1] >> ((i & 1) ? 4 : 0)) & 0xf;
+    if (started) {
+      pt_double(h, h);
+      pt_double(h, h);
+      pt_double(h, h);
+      pt_double(h, h);
+    }
+    if (nib) {
+      if (started) {
+        pt_add(h, h, table[nib]);
+      } else {
+        h = table[nib];
+        started = true;
+      }
+    } else if (started) {
+      // nothing to add
+    }
+  }
+}
+
+// ristretto255 DECODE (crypto/_ristretto.py decode); false on reject
+static bool ristretto_decode(point &out, const uint8_t in[32]) {
+  // reject s >= p or negative (odd)
+  static const uint8_t P_BYTES[32] = {
+      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  bool lt = false;
+  for (int i = 31; i >= 0; i--) {
+    if (in[i] < P_BYTES[i]) { lt = true; break; }
+    if (in[i] > P_BYTES[i]) return false;
+  }
+  if (!lt) return false;          // s == p
+  if (in[0] & 1) return false;    // negative
+  fe s, ss, u1, u2, u2s, v, t1, t2, one;
+  fe_frombytes(s, in);
+  fe_one(one);
+  fe_sq(ss, s);
+  fe_sub(u1, one, ss);
+  fe_carry(u1);
+  fe_add(u2, one, ss);
+  fe_carry(u2);
+  fe_sq(u2s, u2);
+  fe_mul(t1, D_FE, u1);
+  fe_mul(t1, t1, u1);
+  fe_neg(t1, t1);
+  fe_sub(v, t1, u2s);
+  fe_carry(v);
+  fe invsq, vu2s;
+  fe_mul(vu2s, v, u2s);
+  bool ok = fe_invsqrt(invsq, vu2s);
+  fe den_x, den_y, x, y, t;
+  fe_mul(den_x, invsq, u2);
+  fe_mul(den_y, invsq, den_x);
+  fe_mul(den_y, den_y, v);
+  fe_add(t1, s, s);
+  fe_carry(t1);
+  fe_mul(x, t1, den_x);
+  if (fe_is_negative(x)) fe_neg(x, x);
+  fe_mul(y, u1, den_y);
+  fe_mul(t, x, y);
+  if (!ok || fe_is_negative(t) || fe_is_zero(y)) return false;
+  fe_copy(out.x, x);
+  fe_copy(out.y, y);
+  fe_one(out.z);
+  fe_copy(out.t, t);
+  return true;
+}
+
+// ristretto equality: x1 y2 == y1 x2 or y1 y2 == x1 x2
+static bool ristretto_eq(const point &a, const point &b) {
+  fe l, r;
+  fe_mul(l, a.x, b.y);
+  fe_mul(r, a.y, b.x);
+  if (fe_eq(l, r)) return true;
+  fe_mul(l, a.y, b.y);
+  fe_mul(r, a.x, b.x);
+  return fe_eq(l, r);
+}
+
+}  // namespace ed
 
 // OpenSSL's asm SHA-512 when libcrypto is present (no dev headers in the
 // image, so resolve the one-shot SHA512() via dlopen; the scalar
@@ -773,9 +1148,106 @@ static PyObject *py_ed25519_challenges(PyObject *, PyObject *args) {
   return out;
 }
 
+// sr25519_verify_batch(ctx: bytes, pubs: n*32, sigs: n*64, msgs: seq)
+//   -> bytes (n): 1 where R == [s]B - [k]A (schnorrkel verify), else 0.
+// Transcript framing identical to sr25519_challenges; k = challenge mod L.
+static PyObject *py_sr25519_verify_batch(PyObject *, PyObject *args) {
+  const char *ctx_buf;
+  Py_ssize_t ctx_len;
+  Py_buffer pubs, sigs;
+  PyObject *msgs;
+  if (!PyArg_ParseTuple(args, "y#y*y*O", &ctx_buf, &ctx_len, &pubs, &sigs,
+                        &msgs))
+    return nullptr;
+  PyObject *seq = PySequence_Fast(msgs, "expected a sequence of messages");
+  if (!seq) {
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (pubs.len < 32 * n || sigs.len < 64 * n) {
+    Py_DECREF(seq);
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    PyErr_SetString(PyExc_ValueError, "pubs/sigs must be n*32 / n*64 bytes");
+    return nullptr;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, n);
+  if (!out) {
+    Py_DECREF(seq);
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    return nullptr;
+  }
+  uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(out);
+  const uint8_t *pp = (const uint8_t *)pubs.buf;
+  const uint8_t *sp = (const uint8_t *)sigs.buf;
+  ed::point base;
+  ed::fe_copy(base.x, ed::BASE_X_FE);
+  ed::fe_copy(base.y, ed::BASE_Y_FE);
+  ed::fe_one(base.z);
+  ed::fe_copy(base.t, ed::BASE_T_FE);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    dst[i] = 0;
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    char *m;
+    Py_ssize_t mlen;
+    if (PyBytes_AsStringAndSize(item, &m, &mlen) < 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      PyBuffer_Release(&pubs);
+      PyBuffer_Release(&sigs);
+      return nullptr;
+    }
+    const uint8_t *sig = sp + 64 * i;
+    const uint8_t *pub = pp + 32 * i;
+    if (!(sig[63] & 0x80)) continue;  // schnorrkel v1 marker
+    uint8_t s_bytes[32];
+    memcpy(s_bytes, sig + 32, 32);
+    s_bytes[31] &= 0x7f;
+    // s < L check (L = limbs sha512::L_LIMBS, little-endian u64)
+    {
+      uint64_t s_limbs[4];
+      for (int j = 0; j < 4; j++) {
+        s_limbs[j] = 0;
+        for (int b = 0; b < 8; b++)
+          s_limbs[j] |= (uint64_t)s_bytes[8 * j + b] << (8 * b);
+      }
+      bool lt = false, ge = false;
+      for (int j = 3; j >= 0; j--) {
+        if (s_limbs[j] < sha512::L_LIMBS[j]) { lt = true; break; }
+        if (s_limbs[j] > sha512::L_LIMBS[j]) { ge = true; break; }
+      }
+      if (ge || !lt) continue;  // s >= L
+    }
+    ed::point A, R;
+    if (!ed::ristretto_decode(A, pub)) continue;
+    if (!ed::ristretto_decode(R, sig)) continue;
+    // k = merlin challenge mod L (same framing as sr25519_challenges)
+    uint8_t k_wide[64], k_bytes[32];
+    sr25519_challenge_64((const uint8_t *)ctx_buf, (size_t)ctx_len,
+                         (const uint8_t *)m, (size_t)mlen, pub, sig, k_wide);
+    sha512::mod_l(k_wide, k_bytes);
+    // expected = [s]B + [k](-A); accept iff ristretto_eq(expected, R)
+    ed::point sB, kA, negA, expected;
+    ed::pt_scalar_mul(sB, s_bytes, base);
+    ed::pt_neg(negA, A);
+    ed::pt_scalar_mul(kA, k_bytes, negA);
+    ed::pt_add(expected, sB, kA);
+    dst[i] = ed::ristretto_eq(expected, R) ? 1 : 0;
+  }
+  Py_DECREF(seq);
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&sigs);
+  return out;
+}
+
 static PyMethodDef Methods[] = {
     {"ed25519_challenges", py_ed25519_challenges, METH_VARARGS,
      "Batch k = SHA512(R||A||M) mod L challenge scalars (32B LE each)"},
+    {"sr25519_verify_batch", py_sr25519_verify_batch, METH_VARARGS,
+     "Batch schnorrkel sr25519 verification (R == [s]B - [k]A)"},
     {"merkle_root", py_merkle_root, METH_VARARGS,
      "RFC-6962 merkle root of a list of byte strings"},
     {"sha256_many", py_sha256_many, METH_VARARGS,

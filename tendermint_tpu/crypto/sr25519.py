@@ -147,6 +147,30 @@ class PrivKey(_PrivKey):
         return KEY_TYPE
 
 
+def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+    """Per-signature verdicts for (pub, msg, sig) triples. Routes through
+    the native C lane (tm_native.sr25519_verify_batch — full schnorrkel
+    verify incl. merlin transcript, ~16x the pure-Python path) when built;
+    the Python implementation is the fallback and differential oracle."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None and hasattr(native, "sr25519_verify_batch"):
+        ok_shape = all(
+            len(p) == PUB_KEY_SIZE and len(s) == SIGNATURE_SIZE
+            for p, _, s in entries
+        )
+        if ok_shape:
+            out = native.sr25519_verify_batch(
+                SIGNING_CTX,
+                b"".join(p for p, _, _ in entries),
+                b"".join(s for _, _, s in entries),
+                [m for _, m, _ in entries],
+            )
+            return [bool(b) for b in out]
+    return [verify(p, m, s) for p, m, s in entries]
+
+
 class BatchVerifier(_BatchVerifier):
     """crypto/sr25519/batch.go:13-19 semantics (per-sig evaluation)."""
 
@@ -161,7 +185,7 @@ class BatchVerifier(_BatchVerifier):
         self._entries.append((key.bytes(), msg, sig))
 
     def verify(self) -> Tuple[bool, List[bool]]:
-        valid = [verify(p, m, s) for p, m, s in self._entries]
+        valid = verify_batch(self._entries)
         return all(valid) and len(valid) > 0, valid
 
 

@@ -59,6 +59,11 @@ class TrustMetric:
         self.proportional_weight = proportional_weight
         self.integral_weight = integral_weight
         self.interval_s = interval_s
+        if tracking_window_s < interval_s:
+            raise ValueError(
+                "tracking_window_s must be at least interval_s "
+                f"({tracking_window_s} < {interval_s})"
+            )
         self.max_intervals = int(tracking_window_s / interval_s)
         self.history_max_size = _interval_to_history_offset(self.max_intervals) + 1
         self.num_intervals = 0
@@ -270,6 +275,8 @@ class TrustMetricStore:
             peers = json.loads(raw.decode())
         except (ValueError, UnicodeDecodeError):
             return
+        if not isinstance(peers, dict):
+            return  # corrupt top level: start every peer fresh
         for pid, hist in peers.items():
             m = TrustMetric(**self._kwargs)
             try:

@@ -117,3 +117,60 @@ class TestNative:
                 os.environ["TM_TPU_NO_NATIVE"] = prior
             nat._module, nat._tried = None, False
         assert backend._challenges(r_enc, pub, msgs) == pure
+
+    def test_sr25519_verify_batch_differential(self):
+        """Native schnorrkel verify vs the pure-Python oracle across
+        valid/tampered/edge signatures."""
+        from tendermint_tpu.crypto import sr25519
+        from tendermint_tpu.native import load
+
+        m = load()
+        if m is None or not hasattr(m, "sr25519_verify_batch"):
+            import pytest
+
+            pytest.skip("no native sr25519")
+        rng = random.Random(5)
+        keys = [sr25519.gen_priv_key(bytes([i]) * 32) for i in range(4)]
+        pubs, sigs, msgs = [], [], []
+        for i in range(48):
+            sk = keys[i % 4]
+            msg = rng.randbytes(rng.randrange(0, 120))
+            sig = sk.sign(msg)
+            pub = sk.pub_key().bytes()
+            kind = i % 6
+            if kind == 1:
+                sig = bytes([sig[0] ^ 1]) + sig[1:]
+            elif kind == 2:
+                sig = sig[:40] + bytes([sig[40] ^ 4]) + sig[41:]
+            elif kind == 3:
+                msg = msg + b"!"
+            elif kind == 4:
+                sig = sig[:63] + bytes([sig[63] & 0x7F])
+            elif kind == 5:
+                pub = keys[(i + 1) % 4].pub_key().bytes()
+            pubs.append(pub)
+            sigs.append(sig)
+            msgs.append(msg)
+        out = m.sr25519_verify_batch(
+            b"substrate", b"".join(pubs), b"".join(sigs), msgs
+        )
+        expect = [sr25519.verify(p, mm, s) for p, mm, s in zip(pubs, msgs, sigs)]
+        assert [bool(b) for b in out] == expect
+
+    def test_sr25519_crypto_batch_uses_native(self):
+        """crypto.sr25519.BatchVerifier agrees with per-sig verify and
+        pinpoints the bad index."""
+        from tendermint_tpu.crypto import sr25519
+
+        sk = sr25519.gen_priv_key(b"\x07" * 32)
+        bv = sr25519.BatchVerifier()
+        msgs = [b"m%d" % i for i in range(10)]
+        for i, msg in enumerate(msgs):
+            sig = sk.sign(msg)
+            if i == 4:
+                sig = sig[:1] + bytes([sig[1] ^ 1]) + sig[2:]
+            bv.add(sk.pub_key(), msg, sig)
+        ok, valid = bv.verify()
+        assert not ok
+        assert valid[4] is False or valid[4] == 0
+        assert sum(1 for v in valid if not v) == 1

@@ -124,6 +124,10 @@ class TestTrustMetric:
         )
         store = TrustMetricStore(db=db)
         # every loadable peer restores; none crash
+        # top-level non-dict must also be tolerated
+        db2 = MemDB()
+        db2.set(TrustMetricStore._KEY, b"[1,2,3]")
+        assert TrustMetricStore(db=db2).size() == 0
         m = store.get_peer_trust_metric("empty-hist")
         assert m.num_intervals == 0 and m.trust_score() == 100
         m2 = store.get_peer_trust_metric("short-hist")
