@@ -476,6 +476,21 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """probe-upnp (cmd/tendermint/commands/probe_upnp.go): discover a
+    UPnP gateway, map/unmap a test port, print the capabilities JSON."""
+    from .p2p import upnp
+
+    try:
+        caps = upnp.probe(int_port=args.int_port, ext_port=args.ext_port,
+                          timeout=args.timeout)
+    except upnp.UPnPError as e:
+        print(f"Probe failed: {e}")
+        return 1
+    print(json.dumps({"port_mapping": caps.port_mapping, "hairpin": caps.hairpin}))
+    return 0
+
+
 def cmd_reset_unsafe(args) -> int:
     """unsafe-reset-all: wipe data, keep config + priv key state zeroed."""
     data = os.path.join(args.home, "data")
@@ -530,6 +545,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--key-file", default="", help="local FilePV key file")
     sp.add_argument("--expect-key-file", default="")
     sp.add_argument("--chain-id", default="signer-harness")
+    sp = sub.add_parser("probe-upnp")
+    sp.add_argument("--int-port", type=int, default=8001)
+    sp.add_argument("--ext-port", type=int, default=8001)
+    sp.add_argument("--timeout", type=float, default=3.0)
     sub.add_parser("rollback")
     sub.add_parser("inspect")
     sub.add_parser("unsafe-reset-all")
@@ -551,6 +570,7 @@ COMMANDS = {
     "reindex-event": cmd_reindex_event,
     "light": cmd_light,
     "signer-harness": cmd_signer_harness,
+    "probe-upnp": cmd_probe_upnp,
     "rollback": cmd_rollback,
     "inspect": cmd_inspect,
     "unsafe-reset-all": cmd_reset_unsafe,

@@ -12,6 +12,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -62,6 +63,7 @@ class _WrappedTx:
     sender: str = field(compare=False, default="")
     gas_wanted: int = field(compare=False, default=0)
     height: int = field(compare=False, default=0)
+    timestamp: float = field(compare=False, default=0.0)
     seq: int = field(compare=False, default=0)
     removed: bool = field(compare=False, default=False)
 
@@ -135,8 +137,16 @@ class TxMempool:
                 if len(self._tx_by_key) >= self._cfg.size or (
                     self._size_bytes + len(tx) > self._cfg.max_txs_bytes
                 ):
-                    self._cache.remove(tx)
-                    raise MempoolFullError(len(self._tx_by_key))
+                    # full: evict strictly-lower-priority txs to make room
+                    # (mempool.go:498 + priority_queue.go GetEvictableTxs);
+                    # reject when no such set frees enough capacity
+                    victims = self._evictable_locked(res.priority, len(tx))
+                    if not victims:
+                        self._cache.remove(tx)
+                        raise MempoolFullError(len(self._tx_by_key))
+                    for v in victims:
+                        self._remove_tx(v.key)
+                        self._cache.remove(v.tx)
                 was_empty = not self._tx_by_key
                 wtx = _WrappedTx(
                     sort_key=(-res.priority, next(self._seq)),
@@ -146,6 +156,7 @@ class TxMempool:
                     sender=res.sender or sender,
                     gas_wanted=res.gas_wanted,
                     height=self._height,
+                    timestamp=time.time(),
                 )
                 self._tx_by_key[wtx.key] = wtx
                 self._fifo.append(wtx)
@@ -158,6 +169,29 @@ class TxMempool:
         if callback is not None:
             callback(res)
         return res
+
+    def _evictable_locked(self, priority: int, tx_size: int) -> List[_WrappedTx]:
+        """priority_queue.go:34 GetEvictableTxs: ascending-priority txs
+        strictly below `priority`, taken until the new tx fits both the
+        byte and count budgets; empty when impossible."""
+        candidates = sorted(
+            self._tx_by_key.values(), key=lambda w: (w.priority, -w.seq)
+        )
+        victims: List[_WrappedTx] = []
+        bytes_after = self._size_bytes
+        count_after = len(self._tx_by_key)
+        for w in candidates:
+            if w.priority >= priority:
+                break
+            victims.append(w)
+            bytes_after -= len(w.tx)
+            count_after -= 1
+            if (
+                bytes_after + tx_size <= self._cfg.max_txs_bytes
+                and count_after < self._cfg.size
+            ):
+                return victims
+        return []
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         """mempool.go:344-402: highest priority first, FIFO within equal
@@ -222,8 +256,26 @@ class TxMempool:
             elif not self._cfg.keep_invalid_txs_in_cache:
                 self._cache.remove(tx)
             self._remove_tx(tx_key(tx))
+        self._purge_expired_txs()
         if self._cfg.recheck and self._tx_by_key:
             self._recheck_txs()
+
+    def _purge_expired_txs(self) -> None:
+        """mempool.go:806-850 purgeExpiredTxs: drop txs past the
+        height-based (ttl_num_blocks) or time-based (ttl_duration_ms)
+        TTL. No-op when both are 0."""
+        ttl_blocks = self._cfg.ttl_num_blocks
+        ttl_s = self._cfg.ttl_duration_ms / 1000.0
+        if ttl_blocks <= 0 and ttl_s <= 0:
+            return
+        now = time.time()
+        for wtx in list(self._tx_by_key.values()):
+            if ttl_blocks > 0 and self._height - wtx.height > ttl_blocks:
+                self._remove_tx(wtx.key)
+                self._cache.remove(wtx.tx)
+            elif ttl_s > 0 and now - wtx.timestamp > ttl_s:
+                self._remove_tx(wtx.key)
+                self._cache.remove(wtx.tx)
 
     def _remove_tx(self, key: bytes) -> None:
         wtx = self._tx_by_key.pop(key, None)

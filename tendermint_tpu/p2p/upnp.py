@@ -57,11 +57,13 @@ def parse_ssdp_response(data: bytes) -> Optional[str]:
 
 
 def discover(
-    timeout: float = 3.0, ssdp_addr: Tuple[str, int] = SSDP_ADDR, attempts: int = 3
+    timeout: float = 3.0, ssdp_addr: Optional[Tuple[str, int]] = None, attempts: int = 3
 ) -> "UPnPNAT":
     """upnp.go:39 Discover: multicast M-SEARCH, follow the gateway's
     Location to its description XML, resolve the WANIPConnection control
     URL."""
+    if ssdp_addr is None:
+        ssdp_addr = SSDP_ADDR  # read at call time (tests repoint it)
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.settimeout(timeout / attempts)
     try:
@@ -219,7 +221,7 @@ def probe(
     int_port: int = 8001,
     ext_port: int = 8001,
     timeout: float = 3.0,
-    ssdp_addr: Tuple[str, int] = SSDP_ADDR,
+    ssdp_addr: Optional[Tuple[str, int]] = None,
 ) -> Capabilities:
     """probe.go:84 Probe: discover the gateway, map a port, check the
     external address, then clean up. Hairpin (dialing your own external

@@ -69,6 +69,11 @@ class SyncError(RuntimeError):
     pass
 
 
+class RetrySnapshot(Exception):
+    """syncer.go errRetrySnapshot: the app asked to restart restoration
+    of the SAME snapshot (transient failure) — not a snapshot rejection."""
+
+
 def _enc(kind: int, fields: Optional[dict] = None) -> bytes:
     inner = ProtoWriter()
     for num, val in sorted((fields or {}).items()):
@@ -140,7 +145,9 @@ class StateSyncReactor:
         self._params_ch = router.open_channel(PARAMS_DESC)
         self._stopped = threading.Event()
         self._snapshots: Dict[tuple, _SnapshotInfo] = {}
-        self._chunks: Dict[Tuple[int, int, int], bytes] = {}
+        # (height, format, index) -> (chunk bytes, sender peer id)
+        self._chunks: Dict[Tuple[int, int, int], Tuple[bytes, str]] = {}
+        self._banned_senders: set = set()
         self._light_blocks: Dict[int, LightBlock] = {}
         self._params: Dict[int, ConsensusParams] = {}
         self._mtx = threading.Lock()
@@ -222,7 +229,13 @@ class StateSyncReactor:
             r = decode_message(field_bytes(f, 2))
             key = (field_int(r, 1), field_int(r, 2), field_int(r, 3))
             with self._mtx:
-                self._chunks[key] = field_bytes(r, 4)
+                # keep the sender: the app can blame it (reject_senders).
+                # Banned senders are ignored, and a cached chunk is never
+                # overwritten (chunks.go Add: first writer wins) — a
+                # malicious re-send must not clobber an honest peer's data
+                if env.from_id in self._banned_senders or key in self._chunks:
+                    return
+                self._chunks[key] = (field_bytes(r, 4), env.from_id)
 
     def _handle_light_block_msg(self, env) -> None:
         f = decode_message(env.message)
@@ -411,11 +424,30 @@ class StateSyncReactor:
             if not fresh:
                 break  # only known-bad snapshots left: re-trying won't help
             for snap in fresh:
-                try:
-                    return self._sync_one(genesis_state, snap, chunk_timeout, trusted)
-                except SyncError:
+                for _attempt in range(3):
+                    try:
+                        return self._sync_one(
+                            genesis_state, snap, chunk_timeout, trusted
+                        )
+                    except RetrySnapshot:
+                        # syncer.go errRetrySnapshot: restart restoration
+                        # of this same snapshot (not a rejection)
+                        continue
+                    except SyncError:
+                        failed.add(snap.key())
+                        break
+                    finally:
+                        # chunkQueue teardown: drop this snapshot's cached
+                        # chunks whether the attempt succeeded or not
+                        with self._mtx:
+                            for k in [
+                                k
+                                for k in self._chunks
+                                if k[0] == snap.height and k[1] == snap.format
+                            ]:
+                                del self._chunks[k]
+                else:
                     failed.add(snap.key())
-                    continue
         if not discovered_any:
             raise SyncError("no snapshots discovered")
         raise SyncError("all discovered snapshots failed")
@@ -506,16 +538,57 @@ class StateSyncReactor:
         if res.result != abci.OFFER_SNAPSHOT_ACCEPT:
             raise SyncError(f"snapshot rejected by app: {res.result}")
 
-        # 4. fetch + apply chunks (chunks.go + syncer.go:420)
-        for index in range(snap.chunks):
-            chunk = self._fetch_chunk(snap, index, chunk_timeout)
+        # 4. fetch + apply chunks (chunks.go + syncer.go:420-470). The app
+        # steers recovery: RETRY re-applies the same chunk (refetched),
+        # refetch_chunks re-fetches earlier chunks it discarded,
+        # reject_senders bans their sources, RETRY_SNAPSHOT/REJECT abort
+        # this candidate (sync_any moves to the next snapshot).
+        pending = set(range(snap.chunks))  # chunkQueue: lowest unreturned next
+        retries = 0
+        max_retries = 4 * max(snap.chunks, 1)
+        while pending:
+            index = min(pending)
+            pending.discard(index)
+            chunk, sender = self._fetch_chunk(snap, index, chunk_timeout)
             ares = self._conn.apply_snapshot_chunk(
-                abci.RequestApplySnapshotChunk(index=index, chunk=chunk)
+                abci.RequestApplySnapshotChunk(
+                    index=index, chunk=chunk, sender=sender
+                )
             )
-            if ares.result not in (
-                abci.APPLY_SNAPSHOT_CHUNK_ACCEPT,
-                abci.APPLY_SNAPSHOT_CHUNK_RETRY,
-            ):
+            # chunks.Discard: drop the cached bytes so they are refetched
+            for r_idx in ares.refetch_chunks:
+                with self._mtx:
+                    self._chunks.pop((snap.height, snap.format, r_idx), None)
+                pending.add(r_idx)
+                retries += 1
+                if retries > max_retries:
+                    raise SyncError("refetch limit exceeded")
+            # snapshots.RejectPeer + chunks.DiscardSender: ban the sender
+            # and drop any cached chunks it supplied
+            if ares.reject_senders:
+                rejected = set(ares.reject_senders)
+                with self._mtx:
+                    self._banned_senders.update(rejected)
+                    for key in [
+                        k
+                        for k, (_, snd) in self._chunks.items()
+                        if snd in rejected
+                    ]:
+                        del self._chunks[key]
+            if ares.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT:
+                # chunkQueue discards a chunk once applied — a multi-GB
+                # snapshot must not pin every chunk in RAM
+                with self._mtx:
+                    self._chunks.pop((snap.height, snap.format, index), None)
+            elif ares.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY:
+                # chunks.Retry: re-apply the SAME cached bytes (no refetch)
+                retries += 1
+                if retries > max_retries:
+                    raise SyncError(f"chunk {index}: retry limit exceeded")
+                pending.add(index)
+            elif ares.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY_SNAPSHOT:
+                raise RetrySnapshot(f"app requested retry at chunk {index}")
+            else:
                 raise SyncError(f"chunk {index} rejected: {ares.result}")
 
         # 5. verify the app took the snapshot (syncer.go:565 verifyApp)
@@ -567,15 +640,27 @@ class StateSyncReactor:
         )
         return state, snap_block.signed_header.commit
 
-    def _fetch_chunk(self, snap: _SnapshotInfo, index: int, timeout: float) -> bytes:
+    def _fetch_chunk(
+        self, snap: _SnapshotInfo, index: int, timeout: float
+    ) -> tuple:
+        """-> (chunk_bytes, sender_id). Senders the app rejected
+        (banned_senders) are never asked again (syncer.go applyChunks
+        RejectSenders)."""
         key = (snap.height, snap.format, index)
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._mtx:
-                chunk = self._chunks.get(key)
-            if chunk is not None:
-                return chunk
-            for peer in snap.peers or [""]:
+                entry = self._chunks.get(key)
+                banned = set(self._banned_senders)
+                if entry is not None and entry[1] in banned:
+                    # poisoned source; drop under the SAME lock so a
+                    # fresh chunk landing in between is never discarded
+                    del self._chunks[key]
+                    entry = None
+            if entry is not None:
+                return entry
+            peers = [p for p in (snap.peers or [""]) if p not in banned]
+            for peer in peers or [""]:
                 msg = _enc(1, {1: snap.height, 2: snap.format, 3: index})
                 if peer:
                     self._chunk_ch.send(peer, msg)

@@ -147,3 +147,32 @@ class TestLateJoiner:
             net.check_invariants()
         finally:
             net.stop()
+
+
+@pytest.mark.slow
+class TestGeneratedManifestRun:
+    def test_run_generated_quad_manifest(self):
+        """generator -> runner pipeline (the nightly sweep's shape): pick
+        the generated quad/initial-height-1 manifest, drop heavyweight
+        perturbations for CI determinism, run the full runner sequence."""
+        import random
+
+        from tendermint_tpu.e2e import generator
+
+        ms = generator.generate(random.Random(2024))
+        m = next(x for x in ms if x.chain_id == "gen-quad-1")
+        for n in m.nodes:
+            n.perturb = [p for p in n.perturb if p == "disconnect"]
+            n.misbehave = ""
+        net = Testnet(m)
+        net.setup()
+        net.start()
+        try:
+            net.start_late_joiners(timeout=90)
+            net.wait_for_height(2, timeout=90)
+            net.load_transactions()
+            net.perturb()
+            net.wait_for_height(m.initial_height + m.wait_blocks, timeout=120)
+            net.check_invariants()
+        finally:
+            net.stop()

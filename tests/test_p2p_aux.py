@@ -241,6 +241,29 @@ def fake_gateway():
 
 
 class TestUPnP:
+    def test_cli_probe_upnp(self, fake_gateway, capsys):
+        """CLI probe-upnp (cmd/tendermint ProbeUpnpCmd) end-to-end against
+        the fake gateway: discover, map, report capabilities JSON."""
+        import json
+
+        from tendermint_tpu import cli
+        from tendermint_tpu.p2p import upnp as upnp_mod
+
+        ssdp_addr, _ = fake_gateway
+        prior = upnp_mod.SSDP_ADDR
+        upnp_mod.SSDP_ADDR = ssdp_addr
+        try:
+            rc = cli.main(
+                ["probe-upnp", "--timeout", "2", "--int-port", "18421",
+                 "--ext-port", "18421"]
+            )
+        finally:
+            upnp_mod.SSDP_ADDR = prior
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        caps = json.loads(out)
+        assert caps["port_mapping"] is True
+
     def test_discover_and_map(self, fake_gateway):
         ssdp_addr, _ = fake_gateway
         nat = upnp.discover(timeout=3.0, ssdp_addr=ssdp_addr, attempts=1)

@@ -178,3 +178,70 @@ class TestMedianTime:
         commit, _ = sign_commit(sks, state, block, parts, 1, ts_base=500)
         med = median_time(commit, state.validators)
         assert med.seconds == 501  # all voted with seconds=500+height
+
+
+class TestMempoolEvictionTTL:
+    def _mp(self, **cfg_kw):
+        from tendermint_tpu.abci import LocalClient
+        from tendermint_tpu.abci.application import Application
+        from tendermint_tpu.abci import types as abci_t
+        from tendermint_tpu.config import MempoolConfig
+        from tendermint_tpu.mempool import TxMempool
+
+        class PriorityApp(Application):
+            def check_tx(self, req):
+                # priority = first byte of the tx
+                return abci_t.ResponseCheckTx(code=0, priority=req.tx[0])
+
+        cfg = MempoolConfig(**cfg_kw)
+        return TxMempool(LocalClient(PriorityApp()), config=cfg)
+
+    def test_priority_eviction_when_full(self):
+        """mempool.go:498 + priority_queue.go GetEvictableTxs: a full
+        mempool evicts strictly-lower-priority txs for a higher-priority
+        arrival, and rejects arrivals that cannot displace anything."""
+        from tendermint_tpu.mempool import MempoolFullError
+
+        mp = self._mp(size=3)
+        mp.check_tx(bytes([10]) + b"a")
+        mp.check_tx(bytes([20]) + b"b")
+        mp.check_tx(bytes([30]) + b"c")
+        assert mp.size() == 3
+        # higher priority than the lowest: evicts priority-10
+        mp.check_tx(bytes([40]) + b"d")
+        assert mp.size() == 3
+        txs = mp.reap_max_txs(-1)
+        assert bytes([10]) + b"a" not in txs
+        assert bytes([40]) + b"d" in txs
+        # lower than everything resident: rejected outright
+        import pytest as _pytest
+
+        with _pytest.raises(MempoolFullError):
+            mp.check_tx(bytes([5]) + b"e")
+        assert bytes([5]) + b"e" not in mp.reap_max_txs(-1)
+
+    def test_ttl_num_blocks_purge(self):
+        mp = self._mp(size=10, ttl_num_blocks=2)
+        mp.check_tx(bytes([10]) + b"x")
+        assert mp.size() == 1
+        with mp._mtx:
+            mp.update(1, [], [])
+            mp.update(2, [], [])
+            assert mp.size() == 1  # height delta 2, not yet > ttl
+            mp.update(3, [], [])
+        assert mp.size() == 0
+
+    def test_ttl_duration_purge(self):
+        import time as _t
+
+        mp = self._mp(size=10, ttl_duration_ms=50)
+        mp.check_tx(bytes([10]) + b"y")
+        _t.sleep(0.08)
+        with mp._mtx:
+            mp.update(1, [], [])
+        assert mp.size() == 0
+        # a fresh tx survives an immediate update
+        mp.check_tx(bytes([10]) + b"z")
+        with mp._mtx:
+            mp.update(2, [], [])
+        assert mp.size() == 1

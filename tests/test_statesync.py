@@ -278,3 +278,99 @@ class TestStateSyncTrust:
         r = _OfflineReactor(CHAIN_ID, {h: root, h + 1: real_next})
         lb = r._verified_light_block(h + 1, {h: root})
         assert lb.height == h + 1
+
+
+class TestChunkRecovery:
+    def test_retry_refetch_reject_senders(self, snapshotting_chain):
+        """syncer.go:420-470 applyChunks semantics: the app can demand the
+        same chunk again (RETRY), discard and re-request a chunk
+        (refetch_chunks), and ban its sender (reject_senders) — the sync
+        must still complete."""
+        from tendermint_tpu.abci import types as abci_t
+
+        app, proxy, src_sstore, src_bstore, doc = snapshotting_chain
+
+        class FlakyRestoreApp(KVStoreApplication):
+            def __init__(self):
+                super().__init__()
+                self.events = []
+                self._snap_retried = False
+                self._retried = False
+                self._refetched = False
+
+            def apply_snapshot_chunk(self, req):
+                last = self._restoring.chunks - 1 if self._restoring else 0
+                if not self._snap_retried:
+                    # errRetrySnapshot: restart restoration of the SAME
+                    # snapshot (sync_any must re-offer, not reject)
+                    self._snap_retried = True
+                    self.events.append(("retry-snapshot", req.index))
+                    return abci_t.ResponseApplySnapshotChunk(
+                        result=abci_t.APPLY_SNAPSHOT_CHUNK_RETRY_SNAPSHOT
+                    )
+                if req.index == 0 and not self._retried:
+                    self._retried = True
+                    self.events.append(("retry", req.index))
+                    return abci_t.ResponseApplySnapshotChunk(
+                        result=abci_t.APPLY_SNAPSHOT_CHUNK_RETRY
+                    )
+                if req.index == last and not self._refetched:
+                    self._refetched = True
+                    self.events.append(("refetch", req.index, req.sender))
+                    # "discard" the chunk: accept without buffering, ask
+                    # for it again and blame a (fictional) second sender
+                    return abci_t.ResponseApplySnapshotChunk(
+                        result=abci_t.APPLY_SNAPSHOT_CHUNK_ACCEPT,
+                        refetch_chunks=[last],
+                        reject_senders=["ghost-peer"],
+                    )
+                self.events.append(("accept", req.index))
+                return super().apply_snapshot_chunk(req)
+
+        hub = new_memory_network()
+        keys = [NodeKey.generate(bytes([i + 80]) * 32) for i in range(2)]
+        routers = []
+        for i in range(2):
+            t = MemoryTransport(hub, keys[i].node_id, keys[i].pub_key)
+            pm = PeerManager(keys[i].node_id)
+            routers.append(Router(t, pm, keys[i].node_id))
+        server = StateSyncReactor(
+            routers[0], proxy, src_sstore, src_bstore, CHAIN_ID, serving=True
+        )
+        fresh_app = FlakyRestoreApp()
+        client = StateSyncReactor(
+            routers[1], LocalClient(fresh_app), StateStore(MemDB()),
+            BlockStore(MemDB()), CHAIN_ID, serving=False,
+        )
+        routers[0]._pm.add_address(PeerAddress(keys[1].node_id, keys[1].node_id))
+        for r in routers:
+            r.start()
+        server.start()
+        client.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not routers[1].connected():
+            time.sleep(0.05)
+        genesis_state = make_genesis_state(doc)
+        usable = [h for h in app._snapshots if h + 2 <= src_bstore.height()]
+        snap_height = max(usable)
+        trust_block = server._load_local_light_block(snap_height)
+        try:
+            state, _commit = client.sync_any(
+                genesis_state,
+                trust_height=snap_height,
+                trust_hash=trust_block.hash(),
+                discovery_time=10.0,
+            )
+        finally:
+            server.stop()
+            client.stop()
+            for r in routers:
+                r.stop()
+        assert state.last_block_height == snap_height
+        kinds = [e[0] for e in fresh_app.events]
+        assert "retry-snapshot" in kinds
+        assert "retry" in kinds and "refetch" in kinds
+        # restore finished AFTER the recovery dance
+        assert kinds[-1] == "accept"
+        # the blamed sender is banned for the rest of the sync
+        assert "ghost-peer" in client._banned_senders
