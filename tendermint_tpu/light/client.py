@@ -140,9 +140,96 @@ class Client:
             lb.height,
             lb.signed_header.commit,
         )
+        # cross-check BEFORE persisting: a failed construction must not
+        # leave the store bootstrapped (a retry would skip this check)
+        self._compare_first_header_with_witnesses(lb)
         self._store.save_light_block(lb)
 
+    def _compare_first_header_with_witnesses(self, root: LightBlock) -> None:
+        """client.go:1086 compareFirstHeaderWithWitnesses: every reachable
+        witness must agree with the primary's root header. A witness that
+        cannot serve the height is ignored; one that serves a DIFFERENT
+        header is a conflict the operator must resolve (raise); one that
+        serves garbage is removed."""
+        for i, w in enumerate(self._witnesses):
+            try:
+                wlb = w.light_block(root.height)
+            except Exception:  # noqa: BLE001 — unreachable/missing: ignore
+                continue
+            if wlb.hash() != root.hash():
+                # compareNewHeaderWithWitness: hash mismatch at the root is
+                # errConflictingHeaders — the operator must pick a side
+                raise ErrLightClientAttack(
+                    f"witness {i} has a different header at the root height "
+                    f"{root.height}: {wlb.hash().hex()} vs {root.hash().hex()}"
+                )
+
     # -- public API -------------------------------------------------------
+
+    def verify_header(self, new_header, now: Optional[Timestamp] = None) -> None:
+        """client.go:456 VerifyHeader: verify an externally obtained
+        header — already-trusted headers must match byte-for-byte; fresh
+        ones are fetched from the primary (with vals) and must hash-match
+        before the normal verification path runs."""
+        if new_header is None:
+            raise ValueError("nil header")
+        if new_header.height <= 0:
+            raise ValueError("negative or zero height")
+        existing = self._store.light_block(new_header.height)
+        if existing is not None:
+            if existing.hash() != new_header.hash():
+                raise ValueError(
+                    f"existing trusted header {existing.hash().hex()} does not "
+                    f"match newHeader {new_header.hash().hex()}"
+                )
+            return
+        # verify through the normal dispatch (forward bisection or the
+        # backwards hash-link walk for heights below trust), THEN demand
+        # the verified block is the caller's header — a height below the
+        # pruning window must never be stored unverified
+        lb = self.verify_light_block_at_height(new_header.height, now)
+        if lb.hash() != new_header.hash():
+            raise ValueError(
+                f"verified header {lb.hash().hex()} does not match "
+                f"newHeader {new_header.hash().hex()}"
+            )
+
+    def last_trusted_height(self) -> int:
+        """client.go:801 (-1 when empty)."""
+        lb = self._store.latest_light_block()
+        return lb.height if lb is not None else -1
+
+    def first_trusted_height(self) -> int:
+        """client.go:809 (-1 when empty)."""
+        return self._store.first_light_block_height()
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def primary(self) -> Provider:
+        return self._primary
+
+    def witnesses(self) -> List[Provider]:
+        return list(self._witnesses)
+
+    def add_provider(self, p: Provider) -> None:
+        """client.go:841."""
+        self._witnesses.append(p)
+
+    def remove_witnesses(self, indexes: List[int]) -> None:
+        """client.go:975: drop misbehaving witnesses (descending order so
+        earlier removals do not shift later indexes)."""
+        uniq = sorted(set(indexes), reverse=True)
+        if any(i < 0 or i >= len(self._witnesses) for i in uniq):
+            raise IndexError(f"witness index out of range: {indexes}")
+        if len(self._witnesses) <= len(uniq):
+            raise RuntimeError("cannot remove all witnesses")
+        for i in uniq:
+            self._witnesses.pop(i)
+
+    def cleanup(self) -> None:
+        """client.go:849: remove all stored light blocks."""
+        self._store.prune(0)
 
     def trusted_light_block(self, height: int) -> Optional[LightBlock]:
         if height == 0:

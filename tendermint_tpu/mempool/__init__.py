@@ -145,8 +145,9 @@ class TxMempool:
                         self._cache.remove(tx)
                         raise MempoolFullError(len(self._tx_by_key))
                     for v in victims:
-                        self._remove_tx(v.key)
+                        self._remove_tx(v.key, compact=False)
                         self._cache.remove(v.tx)
+                    self._compact_fifo()
                 was_empty = not self._tx_by_key
                 wtx = _WrappedTx(
                     sort_key=(-res.priority, next(self._seq)),
@@ -255,7 +256,8 @@ class TxMempool:
                 self._cache.push(tx)  # committed: keep in cache forever-ish
             elif not self._cfg.keep_invalid_txs_in_cache:
                 self._cache.remove(tx)
-            self._remove_tx(tx_key(tx))
+            self._remove_tx(tx_key(tx), compact=False)
+        self._compact_fifo()
         self._purge_expired_txs()
         if self._cfg.recheck and self._tx_by_key:
             self._recheck_txs()
@@ -271,17 +273,22 @@ class TxMempool:
         now = time.time()
         for wtx in list(self._tx_by_key.values()):
             if ttl_blocks > 0 and self._height - wtx.height > ttl_blocks:
-                self._remove_tx(wtx.key)
+                self._remove_tx(wtx.key, compact=False)
                 self._cache.remove(wtx.tx)
             elif ttl_s > 0 and now - wtx.timestamp > ttl_s:
-                self._remove_tx(wtx.key)
+                self._remove_tx(wtx.key, compact=False)
                 self._cache.remove(wtx.tx)
+        self._compact_fifo()
 
-    def _remove_tx(self, key: bytes) -> None:
+    def _remove_tx(self, key: bytes, compact: bool = True) -> None:
         wtx = self._tx_by_key.pop(key, None)
         if wtx is not None:
             wtx.removed = True
             self._size_bytes -= len(wtx.tx)
+        if compact:
+            self._fifo = [w for w in self._fifo if not w.removed]
+
+    def _compact_fifo(self) -> None:
         self._fifo = [w for w in self._fifo if not w.removed]
 
     def _recheck_txs(self) -> None:

@@ -235,6 +235,8 @@ class StateSyncReactor:
                 # malicious re-send must not clobber an honest peer's data
                 if env.from_id in self._banned_senders or key in self._chunks:
                     return
+                if field_int(r, 5):
+                    return  # missing=1: the peer pruned this snapshot
                 self._chunks[key] = (field_bytes(r, 4), env.from_id)
 
     def _handle_light_block_msg(self, env) -> None:

@@ -164,8 +164,12 @@ def test_unsustained_witness_divergence_removes_witness(produced_chain):
     honest = NodeBackedProvider(bstore, cs._block_exec.store)
 
     class EvilWitness(NodeBackedProvider):
+        armed = False  # honest during client init (the root cross-check)
+
         def light_block(self, height):
             lb = super().light_block(height)
+            if not self.armed:
+                return lb
             evil_header = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
             return LightBlock(
                 signed_header=SignedHeader(
@@ -183,6 +187,7 @@ def test_unsustained_witness_divergence_removes_witness(produced_chain):
         witnesses=[evil],
         store=LightStore(MemDB()),
     )
+    evil.armed = True
     with pytest.raises(ErrFailedHeaderCrossReferencing):
         c.verify_light_block_at_height(3)
     assert c._witnesses == []

@@ -96,8 +96,12 @@ class TestLightClient:
         prov = _provider(cs, bstore)
 
         class EvilWitness(NodeBackedProvider):
+            armed = False  # honest during client init (the root cross-check)
+
             def light_block(self, height):
                 lb = super().light_block(height)
+                if not self.armed:
+                    return lb
                 from dataclasses import replace
 
                 evil_header = replace(lb.signed_header.header, app_hash=b"\x66" * 32)
@@ -110,6 +114,7 @@ class TestLightClient:
 
         evil = EvilWitness(bstore, cs._block_exec.store)
         c = self._client(cs, bstore, witnesses=[evil])
+        evil.armed = True
         # the witness can't sustain its forged header (its commit signs the
         # real one), so it is removed and cross-referencing fails
         # (detector.go:88-101); the sustained-forgery attack path is covered
@@ -193,3 +198,91 @@ class TestBlockSync:
         for h in range(1, target + 1):
             assert fresh_store.load_block(h).hash() == src_store.load_block(h).hash()
         assert caught, "on_caught_up was not reported"
+
+
+class TestLightClientAPI:
+    """client.go public-surface parity: VerifyHeader, height accessors,
+    witness management, init-time witness cross-check."""
+
+    def _client(self, cs, bstore, witnesses=None):
+        prov = _provider(cs, bstore)
+        lb1 = prov.light_block(1)
+        return Client(
+            chain_id="cs-chain",
+            trust_options=TrustOptions(period=1e9, height=1, hash=lb1.hash()),
+            primary=prov,
+            witnesses=witnesses if witnesses is not None else [prov],
+            store=LightStore(MemDB()),
+        ), prov
+
+    def test_verify_header_and_accessors(self, produced_chain):
+        cs, bstore = produced_chain
+        c, prov = self._client(cs, bstore)
+        assert c.chain_id() == "cs-chain"
+        assert c.primary() is prov
+        assert c.last_trusted_height() == 1
+        assert c.first_trusted_height() == 1
+        hdr3 = prov.light_block(3).signed_header.header
+        c.verify_header(hdr3)  # fetches + verifies through the primary
+        assert c.last_trusted_height() >= 3
+        # re-verifying a trusted header is a no-op; a mismatching one errors
+        c.verify_header(hdr3)
+        from dataclasses import replace
+
+        import pytest as _pytest
+
+        forged = replace(hdr3, app_hash=b"\x13" * 32)
+        with _pytest.raises(ValueError):
+            c.verify_header(forged)
+
+    def test_witness_management(self, produced_chain):
+        cs, bstore = produced_chain
+        c, prov = self._client(cs, bstore)
+        extra = _provider(cs, bstore)
+        c.add_provider(extra)
+        assert len(c.witnesses()) == 2
+        c.remove_witnesses([0])
+        assert c.witnesses() == [extra]
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            c.remove_witnesses([0])  # cannot remove all witnesses
+        c.cleanup()
+        assert c.last_trusted_height() == -1
+
+    def test_init_conflicting_witness_rejected(self, produced_chain):
+        """compareFirstHeaderWithWitnesses: a witness serving a different
+        root header aborts client construction."""
+        from dataclasses import replace
+
+        import pytest as _pytest
+
+        from tendermint_tpu.light.client import ErrLightClientAttack
+        from tendermint_tpu.light.provider import LightBlock
+
+        cs, bstore = produced_chain
+        prov = _provider(cs, bstore)
+
+        class ConflictingWitness(type(prov)):
+            def light_block(self, height):
+                lb = super().light_block(height)
+                return LightBlock(
+                    signed_header=SignedHeader(
+                        header=replace(
+                            lb.signed_header.header, app_hash=b"\x31" * 32
+                        ),
+                        commit=lb.signed_header.commit,
+                    ),
+                    validators=lb.validators,
+                )
+
+        evil = ConflictingWitness(bstore, cs._block_exec.store)
+        lb1 = prov.light_block(1)
+        with _pytest.raises(ErrLightClientAttack):
+            Client(
+                chain_id="cs-chain",
+                trust_options=TrustOptions(period=1e9, height=1, hash=lb1.hash()),
+                primary=prov,
+                witnesses=[evil],
+                store=LightStore(MemDB()),
+            )
