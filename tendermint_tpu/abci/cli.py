@@ -1,0 +1,212 @@
+"""abci-cli — drive an ABCI application from the command line.
+
+Reference parity: abci/cmd/abci-cli/abci-cli.go — echo / info / deliver_tx
+/ check_tx / commit / query / version against a running socket server,
+`batch` (commands from stdin) and `console` (interactive), plus `kvstore`
+(serve the demo app). Run as `python -m tendermint_tpu.abci.cli`.
+
+Argument convention matches the reference (abci-cli.go stringOrHexToBytes):
+values are strings in double quotes or hex with an 0x prefix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from . import types as abci
+
+
+def string_or_hex_to_bytes(s: str) -> bytes:
+    """abci-cli.go:658 stringOrHexToBytes."""
+    if s.lower().startswith("0x"):
+        return bytes.fromhex(s[2:])
+    if s.startswith('"') and s.endswith('"') and len(s) >= 2:
+        return s[1:-1].encode()
+    raise ValueError(
+        f"invalid string arg: \"{s}\"; must be in the format 0xXXXX or \"string\""
+    )
+
+
+def _connect(address: str):
+    from .client import SocketClient
+
+    return SocketClient(address)
+
+
+def _print_response(name: str, **fields) -> None:
+    print(f"-> {name}")
+    for k, v in fields.items():
+        if v in (None, b"", "", 0) and k != "code":
+            continue
+        if isinstance(v, bytes):
+            print(f"-> {k}: 0x{v.hex().upper()}")
+            try:
+                print(f"-> {k}.string: {v.decode()}")
+            except UnicodeDecodeError:
+                pass
+        else:
+            print(f"-> {k}: {v}")
+
+
+def cmd_echo(cli, args: list) -> int:
+    msg = args[0] if args else ""
+    _print_response("echo", message=cli.echo(msg))
+    return 0
+
+
+def cmd_info(cli, args: list) -> int:
+    res = cli.info(abci.RequestInfo())
+    _print_response(
+        "info",
+        data=res.data,
+        version=res.version,
+        app_version=res.app_version,
+        last_block_height=res.last_block_height,
+        last_block_app_hash=res.last_block_app_hash,
+    )
+    return 0
+
+
+def cmd_deliver_tx(cli, args: list) -> int:
+    if not args:
+        print("want the tx", file=sys.stderr)
+        return 1
+    res = cli.deliver_tx(abci.RequestDeliverTx(tx=string_or_hex_to_bytes(args[0])))
+    _print_response("deliver_tx", code=res.code, data=res.data, log=res.log)
+    return 0 if res.code == 0 else 1
+
+
+def cmd_check_tx(cli, args: list) -> int:
+    if not args:
+        print("want the tx", file=sys.stderr)
+        return 1
+    res = cli.check_tx(
+        abci.RequestCheckTx(tx=string_or_hex_to_bytes(args[0]), type=abci.CHECK_TX_TYPE_NEW)
+    )
+    _print_response("check_tx", code=res.code, data=res.data, log=res.log)
+    return 0 if res.code == 0 else 1
+
+
+def cmd_commit(cli, args: list) -> int:
+    res = cli.commit()
+    _print_response("commit", data=res.data)
+    return 0
+
+
+def cmd_query(cli, args: list) -> int:
+    if not args:
+        print("want the query", file=sys.stderr)
+        return 1
+    res = cli.query(abci.RequestQuery(data=string_or_hex_to_bytes(args[0]), path=""))
+    _print_response(
+        "query", code=res.code, key=res.key, value=res.value, height=res.height
+    )
+    return 0 if res.code == 0 else 1
+
+
+def cmd_version(cli, args: list) -> int:
+    from ..version import ABCI_VERSION
+
+    print(ABCI_VERSION)
+    return 0
+
+
+COMMANDS = {
+    "echo": cmd_echo,
+    "info": cmd_info,
+    "deliver_tx": cmd_deliver_tx,
+    "check_tx": cmd_check_tx,
+    "commit": cmd_commit,
+    "query": cmd_query,
+    "version": cmd_version,
+}
+
+
+def run_line(cli, line: str) -> int:
+    """One batch/console line: `<command> [args...]` (abci-cli.go:283)."""
+    try:
+        parts = shlex.split(line, posix=False)
+        if not parts:
+            return 0
+        cmd, args = parts[0], parts[1:]
+        fn = COMMANDS.get(cmd)
+        if fn is None:
+            print(f"unknown command: {cmd}", file=sys.stderr)
+            return 1
+        print(f"> {line}")
+        return fn(cli, args)
+    except ValueError as e:
+        # bad quoting or bad args must not kill the batch/console session
+        print(f"-> error: {e}", file=sys.stderr)
+        return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="abci-cli")
+    p.add_argument("--address", default="127.0.0.1:26658")
+    sub = p.add_subparsers(dest="command")
+    for name in COMMANDS:
+        sp = sub.add_parser(name)
+        sp.add_argument("args", nargs="*")
+    sub.add_parser("batch")
+    sub.add_parser("console")
+    sp = sub.add_parser("kvstore")
+    sp.add_argument("--persist", default="")
+    args = p.parse_args(argv)
+
+    if not args.command:
+        p.print_help()
+        return 1
+
+    if args.command == "version":
+        # local, like the reference: no server needed
+        return cmd_version(None, args.args)
+
+    if args.command == "kvstore":
+        from .kvstore import KVStoreApplication, PersistentKVStoreApplication
+        from .server import ABCIServer
+
+        app = (
+            PersistentKVStoreApplication(args.persist)
+            if args.persist
+            else KVStoreApplication()
+        )
+        srv = ABCIServer(args.address, app)
+        srv.start()
+        print(f"kvstore serving on {args.address}")
+        try:
+            import time
+
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+
+    cli = _connect(args.address)
+    try:
+        if args.command == "batch":
+            rc = 0
+            for line in sys.stdin:
+                rc |= run_line(cli, line.strip())
+            return rc
+        if args.command == "console":
+            while True:
+                try:
+                    line = input("> ")
+                except EOFError:
+                    return 0
+                run_line(cli, line.strip())
+        try:
+            return COMMANDS[args.command](cli, args.args)
+        except ValueError as e:
+            print(f"-> error: {e}", file=sys.stderr)
+            return 1
+    finally:
+        cli.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

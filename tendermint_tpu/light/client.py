@@ -148,9 +148,9 @@ class Client:
     def _compare_first_header_with_witnesses(self, root: LightBlock) -> None:
         """client.go:1086 compareFirstHeaderWithWitnesses: every reachable
         witness must agree with the primary's root header. A witness that
-        cannot serve the height is ignored; one that serves a DIFFERENT
-        header is a conflict the operator must resolve (raise); one that
-        serves garbage is removed."""
+        cannot serve the height (unreachable / missing block) is ignored —
+        the reference keeps such witnesses too; one that serves a
+        DIFFERENT header is a conflict the operator must resolve (raise)."""
         for i, w in enumerate(self._witnesses):
             try:
                 wlb = w.light_block(root.height)
@@ -183,10 +183,18 @@ class Client:
                     f"match newHeader {new_header.hash().hex()}"
                 )
             return
-        # verify through the normal dispatch (forward bisection or the
-        # backwards hash-link walk for heights below trust), THEN demand
-        # the verified block is the caller's header — a height below the
-        # pruning window must never be stored unverified
+        # compare the primary's header BEFORE any verification/storage
+        # (client.go:482): a mismatch must not pin the primary's fork into
+        # the trusted store
+        probe = self._light_block_from_primary(new_header.height)
+        if probe.hash() != new_header.hash():
+            raise ValueError(
+                f"header from primary {probe.hash().hex()} does not match "
+                f"newHeader {new_header.hash().hex()}"
+            )
+        # then verify through the normal dispatch (forward bisection or
+        # the backwards hash-link walk for heights below trust) — a height
+        # below the pruning window must never be stored unverified
         lb = self.verify_light_block_at_height(new_header.height, now)
         if lb.hash() != new_header.hash():
             raise ValueError(

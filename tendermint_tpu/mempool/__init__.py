@@ -286,7 +286,7 @@ class TxMempool:
             wtx.removed = True
             self._size_bytes -= len(wtx.tx)
         if compact:
-            self._fifo = [w for w in self._fifo if not w.removed]
+            self._compact_fifo()
 
     def _compact_fifo(self) -> None:
         self._fifo = [w for w in self._fifo if not w.removed]
@@ -304,9 +304,10 @@ class TxMempool:
                 except ValueError:
                     ok = False
             if not ok:
-                self._remove_tx(wtx.key)
+                self._remove_tx(wtx.key, compact=False)
                 if not self._cfg.keep_invalid_txs_in_cache:
                     self._cache.remove(wtx.tx)
+        self._compact_fifo()
 
     def remove_tx_by_key(self, key: bytes) -> bool:
         """mempool.go RemoveTxByKey (public API used by the remove_tx

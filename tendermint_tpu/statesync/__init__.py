@@ -213,16 +213,29 @@ class StateSyncReactor:
         f = decode_message(env.message)
         if 1 in f and self._serving:  # chunk_request
             r = decode_message(field_bytes(f, 1))
+            height, fmt = field_int(r, 1), field_int(r, 2)
             res = self._conn.load_snapshot_chunk(
                 abci.RequestLoadSnapshotChunk(
-                    height=field_int(r, 1), format=field_int(r, 2), chunk=field_int(r, 3)
+                    height=height, format=fmt, chunk=field_int(r, 3)
                 )
             )
+            # missing means "I no longer have this snapshot" (reactor.go:
+            # resp.Chunk == nil), NOT "the chunk is zero-length" — a
+            # legitimately empty chunk from a still-advertised snapshot
+            # must be served as data or the slot can never be filled
+            missing = 1 if not res.chunk else 0
+            if missing:
+                try:
+                    have = self._conn.list_snapshots().snapshots
+                    if any(s.height == height and s.format == fmt for s in have):
+                        missing = 0
+                except Exception:  # noqa: BLE001 — keep the missing verdict
+                    pass
             self._chunk_ch.send(
                 env.from_id,
                 _enc(2, {
-                    1: field_int(r, 1), 2: field_int(r, 2), 3: field_int(r, 3),
-                    4: res.chunk, 5: 0 if res.chunk else 1,
+                    1: height, 2: fmt, 3: field_int(r, 3),
+                    4: res.chunk, 5: missing,
                 }),
             )
         elif 2 in f:  # chunk_response

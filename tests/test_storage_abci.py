@@ -199,3 +199,56 @@ class TestKVStore:
         assert pubkey_from_proto(eb.validator_updates[0].pub_key).bytes() == pk2.bytes()
         vals = app.validators()
         assert len(vals) == 2
+
+
+class TestABCICli:
+    """abci-cli parity (abci/cmd/abci-cli): batch-style commands against
+    a socket kvstore server."""
+
+    def test_cli_commands_roundtrip(self, capsys):
+        from tendermint_tpu.abci import cli as abci_cli
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.abci.server import ABCIServer
+
+        srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApplication())
+        srv.start()
+        addr = srv._address
+        try:
+            assert abci_cli.main(["--address", addr, "echo", "hello"]) == 0
+            assert abci_cli.main(["--address", addr, "info"]) == 0
+            assert (
+                abci_cli.main(["--address", addr, "deliver_tx", '"abc=def"']) == 0
+            )
+            assert abci_cli.main(["--address", addr, "commit"]) == 0
+            assert abci_cli.main(["--address", addr, "query", '"abc"']) == 0
+            out = capsys.readouterr().out
+            assert "hello" in out
+            assert "value" in out
+            # hex form of the same tx (stringOrHexToBytes)
+            hex_tx = "0x" + b"k2=v2".hex()
+            assert abci_cli.main(["--address", addr, "deliver_tx", hex_tx]) == 0
+            # bad arg form errors
+            assert abci_cli.main(["--address", addr, "deliver_tx", "bare"]) == 1
+        finally:
+            srv.stop()
+
+    def test_cli_batch_mode(self, capsys, monkeypatch):
+        import io
+
+        from tendermint_tpu.abci import cli as abci_cli
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.abci.server import ABCIServer
+
+        srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApplication())
+        srv.start()
+        addr = srv._address
+        try:
+            monkeypatch.setattr(
+                "sys.stdin",
+                io.StringIO('deliver_tx "bk=bv"\ncommit\nquery "bk"\n'),
+            )
+            assert abci_cli.main(["--address", addr, "batch"]) == 0
+            out = capsys.readouterr().out
+            assert "-> commit" in out and "-> query" in out
+        finally:
+            srv.stop()
