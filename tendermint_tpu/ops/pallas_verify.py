@@ -24,6 +24,7 @@ Semantics are identical to ops.ed25519_verify / crypto._edwards
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -54,8 +55,14 @@ def SQRT_M1_T():
 NL = fe_t.NLIMBS
 
 # Default lanes per kernel block: table (4 coords x 16 x 20 x B x 4B) plus
-# digit scratch must fit VMEM (~16 MB) with headroom.
-BLOCK = 512
+# digit scratch must fit VMEM (~16 MB) with headroom. Env-tunable for
+# block-size sweeps on real hardware; must divide every bucket size or
+# grid=(n // block,) would silently leave the tail lanes unverified.
+BLOCK = int(os.environ.get("TM_TPU_PALLAS_BLOCK", "512"))
+if 10240 % BLOCK or BLOCK <= 0:
+    raise ValueError(
+        f"TM_TPU_PALLAS_BLOCK={BLOCK} must be a positive divisor of 10240"
+    )
 
 
 # -- point ops (limb-major; mirrors ops.ed25519_verify) ---------------------

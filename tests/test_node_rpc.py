@@ -266,3 +266,20 @@ class TestNodeStartupModes:
                 node_b.stop()
         finally:
             node_a.stop()
+
+
+def test_openapi_spec_covers_route_table():
+    """rpc/openapi parity: the spec documents every mounted route (and
+    nothing that isn't mounted, modulo the websocket pseudo-path)."""
+    import os
+    import re
+
+    from tendermint_tpu.rpc.core import ROUTES, UNSAFE_ROUTES
+
+    spec_path = os.path.join(
+        os.path.dirname(__file__), "..", "tendermint_tpu", "rpc", "openapi.yaml"
+    )
+    text = open(spec_path).read()
+    paths = set(re.findall(r"^  /([a-z_]+):", text, re.M))
+    expected = set(ROUTES) | set(UNSAFE_ROUTES) | {"websocket"}
+    assert paths == expected, (paths ^ expected)
