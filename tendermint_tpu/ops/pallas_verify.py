@@ -59,7 +59,7 @@ NL = fe_t.NLIMBS
 # block-size sweeps on real hardware; must divide every bucket size or
 # grid=(n // block,) would silently leave the tail lanes unverified.
 BLOCK = int(os.environ.get("TM_TPU_PALLAS_BLOCK", "512"))
-if 10240 % BLOCK or BLOCK <= 0:
+if BLOCK <= 0 or 10240 % BLOCK:
     raise ValueError(
         f"TM_TPU_PALLAS_BLOCK={BLOCK} must be a positive divisor of 10240"
     )

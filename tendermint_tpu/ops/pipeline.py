@@ -224,28 +224,74 @@ def verify_commits_pipelined(
     from ..types.validation import _verify_basic_vals_and_commit
 
     v = verifier or shared_verifier()
-    futures: List[Optional[Future]] = []
     errors: List[Optional[str]] = [None] * len(jobs)
+
+    # The whole job list is known upfront, so entries are packed into
+    # FULL max-bucket device batches here instead of relying on the
+    # worker's opportunistic coalescing: per-job submission races the
+    # worker's queue drain, and on a relay-attached TPU each undersized
+    # dispatch pays ~100 ms — measured 3-4x slower for 1k-header syncs.
+    # A job's signatures may straddle two batches; verdicts re-aggregate
+    # per job below.
+    max_b = _backend.BUCKETS[-1]
+    futures: List[Future] = []
+    job_spans: List[list] = [[] for _ in jobs]  # (future_idx, off, n)
+    cur: list = []
+    cur_spans: list = []  # (job_idx, off_in_batch, n)
+
+    def _flush() -> None:
+        nonlocal cur, cur_spans
+        if not cur:
+            return
+        fi = len(futures)
+        futures.append(v.submit(cur))
+        for job_i, off, n in cur_spans:
+            job_spans[job_i].append((fi, off, n))
+        cur, cur_spans = [], []
+
     for i, (vals, block_id, height, commit) in enumerate(jobs):
         try:
             _verify_basic_vals_and_commit(vals, commit, height, block_id)
             needed = vals.total_voting_power() * 2 // 3
             entries, _ = commit_entries(chain_id, vals, commit, needed)
-            futures.append(v.submit(entries))
         except (ValueError, RuntimeError) as e:
             errors[i] = str(e)
-            futures.append(None)
-    for i, fut in enumerate(futures):
-        if fut is None:
             continue
+        pos = 0
+        while pos < len(entries):
+            take = min(len(entries) - pos, max_b - len(cur))
+            cur_spans.append((i, len(cur), take))
+            cur.extend(entries[pos : pos + take])
+            pos += take
+            if len(cur) >= max_b:
+                _flush()
+    _flush()
+
+    results: List[object] = []
+    for fut in futures:
         try:
-            valid = fut.result(timeout=300)
+            results.append(np.asarray(fut.result(timeout=300)))
         except Exception as e:  # noqa: BLE001
-            errors[i] = str(e)
+            results.append(e)
+    for i in range(len(jobs)):
+        if errors[i] is not None:
             continue
-        if not bool(np.asarray(valid).all()):
-            bad = int(np.argmin(np.asarray(valid)))
-            errors[i] = f"wrong signature (batch lane {bad})"
+        pos_in_job = 0
+        for fi, off, n in job_spans[i]:
+            r = results[fi]
+            if isinstance(r, Exception):
+                errors[i] = str(r)
+                break
+            # _resolve already normalized pallas output to a 1-D array
+            seg = np.asarray(r[off : off + n]).astype(bool)
+            if not seg.all():
+                # report the signature index WITHIN this job's entries
+                # (validation.go:242-248 blame assignment), not the lane
+                # of the packed multi-job device batch
+                bad = pos_in_job + int(np.argmin(seg))
+                errors[i] = f"wrong signature (entry {bad})"
+                break
+            pos_in_job += n
     return errors
 
 
