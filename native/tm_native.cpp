@@ -11,6 +11,9 @@
 #include <Python.h>
 #include <cstdint>
 #include <cstring>
+#include <sched.h>
+#include <stdlib.h>
+#include <thread>
 #include <vector>
 
 // --------------------------------------------------------------------------
@@ -1183,13 +1186,12 @@ static PyObject *py_sr25519_verify_batch(PyObject *, PyObject *args) {
   uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(out);
   const uint8_t *pp = (const uint8_t *)pubs.buf;
   const uint8_t *sp = (const uint8_t *)sigs.buf;
-  ed::point base;
-  ed::fe_copy(base.x, ed::BASE_X_FE);
-  ed::fe_copy(base.y, ed::BASE_Y_FE);
-  ed::fe_one(base.z);
-  ed::fe_copy(base.t, ed::BASE_T_FE);
+  // message pointers are pinned under the GIL; the verification loop is
+  // embarrassingly parallel and runs with the GIL RELEASED across a
+  // small thread pool (each signature touches only its own output byte)
+  std::vector<const uint8_t *> mptrs(n);
+  std::vector<size_t> mlens(n);
   for (Py_ssize_t i = 0; i < n; i++) {
-    dst[i] = 0;
     PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
     char *m;
     Py_ssize_t mlen;
@@ -1200,43 +1202,91 @@ static PyObject *py_sr25519_verify_batch(PyObject *, PyObject *args) {
       PyBuffer_Release(&sigs);
       return nullptr;
     }
-    const uint8_t *sig = sp + 64 * i;
-    const uint8_t *pub = pp + 32 * i;
-    if (!(sig[63] & 0x80)) continue;  // schnorrkel v1 marker
-    uint8_t s_bytes[32];
-    memcpy(s_bytes, sig + 32, 32);
-    s_bytes[31] &= 0x7f;
-    // s < L check (L = limbs sha512::L_LIMBS, little-endian u64)
-    {
-      uint64_t s_limbs[4];
-      for (int j = 0; j < 4; j++) {
-        s_limbs[j] = 0;
-        for (int b = 0; b < 8; b++)
-          s_limbs[j] |= (uint64_t)s_bytes[8 * j + b] << (8 * b);
-      }
-      bool lt = false, ge = false;
-      for (int j = 3; j >= 0; j--) {
-        if (s_limbs[j] < sha512::L_LIMBS[j]) { lt = true; break; }
-        if (s_limbs[j] > sha512::L_LIMBS[j]) { ge = true; break; }
-      }
-      if (ge || !lt) continue;  // s >= L
-    }
-    ed::point A, R;
-    if (!ed::ristretto_decode(A, pub)) continue;
-    if (!ed::ristretto_decode(R, sig)) continue;
-    // k = merlin challenge mod L (same framing as sr25519_challenges)
-    uint8_t k_wide[64], k_bytes[32];
-    sr25519_challenge_64((const uint8_t *)ctx_buf, (size_t)ctx_len,
-                         (const uint8_t *)m, (size_t)mlen, pub, sig, k_wide);
-    sha512::mod_l(k_wide, k_bytes);
-    // expected = [s]B + [k](-A); accept iff ristretto_eq(expected, R)
-    ed::point sB, kA, negA, expected;
-    ed::pt_scalar_mul(sB, s_bytes, base);
-    ed::pt_neg(negA, A);
-    ed::pt_scalar_mul(kA, k_bytes, negA);
-    ed::pt_add(expected, sB, kA);
-    dst[i] = ed::ristretto_eq(expected, R) ? 1 : 0;
+    mptrs[i] = (const uint8_t *)m;
+    mlens[i] = (size_t)mlen;
   }
+  const uint8_t *ctx_p = (const uint8_t *)ctx_buf;
+  size_t ctx_l = (size_t)ctx_len;
+
+  auto verify_range = [&](Py_ssize_t lo, Py_ssize_t hi) {
+    ed::point base;
+    ed::fe_copy(base.x, ed::BASE_X_FE);
+    ed::fe_copy(base.y, ed::BASE_Y_FE);
+    ed::fe_one(base.z);
+    ed::fe_copy(base.t, ed::BASE_T_FE);
+    for (Py_ssize_t i = lo; i < hi; i++) {
+      dst[i] = 0;
+      const uint8_t *sig = sp + 64 * i;
+      const uint8_t *pub = pp + 32 * i;
+      if (!(sig[63] & 0x80)) continue;  // schnorrkel v1 marker
+      uint8_t s_bytes[32];
+      memcpy(s_bytes, sig + 32, 32);
+      s_bytes[31] &= 0x7f;
+      // s < L check (L = limbs sha512::L_LIMBS, little-endian u64)
+      {
+        uint64_t s_limbs[4];
+        for (int j = 0; j < 4; j++) {
+          s_limbs[j] = 0;
+          for (int b = 0; b < 8; b++)
+            s_limbs[j] |= (uint64_t)s_bytes[8 * j + b] << (8 * b);
+        }
+        bool lt = false, ge = false;
+        for (int j = 3; j >= 0; j--) {
+          if (s_limbs[j] < sha512::L_LIMBS[j]) { lt = true; break; }
+          if (s_limbs[j] > sha512::L_LIMBS[j]) { ge = true; break; }
+        }
+        if (ge || !lt) continue;  // s >= L
+      }
+      ed::point A, R;
+      if (!ed::ristretto_decode(A, pub)) continue;
+      if (!ed::ristretto_decode(R, sig)) continue;
+      // k = merlin challenge mod L (same framing as sr25519_challenges)
+      uint8_t k_wide[64], k_bytes[32];
+      sr25519_challenge_64(ctx_p, ctx_l, mptrs[i], mlens[i], pub, sig, k_wide);
+      sha512::mod_l(k_wide, k_bytes);
+      // expected = [s]B + [k](-A); accept iff ristretto_eq(expected, R)
+      ed::point sB, kA, negA, expected;
+      ed::pt_scalar_mul(sB, s_bytes, base);
+      ed::pt_neg(negA, A);
+      ed::pt_scalar_mul(kA, k_bytes, negA);
+      ed::pt_add(expected, sB, kA);
+      dst[i] = ed::ristretto_eq(expected, R) ? 1 : 0;
+    }
+  };
+
+  Py_BEGIN_ALLOW_THREADS
+  // pool width: the affinity-mask CPU count (respects cpuset pinning),
+  // overridable with TM_NATIVE_THREADS; hardware_concurrency() alone
+  // oversubscribes cgroup-quota'd containers
+  unsigned hw = 0;
+  {
+    cpu_set_t setmask;
+    if (sched_getaffinity(0, sizeof(setmask), &setmask) == 0)
+      hw = (unsigned)CPU_COUNT(&setmask);
+    if (!hw) hw = std::thread::hardware_concurrency();
+    const char *env = getenv("TM_NATIVE_THREADS");
+    if (env && *env) {
+      long v = strtol(env, nullptr, 10);
+      if (v > 0 && v < 1024) hw = (unsigned)v;
+    }
+  }
+  Py_ssize_t nthreads = (Py_ssize_t)(hw ? hw : 1);
+  if (nthreads > n) nthreads = n > 0 ? n : 1;
+  if (nthreads <= 1 || n < 16) {
+    verify_range(0, n);
+  } else {
+    std::vector<std::thread> pool;
+    Py_ssize_t chunk = (n + nthreads - 1) / nthreads;
+    for (Py_ssize_t t = 0; t < nthreads; t++) {
+      Py_ssize_t lo = t * chunk;
+      Py_ssize_t hi = lo + chunk < n ? lo + chunk : n;
+      if (lo >= hi) break;
+      pool.emplace_back(verify_range, lo, hi);
+    }
+    for (auto &th : pool) th.join();
+  }
+  Py_END_ALLOW_THREADS
+
   Py_DECREF(seq);
   PyBuffer_Release(&pubs);
   PyBuffer_Release(&sigs);

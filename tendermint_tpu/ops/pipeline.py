@@ -232,7 +232,10 @@ def verify_commits_pipelined(
     # worker's queue drain, and on a relay-attached TPU each undersized
     # dispatch pays ~100 ms — measured 3-4x slower for 1k-header syncs.
     # A job's signatures may straddle two batches; verdicts re-aggregate
-    # per job below.
+    # per job below. NOTE this intentionally layers over the worker's own
+    # span machinery (_worker packs STREAMED submissions; this packs a
+    # KNOWN-size job list) — each full chunk passes through the worker
+    # 1:1, so the worker's spans are trivial for this path.
     max_b = _backend.BUCKETS[-1]
     futures: List[Future] = []
     job_spans: List[list] = [[] for _ in jobs]  # (future_idx, off, n)
