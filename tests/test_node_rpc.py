@@ -283,3 +283,74 @@ def test_openapi_spec_covers_route_table():
     paths = set(re.findall(r"^  /([a-z_]+):", text, re.M))
     expected = set(ROUTES) | set(UNSAFE_ROUTES) | {"websocket"}
     assert paths == expected, (paths ^ expected)
+
+
+def test_seed_node_pex_discovery():
+    """node.go:428 makeSeedNode: a seed-mode node runs only the p2p layer
+    (pex + address book). Two validators that each know ONLY the seed must
+    discover each other through it and produce blocks together."""
+    from tendermint_tpu.config import MODE_SEED
+    from tendermint_tpu.p2p import PeerAddress
+
+    sks = [ed25519.gen_priv_key(bytes([i + 31]) * 32) for i in range(2)]
+    doc_json = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Timestamp(seconds=1_700_000_000),
+        validators=[
+            GenesisValidator(address=b"", pub_key=sk.pub_key(), power=10)
+            for sk in sks
+        ],
+    ).to_json()
+
+    seed_cfg = _make_config(9)
+    seed_cfg.base.mode = MODE_SEED
+    seed_cfg.p2p.pex = True
+    seed = make_node(
+        seed_cfg,
+        app=KVStoreApplication(),
+        genesis=GenesisDoc.from_json(doc_json),
+        priv_validator=None,
+        node_key=NodeKey.generate(bytes([91]) * 32),
+        with_rpc=False,
+    )
+    assert seed.consensus_reactor is None  # seed runs no consensus gossip
+    assert seed.pex_reactor is not None
+
+    vals = []
+    for i in range(2):
+        cfg = _make_config(i)
+        cfg.p2p.pex = True
+        node = make_node(
+            cfg,
+            app=KVStoreApplication(),
+            genesis=GenesisDoc.from_json(doc_json),
+            priv_validator=FilePV(sks[i]),
+            node_key=NodeKey.generate(bytes([i + 93]) * 32),
+            with_rpc=False,
+        )
+        vals.append(node)
+    # validators know ONLY the seed; the seed knows both (as a bootstrap
+    # would after they dial in)
+    for n in vals:
+        n.router._pm.add_address(
+            PeerAddress(seed.node_id, seed.router._transport.listen_addr),
+            persistent=True,
+        )
+        seed.router._pm.add_address(
+            PeerAddress(n.node_id, n.router._transport.listen_addr)
+        )
+    seed.start()
+    for n in vals:
+        n.start()
+    try:
+        # consensus requires the two validators to find EACH OTHER via
+        # pex address exchange through the seed (2/3 of power = both)
+        vals[0].wait_for_height(3, timeout=90)
+        vals[1].wait_for_height(3, timeout=90)
+        assert any(
+            pid == vals[1].node_id for pid in vals[0].router.connected()
+        ), "validators never learned each other's address via pex"
+    finally:
+        for n in vals:
+            n.stop()
+        seed.stop()
