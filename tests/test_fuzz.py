@@ -131,3 +131,56 @@ class TestRPCFuzz:
                     pass  # 4xx/5xx is fine; crash/hang is not
         finally:
             srv.stop()
+
+
+class TestWALFuzz:
+    """internal/consensus/wal_fuzz.go: arbitrary bytes fed to the WAL
+    decoder must produce clean errors or truncated iteration — never an
+    unhandled crash; and every well-formed prefix must replay."""
+
+    def test_decoder_survives_garbage(self, tmp_path):
+        from tendermint_tpu.consensus.wal import WAL, WALMessage
+
+        rng = random.Random(99)
+        for trial in range(40):
+            p = tmp_path / f"wal-{trial}"
+            p.mkdir()
+            wal = WAL(str(p / "wal"))
+            wal.start()
+            for i in range(3):
+                wal.write(
+                    WALMessage(msg_kind="vote", msg_payload=b"msg-%d" % i)
+                )
+            wal.stop()
+            # corrupt the file: random mutations, truncations, prepends
+            files = sorted(p.glob("wal*"))
+            assert files, list(p.iterdir())
+            target = files[0]
+            blob = bytearray(target.read_bytes())
+            op = trial % 4
+            if op == 0 and blob:
+                blob[rng.randrange(len(blob))] ^= 0xFF
+            elif op == 1:
+                blob = blob[: rng.randrange(len(blob) + 1)]
+            elif op == 2:
+                blob = bytearray(rng.randbytes(rng.randrange(0, 64))) + blob
+            else:
+                blob += rng.randbytes(rng.randrange(1, 40))
+            target.write_bytes(bytes(blob))
+            wal2 = WAL(str(p / "wal"))
+            wal2.start()  # torn-tail repair must not crash
+            count = 0
+            try:
+                for _ in wal2.iter_messages():
+                    count += 1
+            except (ValueError, EOFError):
+                pass  # clean decode error is acceptable
+            wal2.stop()
+            # safety property: bounded, crash-free iteration (corrupted
+            # framing may occasionally mis-sync into extra records; the
+            # guarantee is clean errors, not record-exact recovery)
+            assert count <= 16
+            # replay property: append-only garbage leaves every original
+            # frame intact, so all three records must still replay
+            if op == 3:
+                assert count >= 3, (trial, count)

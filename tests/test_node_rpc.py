@@ -339,10 +339,10 @@ def test_seed_node_pex_discovery():
         seed.router._pm.add_address(
             PeerAddress(n.node_id, n.router._transport.listen_addr)
         )
-    seed.start()
-    for n in vals:
-        n.start()
     try:
+        seed.start()
+        for n in vals:
+            n.start()
         # consensus requires the two validators to find EACH OTHER via
         # pex address exchange through the seed (2/3 of power = both)
         vals[0].wait_for_height(3, timeout=90)
