@@ -164,11 +164,16 @@ class SignerClient(PrivValidator):
                     self._buf = b""
                     if attempt == 1:
                         raise RemoteSignerError("remote signer unreachable")
-        f = decode_message(msg)
-        if want_field not in f:
-            raise RemoteSignerError(f"unexpected response {list(f)}")
-        r = decode_message(field_bytes(f, want_field))
-        err = field_bytes(r, 2)
+        try:
+            f = decode_message(msg)
+            if want_field not in f:
+                raise RemoteSignerError(f"unexpected response {list(f)}")
+            r = decode_message(field_bytes(f, want_field))
+            err = field_bytes(r, 2)
+        except ValueError as e:
+            # malformed frame: a TRANSPORT-class failure (retryable),
+            # not a signer-reported refusal
+            raise RemoteSignerError(f"undecodable response: {e}") from e
         if err:
             raise ValueError(err.decode())
         return field_bytes(r, 1)
@@ -179,11 +184,17 @@ class SignerClient(PrivValidator):
 
     def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
         raw = self._round_trip(_msg(3, {1: vote.encode(), 2: chain_id}), 4)
-        return Vote.decode(raw)
+        try:
+            return Vote.decode(raw)
+        except ValueError as e:
+            raise RemoteSignerError(f"undecodable signed vote: {e}") from e
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
         raw = self._round_trip(_msg(5, {1: proposal.encode(), 2: chain_id}), 6)
-        return Proposal.decode(raw)
+        try:
+            return Proposal.decode(raw)
+        except ValueError as e:
+            raise RemoteSignerError(f"undecodable signed proposal: {e}") from e
 
     def ping(self) -> None:
         self._round_trip(_msg(7, {}), 8)
@@ -192,3 +203,46 @@ class SignerClient(PrivValidator):
         if self._sock is not None:
             self._sock.close()
         self._listener.close()
+
+
+class RetrySignerClient(PrivValidator):
+    """privval/retry_signer_client.go: wraps SignerClient, retrying each
+    operation (except ping) with a delay between attempts. retries=0
+    retries indefinitely. Transport failures (RemoteSignerError / OSError)
+    are retried; a signer-REPORTED error (ValueError — e.g. the remote
+    double-sign guard refusing) is never retried."""
+
+    def __init__(self, next_client: SignerClient, retries: int = 5, timeout: float = 1.0):
+        self._next = next_client
+        self._retries = retries
+        self._timeout = timeout
+
+    def _retry(self, fn):
+        last: Exception = RemoteSignerError("no attempts made")
+        i = 0
+        while self._retries == 0 or i < self._retries:
+            i += 1
+            try:
+                return fn()
+            except ValueError:
+                raise  # signer-reported: do not retry
+            except (RemoteSignerError, OSError) as e:
+                last = e
+                if self._retries == 0 or i < self._retries:
+                    time.sleep(self._timeout)  # only between attempts
+        raise RemoteSignerError(f"exhausted all attempts: {last}") from last
+
+    def get_pub_key(self) -> PubKey:
+        return self._retry(self._next.get_pub_key)
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        return self._retry(lambda: self._next.sign_vote(chain_id, vote))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        return self._retry(lambda: self._next.sign_proposal(chain_id, proposal))
+
+    def ping(self) -> None:
+        self._next.ping()  # no retry, like the reference
+
+    def close(self) -> None:
+        self._next.close()

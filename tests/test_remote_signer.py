@@ -83,3 +83,59 @@ class TestRemoteSigner:
         assert sv2.timestamp == v1.timestamp
         assert sv2.signature == sv1.signature
         assert pv.get_pub_key().verify_signature(sv2.sign_bytes(CHAIN), sv2.signature)
+
+
+class TestRetrySignerClient:
+    """privval/retry_signer_client.go semantics: transport errors retried
+    (bounded or indefinite), signer-reported errors surfaced immediately."""
+
+    def _wrap(self, inner, **kw):
+        from tendermint_tpu.privval.remote import RetrySignerClient
+
+        return RetrySignerClient(inner, **kw)
+
+    def test_transport_errors_retried_until_success(self):
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.privval.remote import RemoteSignerError
+
+        calls = {"n": 0}
+        pub = ed25519.gen_priv_key(b"\x05" * 32).pub_key()
+
+        class Flaky:
+            def get_pub_key(self):
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise RemoteSignerError("transient")
+                return pub
+
+        rc = self._wrap(Flaky(), retries=5, timeout=0.01)
+        assert rc.get_pub_key() is pub
+        assert calls["n"] == 3
+
+    def test_retries_exhausted(self):
+        import pytest as _pytest
+
+        from tendermint_tpu.privval.remote import RemoteSignerError
+
+        class Dead:
+            def get_pub_key(self):
+                raise RemoteSignerError("down")
+
+        rc = self._wrap(Dead(), retries=3, timeout=0.01)
+        with _pytest.raises(RemoteSignerError, match="exhausted"):
+            rc.get_pub_key()
+
+    def test_signer_reported_error_not_retried(self):
+        import pytest as _pytest
+
+        calls = {"n": 0}
+
+        class Refusing:
+            def sign_vote(self, chain_id, vote):
+                calls["n"] += 1
+                raise ValueError("double sign")
+
+        rc = self._wrap(Refusing(), retries=5, timeout=0.01)
+        with _pytest.raises(ValueError, match="double sign"):
+            rc.sign_vote("c", object())
+        assert calls["n"] == 1
