@@ -91,3 +91,186 @@ class LocalRPCClient:
 
     def __getattr__(self, name):
         return getattr(self._env, name)
+
+
+class WSClient:
+    """Websocket JSON-RPC client with event subscriptions — the client
+    half of rpc/jsonrpc/client/ws_client.go + rpc/client/http Subscribe:
+    one connection carries request/response calls AND pushed subscription
+    events (demuxed by id: calls echo the integer id, event pushes carry
+    the server's "<query>#event" string id)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        import os
+        import socket as _s
+        import threading
+
+        host, _, port = addr.replace("http://", "").replace("tcp://", "").rpartition(":")
+        self._sock = _s.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=timeout
+        )
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("websocket handshake failed")
+            buf += chunk
+        headers, _, leftover = buf.partition(b"\r\n\r\n")
+        if b"101" not in headers.split(b"\r\n", 1)[0]:
+            raise ConnectionError(f"websocket upgrade refused: {headers[:80]!r}")
+        # the handshake timeout must not govern the frame stream: an idle
+        # subscription would otherwise kill the reader after `timeout`s
+        self._sock.settimeout(None)
+        # frame bytes the server pipelined behind the 101 must not be lost
+        self._rfile = _LeftoverReader(leftover, self._sock.makefile("rb"))
+        self._next_id = 0
+        self._mtx = threading.Lock()
+        self._write_mtx = threading.Lock()
+        self._responses: dict = {}
+        self._abandoned: set = set()
+        self._resp_cv = threading.Condition(self._mtx)
+        import queue as _q
+
+        self._events: "_q.Queue[dict]" = _q.Queue()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- framing ---------------------------------------------------------
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        import os
+
+        from .websocket import encode_frame
+
+        data = encode_frame(opcode, payload, mask=os.urandom(4))
+        with self._write_mtx:  # reader PONGs race application calls
+            self._sock.sendall(data)
+
+    def _read_loop(self) -> None:
+        from .websocket import OP_CLOSE, OP_PING, OP_PONG, OP_TEXT, read_frame
+
+        try:
+            while not self._closed.is_set():
+                try:
+                    frame = read_frame(self._rfile)
+                except Exception:  # noqa: BLE001 — truncated frame/EOF
+                    break
+                if frame is None:
+                    break
+                opcode, payload = frame
+                if opcode == OP_CLOSE:
+                    break
+                if opcode == OP_PING:
+                    try:
+                        self._send_frame(OP_PONG, payload)
+                    except OSError:
+                        break
+                    continue
+                if opcode != OP_TEXT:
+                    continue
+                try:
+                    msg = json.loads(payload)
+                except ValueError:
+                    continue
+                mid = msg.get("id")
+                if isinstance(mid, str) and mid.endswith("#event"):
+                    self._events.put(msg.get("result", {}))
+                else:
+                    with self._resp_cv:
+                        if mid in self._abandoned:
+                            self._abandoned.discard(mid)  # late reply: drop
+                        else:
+                            self._responses[mid] = msg
+                            self._resp_cv.notify_all()
+        finally:
+            self._closed.set()
+            with self._resp_cv:
+                self._resp_cv.notify_all()
+
+    # -- JSON-RPC --------------------------------------------------------
+
+    def call(self, method: str, params: Optional[dict] = None, timeout: float = 30.0):
+        import time as _t
+
+        from .websocket import OP_TEXT
+
+        with self._mtx:
+            self._next_id += 1
+            rid = self._next_id
+        self._send_frame(
+            OP_TEXT,
+            json.dumps(
+                {"jsonrpc": "2.0", "id": rid, "method": method, "params": params or {}}
+            ).encode(),
+        )
+        deadline = _t.monotonic() + timeout
+        with self._resp_cv:
+            while rid not in self._responses:
+                if self._closed.is_set():
+                    raise ConnectionError("websocket closed")
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    self._abandoned.add(rid)  # drop the late reply
+                    raise TimeoutError(f"no response to {method} within {timeout}s")
+                self._resp_cv.wait(timeout=min(remaining, 0.5))
+            msg = self._responses.pop(rid)
+        err = msg.get("error")
+        if err:
+            raise RPCError(
+                err.get("code", -1), err.get("message", ""), err.get("data", "")
+            )
+        return msg.get("result")
+
+    # -- subscriptions (rpc/client/http Subscribe) -----------------------
+
+    def subscribe(self, query: str, timeout: float = 30.0) -> None:
+        self.call("subscribe", {"query": query}, timeout=timeout)
+
+    def unsubscribe(self, query: str, timeout: float = 30.0) -> None:
+        self.call("unsubscribe", {"query": query}, timeout=timeout)
+
+    def unsubscribe_all(self, timeout: float = 30.0) -> None:
+        self.call("unsubscribe_all", {}, timeout=timeout)
+
+    def next_event(self, timeout: float = 30.0) -> dict:
+        """Next pushed subscription event: {"query", "data", "events"}."""
+        import queue as _q
+
+        try:
+            return self._events.get(timeout=timeout)
+        except _q.Empty:
+            raise TimeoutError(f"no event within {timeout}s") from None
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _LeftoverReader:
+    """File-like serving buffered bytes before the underlying stream —
+    frame data the server pipelined behind the handshake response."""
+
+    def __init__(self, leftover: bytes, rfile):
+        self._buf = leftover
+        self._rfile = rfile
+
+    def read(self, n: int) -> bytes:
+        out = b""
+        if self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+            n -= len(out)
+        if n > 0:
+            out += self._rfile.read(n)
+        return out

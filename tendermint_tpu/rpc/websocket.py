@@ -31,15 +31,21 @@ def accept_key(client_key: str) -> str:
     ).decode()
 
 
-def encode_frame(opcode: int, payload: bytes) -> bytes:
+def encode_frame(opcode: int, payload: bytes, mask: bytes = b"") -> bytes:
+    """One frame. Servers send unmasked; clients pass a 4-byte mask
+    (RFC 6455 §5.3 requires client frames to be masked)."""
+    mask_bit = 0x80 if mask else 0
     head = bytes([0x80 | opcode])
     n = len(payload)
     if n < 126:
-        head += bytes([n])
+        head += bytes([mask_bit | n])
     elif n < 65536:
-        head += bytes([126]) + struct.pack(">H", n)
+        head += bytes([mask_bit | 126]) + struct.pack(">H", n)
     else:
-        head += bytes([127]) + struct.pack(">Q", n)
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+    if mask:
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        return head + mask + payload
     return head + payload
 
 

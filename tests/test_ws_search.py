@@ -153,3 +153,30 @@ class TestQueryGrammar:
         assert [r["height"] for r in sink.search_txs("transfer.amount > 150")] == [6]
         assert [r["height"] for r in sink.search_txs("transfer.amount <= 100")] == [5]
         assert [r["height"] for r in sink.search_txs("tx.height >= 6 AND transfer.amount EXISTS")] == [6]
+
+
+class TestWSClient:
+    """Library websocket client (rpc/jsonrpc/client/ws_client.go +
+    rpc/client/http Subscribe): calls and event subscription through one
+    connection, no hand-rolled frames."""
+
+    def test_ws_client_subscribe_and_call(self, two_node_net):  # noqa: F811
+        from tendermint_tpu.rpc.client import WSClient
+
+        nodes = two_node_net
+        nodes[0].wait_for_height(1, timeout=60)
+        c = WSClient(nodes[0].rpc_server.listen_addr)
+        try:
+            # plain JSON-RPC call over the socket
+            st = c.call("status")
+            assert int(st["sync_info"]["latest_block_height"]) >= 1
+            # subscription stream
+            c.subscribe("tm.event='NewBlock'")
+            ev = c.next_event(timeout=30)
+            assert ev["query"] == "tm.event='NewBlock'"
+            assert "tm.event" in ev["events"]
+            ev2 = c.next_event(timeout=30)
+            assert ev2["query"] == "tm.event='NewBlock'"
+            c.unsubscribe_all()
+        finally:
+            c.close()
