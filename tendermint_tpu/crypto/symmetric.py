@@ -1,0 +1,230 @@
+"""Symmetric crypto utilities (SURVEY §1 L1).
+
+Two self-contained constructions the reference ships next to the key
+crypto:
+
+- XChaCha20-Poly1305 AEAD (crypto/xchacha20poly1305/xchachapoly.go):
+  HChaCha20 subkey from the first 16 nonce bytes, then standard
+  ChaCha20-Poly1305 (via OpenSSL through `cryptography`) with a 12-byte
+  subnonce of 4 zero bytes + the last 8 nonce bytes. 24-byte nonces are
+  safe to pick at random.
+- xsalsa20symmetric (crypto/xsalsa20symmetric/symmetric.go): NaCl
+  secretbox (XSalsa20 + Poly1305) with a random 24-byte nonce prepended
+  to the box. Salsa20 core and Poly1305 are implemented here from the
+  public specifications (no nacl binding in this image); this is
+  operator-tooling crypto (key files), not a hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+KEY_SIZE = 32
+NONCE_SIZE = 24
+TAG_SIZE = 16
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20 / HChaCha20
+# ---------------------------------------------------------------------------
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & _M32
+
+
+def _chacha_quarter(s, a, b, c, d) -> None:
+    s[a] = (s[a] + s[b]) & _M32
+    s[d] = _rotl32(s[d] ^ s[a], 16)
+    s[c] = (s[c] + s[d]) & _M32
+    s[b] = _rotl32(s[b] ^ s[c], 12)
+    s[a] = (s[a] + s[b]) & _M32
+    s[d] = _rotl32(s[d] ^ s[a], 8)
+    s[c] = (s[c] + s[d]) & _M32
+    s[b] = _rotl32(s[b] ^ s[c], 7)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """32-byte subkey from a 256-bit key and a 128-bit nonce (the XChaCha
+    KDF; xchachapoly.go HChaCha20)."""
+    if len(key) != KEY_SIZE:
+        raise ValueError("hchacha20: key must be 32 bytes")
+    if len(nonce16) < 16:
+        raise ValueError("hchacha20: nonce must be at least 16 bytes")
+    s = list(_SIGMA) + list(struct.unpack("<8L", key)) + list(
+        struct.unpack("<4L", nonce16[:16])
+    )
+    for _ in range(10):
+        _chacha_quarter(s, 0, 4, 8, 12)
+        _chacha_quarter(s, 1, 5, 9, 13)
+        _chacha_quarter(s, 2, 6, 10, 14)
+        _chacha_quarter(s, 3, 7, 11, 15)
+        _chacha_quarter(s, 0, 5, 10, 15)
+        _chacha_quarter(s, 1, 6, 11, 12)
+        _chacha_quarter(s, 2, 7, 8, 13)
+        _chacha_quarter(s, 3, 4, 9, 14)
+    return struct.pack("<4L", *s[0:4]) + struct.pack("<4L", *s[12:16])
+
+
+class XChaCha20Poly1305:
+    """crypto.AEAD parity with crypto/xchacha20poly1305 (24-byte nonces)."""
+
+    def __init__(self, key: bytes):
+        if len(key) != KEY_SIZE:
+            raise ValueError("xchacha20poly1305: bad key length")
+        self._key = bytes(key)
+
+    @property
+    def nonce_size(self) -> int:
+        return NONCE_SIZE
+
+    @property
+    def overhead(self) -> int:
+        return TAG_SIZE
+
+    def _inner(self, nonce: bytes):
+        from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+        if len(nonce) != NONCE_SIZE:
+            raise ValueError("xchacha20poly1305: bad nonce length")
+        subkey = hchacha20(self._key, nonce[:16])
+        subnonce = b"\x00" * 4 + nonce[16:]
+        return ChaCha20Poly1305(subkey), subnonce
+
+    def seal(self, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+        aead, subnonce = self._inner(nonce)
+        return aead.encrypt(subnonce, plaintext, aad or None)
+
+    def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        from cryptography.exceptions import InvalidTag
+
+        aead, subnonce = self._inner(nonce)
+        try:
+            return aead.decrypt(subnonce, ciphertext, aad or None)
+        except InvalidTag:
+            raise ValueError("xchacha20poly1305: message authentication failed")
+
+
+# ---------------------------------------------------------------------------
+# Salsa20 / XSalsa20 secretbox
+# ---------------------------------------------------------------------------
+
+
+def _salsa_quarter(s, a, b, c, d) -> None:
+    s[b] ^= _rotl32((s[a] + s[d]) & _M32, 7)
+    s[c] ^= _rotl32((s[b] + s[a]) & _M32, 9)
+    s[d] ^= _rotl32((s[c] + s[b]) & _M32, 13)
+    s[a] ^= _rotl32((s[d] + s[c]) & _M32, 18)
+
+
+def _salsa20_rounds(state):
+    s = list(state)
+    for _ in range(10):
+        _salsa_quarter(s, 0, 4, 8, 12)
+        _salsa_quarter(s, 5, 9, 13, 1)
+        _salsa_quarter(s, 10, 14, 2, 6)
+        _salsa_quarter(s, 15, 3, 7, 11)
+        _salsa_quarter(s, 0, 1, 2, 3)
+        _salsa_quarter(s, 5, 6, 7, 4)
+        _salsa_quarter(s, 10, 11, 8, 9)
+        _salsa_quarter(s, 15, 12, 13, 14)
+    return s
+
+
+def _salsa20_block(key: bytes, nonce8: bytes, counter: int) -> bytes:
+    k = struct.unpack("<8L", key)
+    n = struct.unpack("<2L", nonce8)
+    state = (
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        counter & _M32, (counter >> 32) & _M32, _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    )
+    s = _salsa20_rounds(state)
+    return struct.pack("<16L", *((a + b) & _M32 for a, b in zip(s, state)))
+
+
+def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """XSalsa20 KDF: 32 output bytes from key + 16-byte nonce."""
+    k = struct.unpack("<8L", key)
+    n = struct.unpack("<4L", nonce16)
+    state = (
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        n[2], n[3], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    )
+    s = _salsa20_rounds(state)
+    out = [s[0], s[5], s[10], s[15], s[6], s[7], s[8], s[9]]
+    return struct.pack("<8L", *out)
+
+
+def _xsalsa20_stream(key: bytes, nonce24: bytes, length: int, first_block=b""):
+    """Keystream bytes [0, length) of XSalsa20; first_block gives bytes
+    0..63 already computed (block reuse between MAC key and payload)."""
+    subkey = hsalsa20(key, nonce24[:16])
+    out = bytearray(first_block)
+    counter = len(first_block) // 64
+    while len(out) < length:
+        out += _salsa20_block(subkey, nonce24[16:], counter)
+        counter += 1
+    return bytes(out[:length])
+
+
+_P1305 = (1 << 130) - 5
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i : i + 16]
+        acc = (acc + int.from_bytes(block, "little") + (1 << (8 * len(block)))) * r % _P1305
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def secretbox_seal(plaintext: bytes, key: bytes, nonce: bytes) -> bytes:
+    """NaCl secretbox: returns poly1305 tag || xsalsa20-xor ciphertext."""
+    stream = _xsalsa20_stream(key, nonce, 32 + len(plaintext))
+    mac_key, pad = stream[:32], stream[32:64]
+    # NaCl xors the plaintext against the stream starting at byte 32
+    ct = bytes(p ^ k for p, k in zip(plaintext, stream[32:]))
+    tag = _poly1305(mac_key, ct)
+    return tag + ct
+
+
+def secretbox_open(box: bytes, key: bytes, nonce: bytes) -> bytes:
+    import hmac as _hmac
+
+    if len(box) < TAG_SIZE:
+        raise ValueError("ciphertext is too short")
+    tag, ct = box[:TAG_SIZE], box[TAG_SIZE:]
+    stream = _xsalsa20_stream(key, nonce, 32 + len(ct))
+    mac_key = stream[:32]
+    if not _hmac.compare_digest(tag, _poly1305(mac_key, ct)):
+        raise ValueError("ciphertext decryption failed")
+    return bytes(c ^ k for c, k in zip(ct, stream[32:]))
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """xsalsa20symmetric.EncryptSymmetric: random 24-byte nonce || box.
+    Ciphertext is (16 + 24) bytes longer than the plaintext."""
+    if len(secret) != KEY_SIZE:
+        raise ValueError(f"secret must be 32 bytes long, got len {len(secret)}")
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + secretbox_seal(plaintext, secret, nonce)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    """xsalsa20symmetric.DecryptSymmetric."""
+    if len(secret) != KEY_SIZE:
+        raise ValueError(f"secret must be 32 bytes long, got len {len(secret)}")
+    if len(ciphertext) <= TAG_SIZE + NONCE_SIZE:
+        raise ValueError("ciphertext is too short")
+    nonce, box = ciphertext[:NONCE_SIZE], ciphertext[NONCE_SIZE:]
+    return secretbox_open(box, secret, nonce)

@@ -150,12 +150,20 @@ class Client:
         witness must agree with the primary's root header. A witness that
         cannot serve the height (unreachable / missing block) is ignored —
         the reference keeps such witnesses too; one that serves a
-        DIFFERENT header is a conflict the operator must resolve (raise)."""
+        DIFFERENT header is a conflict the operator must resolve (raise).
+        No witnesses at all is ErrNoWitnesses (light/errors.go): a client
+        with nothing to cross-check against must not bootstrap silently."""
+        if not self._witnesses:
+            raise ErrNoWitnesses(
+                "no witnesses configured; cannot cross-check the root header"
+            )
+        compared = 0
         for i, w in enumerate(self._witnesses):
             try:
                 wlb = w.light_block(root.height)
-            except Exception:  # noqa: BLE001 — unreachable/missing: ignore
-                continue
+            except (OSError, KeyError, TimeoutError, ConnectionError, RuntimeError):
+                continue  # unreachable / missing block: ignore this witness
+            compared += 1
             if wlb.hash() != root.hash():
                 # compareNewHeaderWithWitness: hash mismatch at the root is
                 # errConflictingHeaders — the operator must pick a side
@@ -163,6 +171,11 @@ class Client:
                     f"witness {i} has a different header at the root height "
                     f"{root.height}: {wlb.hash().hex()} vs {root.hash().hex()}"
                 )
+        if compared == 0:
+            raise ErrFailedHeaderCrossReferencing(
+                f"none of the {len(self._witnesses)} configured witnesses "
+                f"could serve the root header at height {root.height}"
+            )
 
     # -- public API -------------------------------------------------------
 

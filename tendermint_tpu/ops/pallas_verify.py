@@ -101,6 +101,30 @@ def point_neg(p):
     return (fe_t.neg(x), y, z, fe_t.neg(t))
 
 
+def to_niels(p):
+    """Projective (X, Y, Z, T) -> cached/Niels form (Y+X, Y-X, Z, T*2d).
+    Table entries are stored this way so the ladder's add costs 8 field
+    muls instead of 9 and skips two per-iteration carry passes."""
+    x, y, z, t = p
+    return (fe_t.add(y, x), fe_t.sub(y, x), z, fe_t.mul(t, D2_T()))
+
+
+def point_add_niels(p, q):
+    """acc (projective) + table entry (Niels form)."""
+    x1, y1, z1, t1 = p
+    yplusx2, yminusx2, z2, t2d2 = q
+    a = fe_t.mul(fe_t.sub(y1, x1), yminusx2)
+    b = fe_t.mul(fe_t.add(y1, x1), yplusx2)
+    c = fe_t.mul(t1, t2d2)
+    zz = fe_t.mul(z1, z2)
+    d = fe_t.add(zz, zz)
+    e = fe_t.sub(b, a)
+    f = fe_t.sub(d, c)
+    g = fe_t.add(d, c)
+    h = fe_t.add(b, a)
+    return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), fe_t.mul(e, h))
+
+
 def sqrt_ratio(u, v):
     v3 = fe_t.mul(fe_t.sq(v), v)
     v7 = fe_t.mul(fe_t.sq(v3), v)
@@ -233,15 +257,22 @@ def _k2_table_kernel(coords_ref, tbl_ref):
         _catp([b_row[s2] for k2 in range(1, 4) for s2 in range(1, 4)]),
         _catp([a_col[k2] for k2 in range(1, 4) for s2 in range(1, 4)]),
     )
+    entries = []
     for k2 in range(4):
         for s2 in range(4):
-            e = k2 * 4 + s2
             if k2 == 0:
-                ent = b_row[s2]
+                entries.append(b_row[s2])
             elif s2 == 0:
-                ent = a_col[k2]
+                entries.append(a_col[k2])
             else:
-                ent = _slicep(cross, (k2 - 1) * 3 + (s2 - 1), B)
+                entries.append(_slicep(cross, (k2 - 1) * 3 + (s2 - 1), B))
+    # store in Niels form (Y+X, Y-X, Z, T*2d): one 8-lane-folded to_niels
+    # per half keeps the (20, 20, lanes) mul transient within VMEM
+    for half in range(2):
+        niels = to_niels(_catp(entries[half * 8 : half * 8 + 8]))
+        for j in range(8):
+            e = half * 8 + j
+            ent = _slicep(niels, j, B)
             for c in range(4):
                 tbl_ref[(e * 4 + c) * 32 : (e * 4 + c) * 32 + NL] = ent[c]
 
@@ -269,7 +300,7 @@ def _k3_ladder_kernel(tbl_ref, sdig_ref, kdig_ref, coords_ref, ok_ref, sok_ref, 
     def body(i, acc):
         j = _digit_row(126 - i)
         acc = point_double(point_double(acc))
-        return point_add(acc, select(sdig_ref[j] + 4 * kdig_ref[j]))
+        return point_add_niels(acc, select(sdig_ref[j] + 4 * kdig_ref[j]))
 
     acc = lax.fori_loop(0, 127, body, ident)
     R = tuple(coords_ref[(4 + c) * 32 : (4 + c) * 32 + NL] for c in range(4))

@@ -47,7 +47,7 @@ class AsyncBatchVerifier:
     batches bound device memory (2 = classic double buffering).
     """
 
-    def __init__(self, depth: int = 2):
+    def __init__(self, depth: int = 3):
         self._depth = max(depth, 1)
         self._q: "queue.Queue[_Job]" = queue.Queue()
         self._stopped = threading.Event()
@@ -154,6 +154,15 @@ class AsyncBatchVerifier:
                         entries.extend(j.entries)
                     try:
                         dev = self._dispatch(entries)
+                        # start the device->host copy NOW: a blocking fetch
+                        # through the relay costs a full ~65ms RTT, but an
+                        # async copy rides behind the compute, so the later
+                        # np.asarray in _resolve returns in microseconds
+                        # (measured: sustained 152k -> 286k sigs/s)
+                        try:
+                            dev.copy_to_host_async()
+                        except AttributeError:
+                            pass
                         pending.append((spans, dev))
                     except Exception as e:  # noqa: BLE001
                         for j, _, _ in spans:

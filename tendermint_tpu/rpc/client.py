@@ -105,10 +105,14 @@ class WSClient:
         import socket as _s
         import threading
 
-        host, _, port = addr.replace("http://", "").replace("tcp://", "").rpartition(":")
-        self._sock = _s.create_connection(
-            (host or "127.0.0.1", int(port)), timeout=timeout
-        )
+        from urllib.parse import urlsplit
+
+        if "//" not in addr:
+            addr = "//" + addr
+        parts = urlsplit(addr.replace("tcp://", "http://"), scheme="http")
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or 26657
+        self._sock = _s.create_connection((host, port), timeout=timeout)
         key = base64.b64encode(os.urandom(16)).decode()
         self._sock.sendall(
             (

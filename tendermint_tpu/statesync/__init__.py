@@ -675,6 +675,16 @@ class StateSyncReactor:
             if entry is not None:
                 return entry
             peers = [p for p in (snap.peers or [""]) if p not in banned]
+            if snap.peers and not peers:
+                # every known source of this snapshot has been banned via
+                # RejectSenders — replies from them are dropped on receipt
+                # (_handle_chunk_msg), so waiting out the timeout can never
+                # succeed; fail the restore attempt now (syncer.go
+                # applyChunks errNoSnapshotSources spirit)
+                raise SyncError(
+                    f"no usable sources for chunk {index}: all "
+                    f"{len(snap.peers)} snapshot peers are banned"
+                )
             for peer in peers or [""]:
                 msg = _enc(1, {1: snap.height, 2: snap.format, 3: index})
                 if peer:
