@@ -1293,7 +1293,284 @@ static PyObject *py_sr25519_verify_batch(PyObject *, PyObject *args) {
   return out;
 }
 
+// --------------------------------------------------------------------------
+// Host ed25519 RLC batch verification (the honest CPU batch baseline and
+// the no-device fallback). Same construction as Go crypto/ed25519's batch
+// path (crypto/ed25519/ed25519.go:192-227 -> curve25519-voi BatchVerifier):
+// random 128-bit coefficients z_i, one cofactored check
+//   [8]( sum z_i R_i + sum (z_i k_i mod L) A_i - [sum z_i s_i mod L] B ) == O
+// evaluated with a Pippenger multi-scalar multiplication over 2n points.
+
+#include <sys/random.h>
+
+namespace ed {
+
+// ZIP-215 edwards decompression (crypto/_edwards.py decompress with
+// allow_noncanonical=True): y from the low 255 bits WITHOUT a y < p
+// canonicity check, "negative zero" x accepted.
+static bool ge_frombytes_zip215(point &out, const uint8_t in[32]) {
+  fe y, yy, u, v, x;
+  fe_frombytes(y, in);  // drops bit 255; value may be >= p (allowed)
+  int sign = in[31] >> 7;
+  fe_sq(yy, y);
+  fe one;
+  fe_one(one);
+  fe_sub(u, yy, one);
+  fe_carry(u);
+  fe_mul(v, D_FE, yy);
+  fe_add(v, v, one);
+  fe_carry(v);
+  if (!fe_sqrt_ratio(x, u, v)) return false;
+  if (fe_is_negative(x) != (sign != 0)) fe_neg(x, x);
+  fe_copy(out.x, x);
+  fe_copy(out.y, y);
+  fe_one(out.z);
+  fe_mul(out.t, x, y);
+  return true;
+}
+
+// 256-bit LE schoolbook product -> 64-byte LE -> mod L
+static void sc_mul(uint8_t out[32], const uint8_t a[32], const uint8_t b[32]) {
+  uint64_t al[4], bl[4];
+  for (int i = 0; i < 4; i++) {
+    al[i] = bl[i] = 0;
+    for (int j = 0; j < 8; j++) {
+      al[i] |= (uint64_t)a[8 * i + j] << (8 * j);
+      bl[i] |= (uint64_t)b[8 * i + j] << (8 * j);
+    }
+  }
+  uint64_t prod[8] = {0};
+  for (int i = 0; i < 4; i++) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      unsigned __int128 cur =
+          (unsigned __int128)al[i] * bl[j] + prod[i + j] + carry;
+      prod[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    prod[i + 4] = (uint64_t)carry;
+  }
+  uint8_t wide[64];
+  for (int i = 0; i < 8; i++)
+    for (int j = 0; j < 8; j++) wide[8 * i + j] = (uint8_t)(prod[i] >> (8 * j));
+  sha512::mod_l(wide, out);
+}
+
+// out = (a + b) mod L for a, b < L
+static void sc_add(uint8_t out[32], const uint8_t a[32], const uint8_t b[32]) {
+  uint64_t al[4], bl[4], s[4];
+  for (int i = 0; i < 4; i++) {
+    al[i] = bl[i] = 0;
+    for (int j = 0; j < 8; j++) {
+      al[i] |= (uint64_t)a[8 * i + j] << (8 * j);
+      bl[i] |= (uint64_t)b[8 * i + j] << (8 * j);
+    }
+  }
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 4; i++) {
+    c += (unsigned __int128)al[i] + bl[i];
+    s[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  // sum < 2L (< 2^253): one conditional subtract of L
+  bool ge = c != 0;
+  if (!ge) {
+    ge = true;
+    for (int i = 3; i >= 0; i--) {
+      if (s[i] > sha512::L_LIMBS[i]) break;
+      if (s[i] < sha512::L_LIMBS[i]) { ge = false; break; }
+    }
+  }
+  if (ge) {
+    uint64_t borrow = 0;
+    for (int i = 0; i < 4; i++) {
+      unsigned __int128 d =
+          (unsigned __int128)s[i] - sha512::L_LIMBS[i] - borrow;
+      s[i] = (uint64_t)d;
+      borrow = (uint64_t)(d >> 64) ? 1 : 0;
+    }
+  }
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) out[8 * i + j] = (uint8_t)(s[i] >> (8 * j));
+}
+
+// Pippenger MSM with 8-bit windows: res = sum scalars[i] * pts[i].
+// Scalars are 32-byte LE (< L). ~n + 512 point adds per window.
+static void pippenger_msm(point &res, const std::vector<uint8_t> &scalars,
+                          const std::vector<point> &pts) {
+  size_t n = pts.size();
+  pt_identity(res);
+  static thread_local std::vector<point> buckets(256);
+  static thread_local std::vector<uint8_t> used(256);
+  for (int w = 31; w >= 0; w--) {
+    if (w != 31)
+      for (int d = 0; d < 8; d++) pt_double(res, res);
+    memset(used.data(), 0, 256);
+    for (size_t i = 0; i < n; i++) {
+      uint8_t dig = scalars[32 * i + w];
+      if (!dig) continue;
+      if (!used[dig]) {
+        buckets[dig] = pts[i];
+        used[dig] = 1;
+      } else {
+        pt_add(buckets[dig], buckets[dig], pts[i]);
+      }
+    }
+    // sum_d d * bucket[d] via suffix sums
+    point running, acc;
+    pt_identity(running);
+    pt_identity(acc);
+    bool any = false;
+    for (int d = 255; d >= 1; d--) {
+      if (used[d]) {
+        pt_add(running, running, buckets[d]);
+        any = true;
+      }
+      if (any) pt_add(acc, acc, running);
+    }
+    if (any) pt_add(res, res, acc);
+  }
+}
+
+// Full RLC batch verification; entries prevalidated by the caller except
+// for the point decodes and s < L checks done here. Returns 1 (batch
+// equation holds), 0 (reject — caller falls back per-sig for blame), or
+// -1 on malformed input.
+static int batch_verify_rlc(const uint8_t *pubs, const uint8_t *sigs,
+                            const std::vector<std::pair<const uint8_t *, size_t>> &msgs) {
+  size_t n = msgs.size();
+  std::vector<point> pts;
+  std::vector<uint8_t> scalars;
+  pts.reserve(2 * n);
+  scalars.reserve(64 * n);
+  uint8_t s_sum[32] = {0};
+  ossl_sha512_fn fast = ossl_sha512();
+  std::vector<uint8_t> cat;
+  static const uint8_t L_BYTES[32] = {
+      0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58, 0xd6, 0x9c, 0xf7,
+      0xa2, 0xde, 0xf9, 0xde, 0x14, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t *pub = pubs + 32 * i;
+    const uint8_t *sig = sigs + 64 * i;
+    // s < L (RFC 8032)
+    bool lt = false;
+    for (int j = 31; j >= 0; j--) {
+      if (sig[32 + j] < L_BYTES[j]) { lt = true; break; }
+      if (sig[32 + j] > L_BYTES[j]) return 0;
+    }
+    if (!lt) return 0;
+    point A, R;
+    if (!ge_frombytes_zip215(A, pub)) return 0;
+    if (!ge_frombytes_zip215(R, sig)) return 0;
+    // k = SHA512(R || A || M) mod L
+    uint8_t digest[64], k[32];
+    if (fast) {
+      cat.resize(64 + msgs[i].second);
+      memcpy(cat.data(), sig, 32);
+      memcpy(cat.data() + 32, pub, 32);
+      if (msgs[i].second) memcpy(cat.data() + 64, msgs[i].first, msgs[i].second);
+      fast(cat.data(), cat.size(), digest);
+    } else {
+      sha512::Ctx c;
+      sha512::init(&c);
+      sha512::update(&c, sig, 32);
+      sha512::update(&c, pub, 32);
+      sha512::update(&c, msgs[i].first, msgs[i].second);
+      sha512::final(&c, digest);
+    }
+    sha512::mod_l(digest, k);
+    // random 128-bit z
+    uint8_t z[32] = {0};
+    if (getrandom(z, 16, 0) != 16) return -1;
+    uint8_t zs[32], zk[32];
+    sc_mul(zs, z, sig + 32);
+    sc_add(s_sum, s_sum, zs);
+    sc_mul(zk, z, k);
+    pts.push_back(R);
+    scalars.insert(scalars.end(), z, z + 32);
+    pts.push_back(A);
+    scalars.insert(scalars.end(), zk, zk + 32);
+  }
+  point msm, sb, check;
+  pippenger_msm(msm, scalars, pts);
+  point base;
+  fe_copy(base.x, BASE_X_FE);
+  fe_copy(base.y, BASE_Y_FE);
+  fe_one(base.z);
+  fe_copy(base.t, BASE_T_FE);
+  pt_scalar_mul(sb, s_sum, base);
+  point neg_sb;
+  pt_neg(neg_sb, sb);
+  pt_add(check, msm, neg_sb);
+  for (int d = 0; d < 3; d++) pt_double(check, check);  // cofactor 8
+  return (fe_is_zero(check.x) && fe_eq(check.y, check.z)) ? 1 : 0;
+}
+
+}  // namespace ed
+
+// ed25519_batch_verify(pubs: n*32, sigs: n*64, msgs: seq[bytes]) -> bool
+//   One RLC batch equation over the whole input (crypto/ed25519/ed25519.go
+//   :219-227 BatchVerifier.Verify semantics: a single cofactored check;
+//   on False the caller re-verifies per signature for blame assignment).
+static PyObject *py_ed25519_batch_verify(PyObject *, PyObject *args) {
+  Py_buffer pubs, sigs;
+  PyObject *msgs;
+  if (!PyArg_ParseTuple(args, "y*y*O", &pubs, &sigs, &msgs)) return nullptr;
+  PyObject *seq = PySequence_Fast(msgs, "expected a sequence of messages");
+  if (!seq) {
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  int rc = -1;
+  if (pubs.len >= 32 * n && sigs.len >= 64 * n) {
+    std::vector<std::pair<const uint8_t *, size_t>> mv;
+    mv.reserve((size_t)n);
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      char *m;
+      Py_ssize_t mlen;
+      if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(seq, i), &m,
+                                  &mlen) < 0) {
+        ok = false;
+        break;
+      }
+      mv.emplace_back((const uint8_t *)m, (size_t)mlen);
+    }
+    if (ok) {
+      if (n == 0) {
+        rc = 0;  // Verify() on an empty batch is false (batch.go:29)
+      } else {
+        Py_BEGIN_ALLOW_THREADS
+        rc = ed::batch_verify_rlc((const uint8_t *)pubs.buf,
+                                  (const uint8_t *)sigs.buf, mv);
+        Py_END_ALLOW_THREADS
+      }
+    } else {
+      Py_DECREF(seq);
+      PyBuffer_Release(&pubs);
+      PyBuffer_Release(&sigs);
+      return nullptr;
+    }
+  } else {
+    PyErr_SetString(PyExc_ValueError, "pubs/sigs shorter than n entries");
+  }
+  Py_DECREF(seq);
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&sigs);
+  if (rc < 0) {
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_RuntimeError, "batch verification failed to run");
+    return nullptr;
+  }
+  return PyBool_FromLong(rc);
+}
+
 static PyMethodDef Methods[] = {
+    {"ed25519_batch_verify", py_ed25519_batch_verify, METH_VARARGS,
+     "Host RLC batch ed25519 verification (Pippenger MSM); returns bool"},
     {"ed25519_challenges", py_ed25519_challenges, METH_VARARGS,
      "Batch k = SHA512(R||A||M) mod L challenge scalars (32B LE each)"},
     {"sr25519_verify_batch", py_sr25519_verify_batch, METH_VARARGS,

@@ -10,6 +10,7 @@ witnesses.
 
 from __future__ import annotations
 
+import http.client as _http
 import time as _time
 from dataclasses import dataclass
 from typing import List, Optional
@@ -161,7 +162,8 @@ class Client:
         for i, w in enumerate(self._witnesses):
             try:
                 wlb = w.light_block(root.height)
-            except (OSError, KeyError, TimeoutError, ConnectionError, RuntimeError):
+            except (OSError, ValueError, KeyError, TimeoutError,
+                    ConnectionError, RuntimeError, _http.HTTPException):
                 continue  # unreachable / missing block: ignore this witness
             compared += 1
             if wlb.hash() != root.hash():

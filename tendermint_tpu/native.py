@@ -37,7 +37,7 @@ def _build() -> bool:
     out = _so_path()
     include = sysconfig.get_path("include")
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-march=x86-64-v3", "-funroll-loops", "-shared", "-fPIC", "-std=c++17",
         f"-I{include}", src, "-o", out,
     ]
     try:

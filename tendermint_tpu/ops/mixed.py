@@ -46,12 +46,12 @@ def _host_sr_batch(entries) -> np.ndarray:
 
 
 def _sr_device_enabled() -> bool:
-    """The sr25519 DEVICE lane is opt-in (TM_TPU_SR_DEVICE=1): its Mosaic
-    compile has been observed to hang the shared remote compile helper,
-    which poisons the relay for every subsequent process on the host (see
-    ops/pallas_sr25519 STATUS). The kernels are differentially validated;
-    flip the default once the toolchain compiles them."""
-    return os.environ.get("TM_TPU_SR_DEVICE", "0") == "1"
+    """sr25519 device lane: ON by default since round 4 — the round-3
+    Mosaic compile hang no longer reproduces (verified on hardware:
+    compiles in ~16s, correct at production buckets vs the host oracle).
+    The first-use watchdog below still guards against a hung remote
+    compile; TM_TPU_SR_DEVICE=0 forces the native host lane."""
+    return os.environ.get("TM_TPU_SR_DEVICE", "1") == "1"
 
 
 def _verify_sr25519_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
@@ -117,15 +117,35 @@ def verify_mixed(
         order.append((kind, len(lanes[kind])))
         lanes[kind].append((pk, msg, sig))
 
+    # Lanes run CONCURRENTLY: the ed25519 batch rides the shared async
+    # pipeline (a future), the sr25519 device batch dispatches on a helper
+    # thread, and the secp256k1 host loop fills the main thread while the
+    # device works — the mixed batch costs max(lanes), not sum(lanes).
     results = {}
+    ed_future = None
+    sr_thread = None
+    sr_holder: dict = {}
     if lanes["ed25519"]:
-        results["ed25519"] = _backend.verify_batch(
-            [(pk.bytes(), m, s) for pk, m, s in lanes["ed25519"]]
-        )
+        ed_entries = [(pk.bytes(), m, s) for pk, m, s in lanes["ed25519"]]
+        if len(ed_entries) <= _backend.BUCKETS[-1]:
+            from .pipeline import shared_verifier
+
+            ed_future = shared_verifier().submit(ed_entries)
+        else:
+            results["ed25519"] = _backend.verify_batch(ed_entries)
     if lanes["sr25519"]:
-        results["sr25519"] = _verify_sr25519_batch(
-            [(pk.bytes(), m, s) for pk, m, s in lanes["sr25519"]]
-        )
+        import threading
+
+        sr_entries = [(pk.bytes(), m, s) for pk, m, s in lanes["sr25519"]]
+
+        def _sr_run():
+            try:
+                sr_holder["res"] = _verify_sr25519_batch(sr_entries)
+            except Exception as e:  # noqa: BLE001
+                sr_holder["err"] = e
+
+        sr_thread = threading.Thread(target=_sr_run, daemon=True)
+        sr_thread.start()
     if lanes["secp256k1"]:
         results["secp256k1"] = np.asarray(
             [pk.verify_signature(m, s) for pk, m, s in lanes["secp256k1"]],
@@ -136,6 +156,15 @@ def verify_mixed(
             [pk.verify_signature(m, s) for pk, m, s in lanes["other"]],
             dtype=bool,
         )
+    if ed_future is not None:
+        results["ed25519"] = np.asarray(ed_future.result(timeout=600))
+    if sr_thread is not None:
+        sr_thread.join(timeout=600)
+        if sr_thread.is_alive():
+            raise TimeoutError("sr25519 device lane did not finish in 600s")
+        if "err" in sr_holder:
+            raise sr_holder["err"]
+        results["sr25519"] = sr_holder["res"]
     return [bool(results[kind][j]) for kind, j in order]
 
 

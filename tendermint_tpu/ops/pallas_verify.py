@@ -83,8 +83,11 @@ def point_add(p, q):
     return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), fe_t.mul(e, h))
 
 
-def point_double(p):
-    x1, y1, z1, _ = p
+def point_double(p, need_t: bool = True):
+    """Doubling never READS t; with need_t=False it also skips producing
+    it (the e*h mul) — valid whenever the consumer is another double or a
+    select, which covers the first double of every ladder iteration."""
+    x1, y1, z1 = p[0], p[1], p[2]
     a = fe_t.sq(x1)
     b = fe_t.sq(y1)
     zz = fe_t.sq(z1)
@@ -93,7 +96,8 @@ def point_double(p):
     g = fe_t.sub(b, a)
     f = fe_t.sub(g, c)
     h = fe_t.neg(fe_t.add(a, b))
-    return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), fe_t.mul(e, h))
+    t = fe_t.mul(e, h) if need_t else jnp.zeros_like(x1)
+    return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), t)
 
 
 def point_neg(p):
@@ -109,8 +113,10 @@ def to_niels(p):
     return (fe_t.add(y, x), fe_t.sub(y, x), z, fe_t.mul(t, D2_T()))
 
 
-def point_add_niels(p, q):
-    """acc (projective) + table entry (Niels form)."""
+def point_add_niels(p, q, need_t: bool = True):
+    """acc (extended projective) + table entry (Niels form). With
+    need_t=False the e*h mul is skipped — sound when the consumer chain
+    never reads T (doubles and the cross-multiplied equality test)."""
     x1, y1, z1, t1 = p
     yplusx2, yminusx2, z2, t2d2 = q
     a = fe_t.mul(fe_t.sub(y1, x1), yminusx2)
@@ -122,7 +128,8 @@ def point_add_niels(p, q):
     f = fe_t.sub(d, c)
     g = fe_t.add(d, c)
     h = fe_t.add(b, a)
-    return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), fe_t.mul(e, h))
+    t = fe_t.mul(e, h) if need_t else jnp.zeros_like(x1)
+    return (fe_t.mul(e, f), fe_t.mul(g, h), fe_t.mul(f, g), t)
 
 
 def sqrt_ratio(u, v):
@@ -299,16 +306,35 @@ def _k3_ladder_kernel(tbl_ref, sdig_ref, kdig_ref, coords_ref, ok_ref, sok_ref, 
 
     def body(i, acc):
         j = _digit_row(126 - i)
-        acc = point_double(point_double(acc))
-        return point_add_niels(acc, select(sdig_ref[j] + 4 * kdig_ref[j]))
+        # inner double & the add skip their T output (never read); only
+        # the outer double's T feeds the Niels add's t1*T2d term
+        acc = point_double(point_double(acc, need_t=False))
+        return point_add_niels(
+            acc, select(sdig_ref[j] + 4 * kdig_ref[j]), need_t=False
+        )
 
     acc = lax.fori_loop(0, 127, body, ident)
+    # [8]([s]B - [k]A - R) == O  <=>  [8]acc == [8]R, checked by projective
+    # cross-multiplication — doubles-only (complete for all inputs, incl.
+    # the small-order/mixed ZIP-215 edge points) and T-free end to end.
     R = tuple(coords_ref[(4 + c) * 32 : (4 + c) * 32 + NL] for c in range(4))
-    acc = point_add(acc, point_neg(R))
-    acc = lax.fori_loop(0, 3, lambda _, p: point_double(p), acc)
-    is_ident = fe_t.is_zero(acc[0]) & fe_t.is_zero(fe_t.sub(acc[1], acc[2]))
+    acc8 = acc
+    r8 = R
+    for _ in range(3):
+        acc8 = point_double(acc8, need_t=False)
+        r8 = point_double(r8, need_t=False)
+    eq_x = fe_t.is_zero(
+        fe_t.sub(fe_t.mul(acc8[0], r8[2]), fe_t.mul(r8[0], acc8[2]))
+    )
+    eq_y = fe_t.is_zero(
+        fe_t.sub(fe_t.mul(acc8[1], r8[2]), fe_t.mul(r8[1], acc8[2]))
+    )
     valid = (
-        (ok_ref[0:1] != 0) & (ok_ref[1:2] != 0) & (sok_ref[0:1] != 0) & is_ident
+        (ok_ref[0:1] != 0)
+        & (ok_ref[1:2] != 0)
+        & (sok_ref[0:1] != 0)
+        & eq_x
+        & eq_y
     )
     out_ref[:] = valid.astype(jnp.int32)
 

@@ -1,5 +1,5 @@
 """tendermint_tpu.rpc — JSON-RPC API (reference rpc/ + internal/rpc/core, L11)."""
 
-from .client import HTTPClient, LocalRPCClient  # noqa: F401
+from .client import Call, HTTPClient, LocalRPCClient, MockClient  # noqa: F401
 from .core import Environment, ROUTES, RPCError  # noqa: F401
 from .server import RPCServer  # noqa: F401

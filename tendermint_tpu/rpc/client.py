@@ -84,13 +84,87 @@ class HTTPClient:
 
 
 class LocalRPCClient:
-    """rpc/client/local — direct Environment calls in-process."""
+    """rpc/client/local — direct Environment calls in-process (local.go:1:
+    the client apps embed when they run in the same process as the node)."""
 
     def __init__(self, env: Environment):
         self._env = env
 
     def __getattr__(self, name):
         return getattr(self._env, name)
+
+
+class Call:
+    """rpc/client/mock/client.go Call: one canned response (or error) for
+    a method, optionally matched against specific args; also the record
+    type the recorder keeps."""
+
+    def __init__(self, name: str, args=None, response=None, error=None):
+        self.name = name
+        self.args = args
+        self.response = response
+        self.error = error
+
+    def get_response(self, args):
+        """mock/client.go GetResponse: error-only -> raise; response-only
+        -> return; both set -> response iff args match, else error."""
+        if self.response is None:
+            if self.error is not None:
+                raise self.error
+            raise RuntimeError("mock call has no response or error")
+        if self.error is None:
+            return self.response
+        if self.args == args:
+            return self.response
+        raise self.error
+
+
+class MockClient:
+    """rpc/client/mock — canned per-method responses + call recording.
+
+    Configure with `mock.expect(Call("status", response={...}))`; every
+    RPC method then resolves against the canned table, and `mock.calls`
+    records (name, args, response_or_error) like mock/client.go's
+    recorder. Unconfigured methods fall through to `base` (e.g. a
+    LocalRPCClient) when one is given, else raise."""
+
+    def __init__(self, base=None):
+        self._canned = {}
+        self._base = base
+        self.calls: list = []
+
+    def expect(self, call: Call) -> "MockClient":
+        self._canned[call.name] = call
+        return self
+
+    def _invoke(self, name, **params):
+        if name in self._canned:
+            try:
+                resp = self._canned[name].get_response(params or None)
+            except Exception as e:
+                self.calls.append(Call(name, params or None, error=e))
+                raise
+            self.calls.append(Call(name, params or None, response=resp))
+            return resp
+        if self._base is not None:
+            fn = getattr(self._base, name)
+            try:
+                resp = fn(**params) if params else fn()
+            except Exception as e:
+                self.calls.append(Call(name, params or None, error=e))
+                raise
+            self.calls.append(Call(name, params or None, response=resp))
+            return resp
+        raise NotImplementedError(f"mock client: no expectation for {name!r}")
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def method(**params):
+            return self._invoke(name, **params)
+
+        return method
 
 
 class WSClient:

@@ -22,11 +22,18 @@ def rollback_state(state_store: StateStore, block_store) -> Tuple[int, bytes]:
         raise RuntimeError("no state found")
     height = block_store.height()
 
-    # the block at the current state height must exist to roll back from
+    # State and block persistence are not atomic: a node stopped between
+    # save_block and state save leaves the blockstore ONE ahead. No state
+    # needs rolling back — return the current state unchanged
+    # (rollback.go:24-29).
+    if height == invalid_state.last_block_height + 1:
+        return invalid_state.last_block_height, invalid_state.app_hash
+
+    # otherwise the stores must agree on the height (rollback.go:31-36)
     if invalid_state.last_block_height != height:
         raise RuntimeError(
-            f"statestore height ({invalid_state.last_block_height}) and "
-            f"blockstore height ({height}) mismatch; cannot rollback"
+            f"statestore height ({invalid_state.last_block_height}) is not "
+            f"one below or equal to blockstore height ({height})"
         )
     rollback_height = invalid_state.last_block_height
     rollback_block = block_store.load_block_meta(rollback_height)
@@ -60,9 +67,15 @@ def rollback_state(state_store: StateStore, block_store) -> Tuple[int, bytes]:
         last_validators=state_store.load_validators(max(prev_height - 1, 1))
         if prev_height > 1
         else prev_validators,
-        last_height_validators_changed=invalid_state.last_height_validators_changed,
+        # clamp change-heights that refer past the rolled-back block
+        # (rollback.go:56-66)
+        last_height_validators_changed=min(
+            invalid_state.last_height_validators_changed, rollback_height
+        ),
         consensus_params=prev_params,
-        last_height_consensus_params_changed=invalid_state.last_height_consensus_params_changed,
+        last_height_consensus_params_changed=min(
+            invalid_state.last_height_consensus_params_changed, rollback_height
+        ),
         last_results_hash=prev_block.header.last_results_hash,
         app_hash=rollback_block.header.app_hash,
     )

@@ -109,21 +109,51 @@ class TestRollback:
             cs.stop()
         sstore = cs._block_exec.store
         before = sstore.load()
-        # pretend the block store is at state height (normal shutdown case)
         h = before.last_block_height
-        # rollback requires block_store.height == state height; ours is ≥
-        while bstore.height() > h:
-            pass  # cannot happen: save_block ordering guarantees <= state+1
         if bstore.height() == h + 1:
-            # state lagging one behind store — roll forward not needed for
-            # this test; use state at store height via handshake semantics
-            pytest.skip("stopped mid-apply; rollback unsupported in this state")
-        new_h, app_hash = rollback_state(sstore, bstore)
-        assert new_h == h - 1
-        after = sstore.load()
-        assert after.last_block_height == h - 1
-        meta = bstore.load_block_meta(h)
-        assert app_hash == meta.header.app_hash
+            # stopped mid-apply: block persisted, state not yet. The
+            # reference returns the CURRENT state unchanged
+            # (rollback.go:24-29) — no state to roll back.
+            new_h, app_hash = rollback_state(sstore, bstore)
+            assert new_h == h
+            assert app_hash == before.app_hash
+            assert sstore.load().last_block_height == h
+            # the normal-shutdown case must still roll back: re-run after
+            # pretending the tail block was applied is not possible here,
+            # so verify via the invariant error path instead
+        else:
+            assert bstore.height() == h
+            new_h, app_hash = rollback_state(sstore, bstore)
+            assert new_h == h - 1
+            after = sstore.load()
+            assert after.last_block_height == h - 1
+            meta = bstore.load_block_meta(h)
+            assert app_hash == meta.header.app_hash
+
+    def test_rollback_mid_apply_returns_current_state(self):
+        """blockstore one ahead of statestore (crash between save_block
+        and state save) — rollback is a no-op returning the current state
+        (rollback.go:24-29); a larger divergence is an invariant error."""
+        from types import SimpleNamespace
+
+        from tendermint_tpu.state.rollback import rollback_state
+
+        state = SimpleNamespace(last_block_height=7, app_hash=b"\xaa" * 32)
+
+        class SS:
+            def load(self):
+                return state
+
+        class BS:
+            def __init__(self, h):
+                self._h = h
+
+            def height(self):
+                return self._h
+
+        assert rollback_state(SS(), BS(8)) == (7, b"\xaa" * 32)
+        with pytest.raises(RuntimeError, match="not one below or equal"):
+            rollback_state(SS(), BS(9))
 
 
 class TestInspect:

@@ -1,0 +1,72 @@
+"""rpc/client local + mock parity (rpc/client/local/local.go:1,
+rpc/client/mock/client.go:1): the in-process client apps embed, and the
+canned-response/recording client tests are written against."""
+
+import pytest
+
+from tendermint_tpu.rpc import Call, LocalRPCClient, MockClient
+from tendermint_tpu.rpc.core import Environment
+
+
+class _FakeNode:
+    pass
+
+
+@pytest.fixture
+def env():
+    return Environment(_FakeNode())
+
+
+class TestLocalRPCClient:
+    def test_direct_environment_dispatch(self, env):
+        lc = LocalRPCClient(env)
+        # health needs no node state — direct in-process Environment call
+        assert lc.health() == {}
+        # attribute access resolves Environment methods, not copies
+        assert lc.unconfirmed_txs.__self__ is env
+
+    def test_unknown_method_raises(self, env):
+        lc = LocalRPCClient(env)
+        with pytest.raises(AttributeError):
+            lc.not_a_route()
+
+
+class TestMockClient:
+    def test_canned_response_and_recording(self):
+        mc = MockClient()
+        mc.expect(Call("status", response={"node_info": {"moniker": "mock"}}))
+        assert mc.status() == {"node_info": {"moniker": "mock"}}
+        assert [c.name for c in mc.calls] == ["status"]
+        assert mc.calls[0].response["node_info"]["moniker"] == "mock"
+
+    def test_canned_error(self):
+        mc = MockClient()
+        mc.expect(Call("broadcast_tx_sync", error=ValueError("tx too big")))
+        with pytest.raises(ValueError, match="tx too big"):
+            mc.broadcast_tx_sync(tx="00")
+        assert mc.calls[0].name == "broadcast_tx_sync"
+        assert isinstance(mc.calls[0].error, ValueError)
+
+    def test_args_matched_response(self):
+        # mock/client.go GetResponse: both set -> response iff args match
+        call = Call(
+            "abci_query",
+            args={"path": "/key", "data": "61"},
+            response={"value": "ok"},
+            error=KeyError("wrong args"),
+        )
+        mc = MockClient().expect(call)
+        assert mc.abci_query(path="/key", data="61") == {"value": "ok"}
+        with pytest.raises(KeyError):
+            mc.abci_query(path="/other", data="61")
+
+    def test_fallthrough_to_base(self, env):
+        # unconfigured methods hit the wrapped (local) client, still
+        # recorded — the recorder shape from mock/client.go
+        mc = MockClient(base=LocalRPCClient(env))
+        assert mc.health() == {}
+        assert [c.name for c in mc.calls] == ["health"]
+
+    def test_unconfigured_without_base(self):
+        with pytest.raises(NotImplementedError):
+            MockClient().genesis()
