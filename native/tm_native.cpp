@@ -1446,6 +1446,16 @@ static int batch_verify_rlc(const uint8_t *pubs, const uint8_t *sigs,
   uint8_t s_sum[32] = {0};
   ossl_sha512_fn fast = ossl_sha512();
   std::vector<uint8_t> cat;
+  // one bulk getrandom for every z coefficient (vs n syscalls in-loop)
+  std::vector<uint8_t> zs_rand(16 * n);
+  {
+    size_t got = 0;
+    while (got < zs_rand.size()) {
+      ssize_t r = getrandom(zs_rand.data() + got, zs_rand.size() - got, 0);
+      if (r <= 0) return -1;
+      got += (size_t)r;
+    }
+  }
   static const uint8_t L_BYTES[32] = {
       0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58, 0xd6, 0x9c, 0xf7,
       0xa2, 0xde, 0xf9, 0xde, 0x14, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
@@ -1480,9 +1490,9 @@ static int batch_verify_rlc(const uint8_t *pubs, const uint8_t *sigs,
       sha512::final(&c, digest);
     }
     sha512::mod_l(digest, k);
-    // random 128-bit z
+    // random 128-bit z from the bulk fill
     uint8_t z[32] = {0};
-    if (getrandom(z, 16, 0) != 16) return -1;
+    memcpy(z, zs_rand.data() + 16 * i, 16);
     uint8_t zs[32], zk[32];
     sc_mul(zs, z, sig + 32);
     sc_add(s_sum, s_sum, zs);

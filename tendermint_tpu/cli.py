@@ -501,6 +501,79 @@ def cmd_reset_unsafe(args) -> int:
     return 0
 
 
+def cmd_wal2json(args) -> int:
+    """scripts/wal2json/main.go:1 — decode a binary WAL file to one JSON
+    object per line on stdout (operator tooling for WAL surgery)."""
+    import json as _json
+
+    from .consensus.wal import WAL
+
+    for msg in WAL._iter_file(args.wal_file):
+        obj = {}
+        if msg.end_height is not None:
+            obj["end_height"] = msg.end_height
+        elif msg.timeout is not None:
+            d, h, r, s = msg.timeout
+            obj["timeout"] = {"duration_ms": d, "height": h, "round": r, "step": s}
+        else:
+            obj["msg"] = {
+                "kind": msg.msg_kind,
+                "payload": msg.msg_payload.hex(),
+                "peer_id": msg.peer_id,
+            }
+        print(_json.dumps(obj))
+    return 0
+
+
+def cmd_json2wal(args) -> int:
+    """scripts/json2wal/main.go:1 — re-encode wal2json output (one JSON
+    object per line on stdin or --input) into a CRC-framed binary WAL."""
+    import json as _json
+    import struct as _struct
+    import zlib as _zlib
+
+    from .consensus.wal import MAX_MSG_SIZE, WALMessage, _encode_record
+
+    src = open(args.input, "r") if args.input else sys.stdin
+    try:
+        with open(args.wal_file, "wb") as out:
+            for line in src:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = _json.loads(line)
+                if "end_height" in obj:
+                    msg = WALMessage(end_height=int(obj["end_height"]))
+                elif "timeout" in obj:
+                    t = obj["timeout"]
+                    msg = WALMessage(
+                        timeout=(int(t["duration_ms"]), int(t["height"]),
+                                 int(t["round"]), int(t["step"]))
+                    )
+                else:
+                    m = obj["msg"]
+                    msg = WALMessage(
+                        msg_kind=m["kind"],
+                        msg_payload=bytes.fromhex(m["payload"]),
+                        peer_id=m.get("peer_id", ""),
+                    )
+                body = _encode_record(msg)
+                if len(body) > MAX_MSG_SIZE:
+                    # an oversized frame would make WAL._iter_file stop
+                    # silently at replay, dropping the tail — refuse here
+                    print(
+                        f"error: record too big ({len(body)} > "
+                        f"{MAX_MSG_SIZE} bytes)", file=sys.stderr,
+                    )
+                    return 1
+                crc = _zlib.crc32(body) & 0xFFFFFFFF
+                out.write(_struct.pack(">II", crc, len(body)) + body)
+    finally:
+        if args.input:
+            src.close()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tendermint-tpu")
     p.add_argument("--home", default=os.path.expanduser("~/.tendermint-tpu"))
@@ -551,6 +624,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=3.0)
     sub.add_parser("rollback")
     sub.add_parser("inspect")
+    sp = sub.add_parser("wal2json")
+    sp.add_argument("wal_file")
+    sp = sub.add_parser("json2wal")
+    sp.add_argument("wal_file")
+    sp.add_argument("--input", default="")
     sub.add_parser("unsafe-reset-all")
     return p
 
@@ -573,6 +651,8 @@ COMMANDS = {
     "probe-upnp": cmd_probe_upnp,
     "rollback": cmd_rollback,
     "inspect": cmd_inspect,
+    "wal2json": cmd_wal2json,
+    "json2wal": cmd_json2wal,
     "unsafe-reset-all": cmd_reset_unsafe,
 }
 

@@ -69,6 +69,11 @@ class RPCConfig:
     timeout_broadcast_tx_commit_ms: int = 10000
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
+    # TLS: both set -> the RPC server serves HTTPS/WSS
+    # (rpc/jsonrpc/server/http_server.go ServeTLS; config.go TLSCertFile).
+    # Relative paths resolve under <home>/config/.
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
     pprof_laddr: str = ""
 
 

@@ -64,6 +64,10 @@ class KVSink(Sink):
                     )
 
     def index_block(self, height, events) -> None:
+        # the implicit height key every block gets (state/indexer/block/kv:
+        # block.height is always queryable)
+        events = dict(events)
+        events.setdefault("block.height", [str(height)])
         with self._mtx:
             self._db.set(b"blk/" + struct.pack(">q", height), json.dumps(events).encode())
             for key, values in events.items():

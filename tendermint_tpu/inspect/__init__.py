@@ -30,7 +30,8 @@ class _StubConsensus:
 
 
 class _InspectNode:
-    def __init__(self, config, genesis, state_store, block_store):
+    def __init__(self, config, genesis, state_store, block_store,
+                 tx_index_sink=None):
         self.config = config
         self.genesis = genesis
         self.state_store = state_store
@@ -40,6 +41,7 @@ class _InspectNode:
         self.mempool_reactor = None
         self.evidence_pool = None
         self.proxy_app = None
+        self.tx_index_sink = tx_index_sink
         state = state_store.load()
         self.consensus = _StubConsensus(state)
         self.node_key = None
@@ -49,18 +51,40 @@ class _InspectNode:
         return ""
 
 
-# routes the inspect server exposes (inspect.go:60-90)
+# routes the inspect server exposes (inspect.go:60-90 + the indexer-backed
+# routes the reference inspect serves, internal/inspect/rpc/rpc.go:48-66)
 INSPECT_ROUTES = [
     "status", "health", "genesis", "block", "block_by_hash", "blockchain",
     "commit", "block_results", "validators", "consensus_params",
+    "tx", "tx_search", "block_search",
 ]
+
+
+def _open_index_sink(config):
+    """Open the stopped node's tx_index KV sink read-only-ish — the same
+    data dir the live node's IndexerService wrote
+    (internal/inspect/inspect.go NewFromConfig -> sink setup)."""
+    if "kv" not in getattr(config.tx_index, "indexer", ""):
+        return None
+    home = config.base.home
+    if not home or config.base.db_backend in ("memdb", "mem"):
+        return None
+    from ..db import backend as db_backend
+    from ..indexer import KVSink
+
+    return KVSink(db_backend(config.base.db_backend, config.base.db_path("tx_index")))
 
 
 class Inspector:
     """inspect.go Inspector."""
 
-    def __init__(self, config, genesis, state_store, block_store, laddr: Optional[str] = None):
-        node = _InspectNode(config, genesis, state_store, block_store)
+    def __init__(self, config, genesis, state_store, block_store,
+                 laddr: Optional[str] = None, tx_index_sink=None):
+        if tx_index_sink is None:
+            tx_index_sink = _open_index_sink(config)
+        node = _InspectNode(
+            config, genesis, state_store, block_store, tx_index_sink
+        )
         self._env = Environment(node)
         self._server = RPCServer(laddr or config.rpc.laddr, self._env)
 

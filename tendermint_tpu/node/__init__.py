@@ -464,5 +464,15 @@ def make_node(
         from ..rpc.core import Environment
 
         env = Environment(node)
-        node.rpc_server = RPCServer(config.rpc.laddr, env)
+        cert, key = config.rpc.tls_cert_file, config.rpc.tls_key_file
+        cfg_dir = (
+            os.path.join(home, "config") if home else ""
+        )
+        if cert and not os.path.isabs(cert):
+            cert = os.path.join(cfg_dir, cert)
+        if key and not os.path.isabs(key):
+            key = os.path.join(cfg_dir, key)
+        node.rpc_server = RPCServer(
+            config.rpc.laddr, env, tls_cert_file=cert, tls_key_file=key
+        )
     return node

@@ -15,11 +15,25 @@ from .core import Environment, RPCError
 
 
 class HTTPClient:
-    def __init__(self, base_url: str):
+    """JSON-RPC over http:// or https://. For https, `ca_file` pins a CA
+    bundle (self-signed server certs in tests/private deployments);
+    `insecure=True` skips verification entirely (curl -k equivalent)."""
+
+    def __init__(self, base_url: str, ca_file: str = "", insecure: bool = False):
         if not base_url.startswith("http"):
             base_url = "http://" + base_url.replace("tcp://", "")
         self._url = base_url.rstrip("/")
         self._id = 0
+        self._ctx = None
+        if self._url.startswith("https"):
+            import ssl
+
+            if insecure:
+                self._ctx = ssl._create_unverified_context()
+            else:
+                self._ctx = ssl.create_default_context(
+                    cafile=ca_file or None
+                )
 
     def call(self, method: str, **params):
         self._id += 1
@@ -29,7 +43,7 @@ class HTTPClient:
         req = urllib.request.Request(
             self._url, data=body, headers={"Content-Type": "application/json"}
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        with urllib.request.urlopen(req, timeout=30, context=self._ctx) as resp:
             obj = json.loads(resp.read())
         if "error" in obj:
             e = obj["error"]
@@ -174,7 +188,8 @@ class WSClient:
     events (demuxed by id: calls echo the integer id, event pushes carry
     the server's "<query>#event" string id)."""
 
-    def __init__(self, addr: str, timeout: float = 10.0):
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 ca_file: str = "", insecure: bool = False):
         import os
         import socket as _s
         import threading
@@ -183,10 +198,20 @@ class WSClient:
 
         if "//" not in addr:
             addr = "//" + addr
-        parts = urlsplit(addr.replace("tcp://", "http://"), scheme="http")
+        addr = addr.replace("tcp://", "http://").replace("wss://", "https://")
+        addr = addr.replace("ws://", "http://")
+        parts = urlsplit(addr, scheme="http")
         host = parts.hostname or "127.0.0.1"
         port = parts.port or 26657
         self._sock = _s.create_connection((host, port), timeout=timeout)
+        if parts.scheme == "https":  # wss: TLS under the websocket frames
+            import ssl
+
+            if insecure:
+                ctx = ssl._create_unverified_context()
+            else:
+                ctx = ssl.create_default_context(cafile=ca_file or None)
+            self._sock = ctx.wrap_socket(self._sock, server_hostname=host)
         key = base64.b64encode(os.urandom(16)).decode()
         self._sock.sendall(
             (

@@ -119,14 +119,36 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class RPCServer:
-    def __init__(self, laddr: str, env: Environment):
+    def __init__(
+        self,
+        laddr: str,
+        env: Environment,
+        tls_cert_file: str = "",
+        tls_key_file: str = "",
+    ):
         addr = laddr
-        for prefix in ("tcp://", "http://"):
+        for prefix in ("tcp://", "http://", "https://"):
             if addr.startswith(prefix):
                 addr = addr[len(prefix):]
         host, _, port = addr.rpartition(":")
         handler = type("BoundHandler", (_Handler,), {"env": env})
         self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
+        if bool(tls_cert_file) != bool(tls_key_file):
+            raise ValueError(
+                "TLS requires BOTH tls_cert_file and tls_key_file; refusing "
+                "to silently serve plaintext on a half-configured listener"
+            )
+        self.tls = bool(tls_cert_file and tls_key_file)
+        if self.tls:
+            # ServeTLS (rpc/jsonrpc/server/http_server.go:113): same
+            # handler tree over TLS; WS upgrades ride the same listener.
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -157,6 +157,88 @@ class TestRollback:
 
 
 class TestInspect:
+    def test_inspect_serves_indexer_rpcs_from_dead_node_dir(self, tmp_path):
+        """VERDICT r3 item 6 / internal/inspect/rpc/rpc.go:48-66: kill a
+        node, run inspect over its DATA DIR (sqlite stores + tx_index
+        sink), find a tx by hash and by event query, and block_search."""
+        import json
+        import urllib.request
+
+        from tendermint_tpu.abci import KVStoreApplication
+        from tendermint_tpu.config import Config
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.db import backend as db_backend
+        from tendermint_tpu.inspect import Inspector
+        from tendermint_tpu.node import make_node
+        from tendermint_tpu.p2p import NodeKey
+        from tendermint_tpu.privval import FilePV
+        from tendermint_tpu.rpc import HTTPClient
+        from tendermint_tpu.state.store import StateStore
+        from tendermint_tpu.store import BlockStore
+        from tendermint_tpu.types import Timestamp
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tests.test_node_rpc import FAST
+
+        sk = ed25519.gen_priv_key(bytes([8]) * 32)
+        doc = GenesisDoc(
+            chain_id="inspect-chain",
+            genesis_time=Timestamp(seconds=1_700_000_000),
+            validators=[
+                GenesisValidator(address=b"", pub_key=sk.pub_key(), power=10)
+            ],
+        )
+        cfg = Config()
+        cfg.base.home = str(tmp_path)
+        cfg.base.db_backend = "sqlite"
+        cfg.consensus = FAST
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        node = make_node(
+            cfg,
+            app=KVStoreApplication(),
+            genesis=doc,
+            priv_validator=FilePV(sk),
+            node_key=NodeKey.generate(bytes([42]) * 32),
+            with_rpc=True,
+        )
+        node.start()
+        try:
+            rpc = HTTPClient(node.rpc_server.listen_addr)
+            res = rpc.call("broadcast_tx_commit", tx="696e73703d6b6579")  # insp=key
+            assert int(res["deliver_tx"]["code"]) == 0
+            tx_hash_hex = res["hash"]
+            height = int(res["height"])
+            node.wait_for_height(height + 1, timeout=30)
+        finally:
+            node.stop()
+
+        # the node is dead; inspect opens the same data dir from disk
+        insp = Inspector(
+            cfg,
+            doc,
+            StateStore(db_backend("sqlite", cfg.base.db_path("state"))),
+            BlockStore(db_backend("sqlite", cfg.base.db_path("blockstore"))),
+        )
+        insp.start()
+        try:
+            rpc = HTTPClient(insp.listen_addr)
+            # tx by hash
+            got = rpc.call("tx", hash=tx_hash_hex)
+            assert got["hash"].lower() == tx_hash_hex.lower()
+            assert int(got["height"]) == height
+            # tx by event query through the persisted index sink
+            hits = rpc.call("tx_search", query="app.creator='Cosmoshi Netowoko'")
+            assert int(hits["total_count"]) >= 1
+            assert any(t["hash"].lower() == tx_hash_hex.lower() for t in hits["txs"])
+            # block_search over the same sink
+            blocks = rpc.call("block_search", query=f"block.height={height}")
+            assert any(
+                int(b["block"]["header"]["height"]) == height
+                for b in blocks["blocks"]
+            )
+        finally:
+            insp.stop()
+
     def test_inspect_serves_store_rpcs(self):
         from tendermint_tpu.config import default_config
         from tendermint_tpu.crypto import ed25519
@@ -272,3 +354,45 @@ class TestSQLSink:
         cur.execute("SELECT COUNT(*) FROM blocks")
         assert cur.fetchone()[0] == 3
         sink.close()
+
+
+class TestWALTools:
+    def test_wal2json_json2wal_roundtrip(self, tmp_path, capsys):
+        """scripts/wal2json + json2wal parity: binary -> JSON lines ->
+        binary reproduces the byte-identical CRC-framed WAL."""
+        import json as _json
+        import struct
+        import zlib
+
+        from tendermint_tpu import cli
+        from tendermint_tpu.consensus.wal import WAL, WALMessage, _encode_record
+
+        wal_path = tmp_path / "wal"
+        msgs = [
+            WALMessage(end_height=3),
+            WALMessage(timeout=(1000, 4, 0, 1)),
+            WALMessage(msg_kind="vote", msg_payload=b"\x01\x02\xff", peer_id="p1"),
+            WALMessage(msg_kind="block_part", msg_payload=b"\x00" * 40, peer_id=""),
+        ]
+        with open(wal_path, "wb") as fh:
+            for m in msgs:
+                body = _encode_record(m)
+                crc = zlib.crc32(body) & 0xFFFFFFFF
+                fh.write(struct.pack(">II", crc, len(body)) + body)
+        orig = wal_path.read_bytes()
+
+        assert cli.main(["wal2json", str(wal_path)]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 4
+        assert _json.loads(lines[0]) == {"end_height": 3}
+        assert _json.loads(lines[2])["msg"]["kind"] == "vote"
+
+        json_path = tmp_path / "wal.json"
+        json_path.write_text("\n".join(lines) + "\n")
+        out_path = tmp_path / "wal2"
+        assert cli.main(["json2wal", str(out_path), "--input", str(json_path)]) == 0
+        assert out_path.read_bytes() == orig
+        # and it decodes back to the same records
+        assert [m.end_height for m in WAL._iter_file(str(out_path))] == [
+            m.end_height for m in msgs
+        ]
