@@ -1578,9 +1578,95 @@ static PyObject *py_ed25519_batch_verify(PyObject *, PyObject *args) {
   return PyBool_FromLong(rc);
 }
 
+// vote_sign_bytes_batch(prefix, suffix, times: n*16B LE int64 pairs
+// (seconds, nanos)) -> list[bytes]. Composes the canonical vote sign
+// bytes for every signature of a commit in one call: delimited(prefix +
+// Timestamp-field(5) + suffix), mirroring wire/canonical.py
+// compose_vote_sign_bytes byte for byte (proto3 default-skip varints,
+// 64-bit two's complement negatives). The per-signature Python composer
+// measured ~27us/sig — the host bottleneck of pipelined header sync.
+static size_t put_uvarint(uint8_t *dst, uint64_t v) {
+  size_t i = 0;
+  while (v >= 0x80) {
+    dst[i++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  dst[i++] = (uint8_t)v;
+  return i;
+}
+
+static PyObject *py_vote_sign_bytes_batch(PyObject *, PyObject *args) {
+  Py_buffer prefix, suffix, times;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &prefix, &suffix, &times))
+    return nullptr;
+  if (times.len % 16) {
+    PyBuffer_Release(&prefix);
+    PyBuffer_Release(&suffix);
+    PyBuffer_Release(&times);
+    PyErr_SetString(PyExc_ValueError,
+                    "times must be n*16 bytes of (seconds, nanos) pairs");
+    return nullptr;
+  }
+  Py_ssize_t n = times.len / 16;
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    PyBuffer_Release(&prefix);
+    PyBuffer_Release(&suffix);
+    PyBuffer_Release(&times);
+    return nullptr;
+  }
+  const uint8_t *tp = (const uint8_t *)times.buf;
+  std::vector<uint8_t> buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int64_t secs, nanos;
+    memcpy(&secs, tp + 16 * i, 8);
+    memcpy(&nanos, tp + 16 * i + 8, 8);
+    uint8_t ts_body[22];
+    size_t tn = 0;
+    if (secs != 0) {
+      ts_body[tn++] = 0x08;  // field 1, varint
+      tn += put_uvarint(ts_body + tn, (uint64_t)secs);
+    }
+    if (nanos != 0) {
+      ts_body[tn++] = 0x10;  // field 2, varint
+      tn += put_uvarint(ts_body + tn, (uint64_t)nanos);
+    }
+    uint8_t mid[32];
+    size_t mn = 0;
+    mid[mn++] = 0x2a;  // field 5, length-delimited
+    mn += put_uvarint(mid + mn, tn);
+    memcpy(mid + mn, ts_body, tn);
+    mn += tn;
+    size_t body_len = (size_t)prefix.len + mn + (size_t)suffix.len;
+    uint8_t hdr[10];
+    size_t hn = put_uvarint(hdr, body_len);
+    buf.resize(hn + body_len);
+    memcpy(buf.data(), hdr, hn);
+    memcpy(buf.data() + hn, prefix.buf, prefix.len);
+    memcpy(buf.data() + hn + prefix.len, mid, mn);
+    memcpy(buf.data() + hn + prefix.len + mn, suffix.buf, suffix.len);
+    PyObject *b =
+        PyBytes_FromStringAndSize((const char *)buf.data(), (Py_ssize_t)buf.size());
+    if (!b) {
+      Py_DECREF(out);
+      PyBuffer_Release(&prefix);
+      PyBuffer_Release(&suffix);
+      PyBuffer_Release(&times);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, b);
+  }
+  PyBuffer_Release(&prefix);
+  PyBuffer_Release(&suffix);
+  PyBuffer_Release(&times);
+  return out;
+}
+
 static PyMethodDef Methods[] = {
     {"ed25519_batch_verify", py_ed25519_batch_verify, METH_VARARGS,
      "Host RLC batch ed25519 verification (Pippenger MSM); returns bool"},
+    {"vote_sign_bytes_batch", py_vote_sign_bytes_batch, METH_VARARGS,
+     "Batch canonical vote sign-bytes composition from a template"},
     {"ed25519_challenges", py_ed25519_challenges, METH_VARARGS,
      "Batch k = SHA512(R||A||M) mod L challenge scalars (32B LE each)"},
     {"sr25519_verify_batch", py_sr25519_verify_batch, METH_VARARGS,

@@ -17,8 +17,16 @@ every commit's critical path.
 Semantics are identical to ops.ed25519_verify / crypto._edwards
 (per-signature cofactored ZIP-215, crypto/ed25519/ed25519.go:26-31 parity):
   accept iff A, R decompress (non-canonical y allowed), s < L (host-checked
-  flag), and [8]([s]B - R - [k]A) == O with k = SHA512(R||A||M) mod L
-  (host-computed: hashlib is C-speed and k costs 32 B/sig to ship).
+  flag), and [8]([s]B - [k]A) == [8]R — evaluated as a doubles-only
+  projective cross-multiplication (complete for small-order inputs) —
+  with k = SHA512(R||A||M) mod L computed host-side: the native batch
+  helper is ~17 ms/batch, fully hidden behind the 33 ms device pass by
+  the async pipeline, and shipping k costs 32 B/sig vs ~256 B/sig for
+  on-device hashing (PERF_r04.md).
+
+Table entries are stored in Niels form (Y+X, Y-X, Z, T*2d) and the
+ladder carries no T (doubles never read it; see point_double/
+point_add_niels need_t).
 """
 
 from __future__ import annotations

@@ -200,20 +200,22 @@ def commit_entries(
     signatures (index lookup, early-stop past 2/3 like validation.go:152
     with countAllSignatures=false). Returns (entries, tallied_power).
     Raises on structural problems (bad counts, short power)."""
-    entries = []
+    idxs = []
     tallied = 0
     for idx, cs in enumerate(commit.signatures):
         if not cs.for_block():
             continue
-        val = vals.validators[idx]
-        entries.append(
-            (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
-        )
-        tallied += val.voting_power
+        idxs.append(idx)
+        tallied += vals.validators[idx].voting_power
         if tallied > voting_power_needed:
             break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    sign_bytes = commit.vote_sign_bytes_many(chain_id, idxs)
+    entries = [
+        (vals.validators[i].pub_key.bytes(), sb, commit.signatures[i].signature)
+        for i, sb in zip(idxs, sign_bytes, strict=True)
+    ]
     return entries, tallied
 
 

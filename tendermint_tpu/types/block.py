@@ -378,6 +378,37 @@ class Commit:
             self._sb_tpl[key] = tpl
         return _canon.compose_vote_sign_bytes(tpl, cs.timestamp)
 
+    def vote_sign_bytes_many(self, chain_id: str, idxs) -> list:
+        """Batch form of vote_sign_bytes: one native compose call for all
+        requested lanes (the pure-Python composer is ~27us/sig, which was
+        the host bottleneck of pipelined header sync at 128 vals/header).
+        Falls back to the per-index path without the native module or for
+        mixed BlockIDFlags."""
+        idxs = list(idxs)
+        if len(idxs) >= 8:
+            flag = self.signatures[idxs[0]].block_id_flag
+            if all(self.signatures[i].block_id_flag == flag for i in idxs):
+                from ..native import load as _load_native
+
+                native = _load_native()
+                if native is not None and hasattr(native, "vote_sign_bytes_batch"):
+                    # materialize the (chain_id, flag) template via the
+                    # single-lane path once
+                    self.vote_sign_bytes(chain_id, idxs[0])
+                    prefix, suffix = self._sb_tpl[(chain_id, flag)]
+                    import struct as _struct
+
+                    times = b"".join(
+                        _struct.pack(
+                            "<qq",
+                            self.signatures[i].timestamp.seconds,
+                            self.signatures[i].timestamp.nanos,
+                        )
+                        for i in idxs
+                    )
+                    return native.vote_sign_bytes_batch(prefix, suffix, times)
+        return [self.vote_sign_bytes(chain_id, i) for i in idxs]
+
     def encode(self) -> bytes:
         w = ProtoWriter()
         w.write_varint(1, self.height)

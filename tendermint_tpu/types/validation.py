@@ -148,6 +148,7 @@ def _verify_commit_batch(
         raise RuntimeError(
             "unsupported signature algorithm or insufficient signatures for batch verification"
         )
+    selected = []  # (idx, val) in signature order
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue
@@ -162,15 +163,20 @@ def _verify_commit_batch(
                     f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
                 )
             seen_vals[val_idx] = idx
-        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
-        batch_sig_idxs.append(idx)
+        selected.append((idx, val))
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
             break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    # one batch sign-bytes composition for all selected lanes (native
+    # composer; the per-lane Python encode was the dominant host cost on
+    # large commits)
+    sign_bytes = commit.vote_sign_bytes_many(chain_id, [i for i, _ in selected])
+    for (idx, val), sb in zip(selected, sign_bytes, strict=True):
+        bv.add(val.pub_key, sb, commit.signatures[idx].signature)
+        batch_sig_idxs.append(idx)
     ok, valid_sigs = bv.verify()
     if ok:
         return

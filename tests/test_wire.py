@@ -233,3 +233,39 @@ def test_vote_sign_bytes_template_parity():
                     timestamp=cs.timestamp,
                 )
                 assert commit.vote_sign_bytes(chain_id, idx) == direct, (chain_id, idx)
+
+
+class TestNativeSignBytesParity:
+    def test_vote_sign_bytes_many_matches_python_composer(self):
+        """Consensus-critical parity: the native batch composer
+        (tm_native.vote_sign_bytes_batch) must match the pure-Python
+        compose_vote_sign_bytes byte-for-byte, including edge timestamps
+        (zero fields skipped, Go zero-time negative 10-byte varints,
+        > 2^32 seconds)."""
+        import struct
+
+        import pytest as _pytest
+
+        from tendermint_tpu.native import load
+        from tendermint_tpu.wire import canonical as _c
+
+        native = load()
+        if native is None or not hasattr(native, "vote_sign_bytes_batch"):
+            _pytest.skip("native module unavailable")
+        tpl = _c.canonical_vote_template(
+            chain_id="parity-chain", msg_type=_c.SIGNED_MSG_TYPE_PRECOMMIT,
+            height=77, round_=2, block_id=None,
+        )
+        cases = [
+            (0, 0), (0, 5), (5, 0), (-62135596800, 0), (-1, 999999999),
+            (1 << 33, 17), (2**62, 1), (1_600_000_000, 123456789),
+        ]
+        want = [
+            _c.compose_vote_sign_bytes(tpl, _c.Timestamp(seconds=s, nanos=n))
+            for s, n in cases
+        ]
+        times = b"".join(struct.pack("<qq", s, n) for s, n in cases)
+        got = native.vote_sign_bytes_batch(tpl[0], tpl[1], times)
+        assert got == want
+        with _pytest.raises(ValueError):
+            native.vote_sign_bytes_batch(tpl[0], tpl[1], b"\x00" * 15)
