@@ -143,7 +143,13 @@ class SocketClient:
                     except ValueError:
                         break
                     buf = buf[consumed:]
-                    kind, payload = abci.decode_response(msg)
+                    try:
+                        kind, payload = abci.decode_response(msg)
+                    except ValueError as e:
+                        # protocol error (unknown oneof): route through the
+                        # OSError path so pending futures fail instead of
+                        # blocking their full timeout on a dead recv thread
+                        raise OSError(f"ABCI protocol error: {e}") from e
                     want_kind, fut = self._pending.get_nowait()
                     if kind == "exception":
                         fut.set_exception(

@@ -86,7 +86,9 @@ class Inspector:
             config, genesis, state_store, block_store, tx_index_sink
         )
         self._env = Environment(node)
-        self._server = RPCServer(laddr or config.rpc.laddr, self._env)
+        self._server = RPCServer(
+            laddr or config.rpc.laddr, self._env, routes=INSPECT_ROUTES
+        )
 
     @property
     def env(self) -> Environment:

@@ -28,6 +28,7 @@ def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> bytes:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     env: Environment = None  # class attr set by server factory
+    route_filter = None  # optional frozenset restricting served routes
 
     def log_message(self, fmt, *args):  # noqa: A003 — silence default logging
         pass
@@ -39,13 +40,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _call(self, method: str, params: dict, id_):
-        allowed = method in ROUTES
-        if not allowed and method in UNSAFE_ROUTES:
-            # routes.go:56-60: unsafe routes mount only when configured
+    def _route_allowed(self, method: str) -> bool:
+        """Single route gate for HTTP, URI, and websocket dispatch:
+        restricted servers (inspect) serve only their table; unsafe
+        routes mount only when configured (routes.go:56-60)."""
+        if self.route_filter is not None and method not in self.route_filter:
+            return False
+        if method in ROUTES:
+            return True
+        if method in UNSAFE_ROUTES:
             cfg = getattr(getattr(self.env, "_node", None), "config", None)
-            allowed = bool(cfg and cfg.rpc.unsafe)
-        if not allowed:
+            return bool(cfg and cfg.rpc.unsafe)
+        return False
+
+    def _call(self, method: str, params: dict, id_):
+        if not self._route_allowed(method):
             return _rpc_response(
                 id_, error=RPCError(-32601, f"Method not found: {method}")
             )
@@ -106,8 +115,10 @@ class _Handler(BaseHTTPRequestHandler):
             handle_websocket(self, self.env)
             return
         if method == "":
-            # route listing like the reference's index page
-            body = json.dumps({"available_methods": ROUTES}).encode()
+            # route listing like the reference's index page (restricted
+            # servers advertise only what they serve)
+            methods = [r for r in ROUTES if self._route_allowed(r)]
+            body = json.dumps({"available_methods": methods}).encode()
             self._send(200, body)
             return
         params = {}
@@ -125,13 +136,19 @@ class RPCServer:
         env: Environment,
         tls_cert_file: str = "",
         tls_key_file: str = "",
+        routes=None,
     ):
         addr = laddr
         for prefix in ("tcp://", "http://", "https://"):
             if addr.startswith(prefix):
                 addr = addr[len(prefix):]
         host, _, port = addr.rpartition(":")
-        handler = type("BoundHandler", (_Handler,), {"env": env})
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"env": env,
+             "route_filter": frozenset(routes) if routes is not None else None},
+        )
         self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), handler)
         if bool(tls_cert_file) != bool(tls_key_file):
             raise ValueError(

@@ -146,8 +146,14 @@ def handle_websocket(handler, env) -> None:
                     env._unsubscribe_all(subscriber)
                     send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
                 else:
-                    # any regular RPC method also works over the socket
+                    # any regular RPC method also works over the socket —
+                    # through the SAME route gate as HTTP dispatch (route
+                    # restriction + unsafe-route config must not be
+                    # bypassable by upgrading to a websocket)
+                    gate = getattr(handler, "_route_allowed", None)
                     fn = getattr(env, method, None)
+                    if gate is not None and not gate(method):
+                        fn = None
                     if fn is None or method.startswith("_"):
                         send_json(
                             {

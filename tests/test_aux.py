@@ -236,6 +236,26 @@ class TestInspect:
                 int(b["block"]["header"]["height"]) == height
                 for b in blocks["blocks"]
             )
+            # routes outside the inspect table are refused cleanly
+            # (internal/inspect/rpc/rpc.go Routes)
+            from tendermint_tpu.rpc.core import RPCError
+
+            with pytest.raises(RPCError) as ei:
+                rpc.call("broadcast_tx_sync", tx="00")
+            assert ei.value.code == -32601
+            # ...including over the websocket upgrade (the route gate
+            # must not be bypassable by switching transports)
+            from tendermint_tpu.rpc.client import WSClient
+
+            ws = WSClient(insp.listen_addr)
+            try:
+                with pytest.raises(RPCError) as ei2:
+                    ws.call("broadcast_tx_sync", {"tx": "00"})
+                assert ei2.value.code == -32601
+                got_h = ws.call("block", {"height": height})
+                assert int(got_h["block"]["header"]["height"]) == height
+            finally:
+                ws.close()
         finally:
             insp.stop()
 
