@@ -41,9 +41,9 @@ ACCEL_ATTEMPTS = int(os.environ.get("TM_TPU_BENCH_ACCEL_ATTEMPTS", "2"))
 
 def _cache_env(env: dict, cpu: bool = False) -> dict:
     env = dict(env)
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.set_env(env, os.path.dirname(os.path.abspath(__file__)))
     if cpu:
         # CPU paths must not touch the remote-TPU relay at all: the axon
         # sitecustomize registers (and may dial) the PJRT plugin at

@@ -1,0 +1,58 @@
+"""Per-machine persistent JAX compilation cache.
+
+XLA:CPU AOT results are compiled for the build machine's exact CPU
+feature flags; loading them on a host with a different CPU risks SIGILL
+(observed as loader warnings when an external driver ran a cache warmed
+on different hardware). Every cache-enabling site (tests/conftest,
+bench, tools, the driver entry) routes through here so each machine
+warms its own subdirectory of `.jax_cache/`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def machine_tag() -> str:
+    """Short tag identifying this host's CPU feature set."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 reports "flags", ARM reports "Features"
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha256(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    # No readable cpuinfo (non-Linux / hardened container): there is no
+    # feature list to key on, so fall back to machine|processor|version.
+    # processor is often "" there, and version (kernel build) churns on
+    # kernel upgrades — accepted: a cold recompile on upgrade beats two
+    # different-featured hosts silently sharing AOT executables.
+    u = platform.uname()
+    return hashlib.sha256(
+        f"{u.machine}|{u.processor}|{u.version}".encode()
+    ).hexdigest()[:12]
+
+
+_MIN_COMPILE_SECS = "1.0"
+
+
+def cache_dir(repo_root: str) -> str:
+    return os.path.join(repo_root, ".jax_cache", machine_tag())
+
+
+def enable(jax, repo_root: str) -> None:
+    jax.config.update("jax_compilation_cache_dir", cache_dir(repo_root))
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(_MIN_COMPILE_SECS)
+    )
+
+
+def set_env(env: dict, repo_root: str) -> dict:
+    """setdefault the cache env vars for a subprocess environment."""
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir(repo_root))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", _MIN_COMPILE_SECS)
+    return env

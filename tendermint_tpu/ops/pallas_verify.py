@@ -348,12 +348,17 @@ def _k3_ladder_kernel(tbl_ref, sdig_ref, kdig_ref, coords_ref, ok_ref, sok_ref, 
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_pallas_verify(n: int, block: int, interpret: bool):
+def _jitted_pallas_verify(n: int, block: int, interpret: bool,
+                          vma: frozenset | None = None):
     """Three chained pallas_calls (single-kernel fusion SIGABRTs Mosaic;
     see the kernel docstrings). Intermediates live in HBM between kernels
     — ~3 MB/block, negligible next to the in-kernel work. K2's block is
     capped at 256 lanes: its double-buffered (2048, B) table output plus
-    the 9B-lane cross-add working set exceeds VMEM at 512."""
+    the 9B-lane cross-add working set exceeds VMEM at 512.
+
+    vma: varying-mesh-axes annotation for the kernel outputs — required
+    when the pipeline runs inside a checked shard_map (ops.sharded), where
+    every output must declare which mesh axes it varies over."""
     k2_block = min(block, 256)
 
     def mkspec(b):
@@ -361,6 +366,9 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool):
             return pl.BlockSpec((rows, b), lambda i: (0, i), memory_space=pltpu.VMEM)
 
         return spec
+
+    def out(rows):
+        return jax.ShapeDtypeStruct((rows, n), jnp.int32, vma=vma)
 
     spec = mkspec(block)
     spec2 = mkspec(k2_block)
@@ -370,12 +378,7 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool):
         grid=(n // block,),
         in_specs=[spec(32)] * 4,
         out_specs=[spec(8 * 32), spec(2), spec(128), spec(128)],
-        out_shape=[
-            jax.ShapeDtypeStruct((8 * 32, n), jnp.int32),
-            jax.ShapeDtypeStruct((2, n), jnp.int32),
-            jax.ShapeDtypeStruct((128, n), jnp.int32),
-            jax.ShapeDtypeStruct((128, n), jnp.int32),
-        ],
+        out_shape=[out(8 * 32), out(2), out(128), out(128)],
         interpret=interpret,
     )
     k2 = pl.pallas_call(
@@ -383,7 +386,7 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool):
         grid=(n // k2_block,),
         in_specs=[spec2(8 * 32)],
         out_specs=spec2(16 * 4 * 32),
-        out_shape=jax.ShapeDtypeStruct((16 * 4 * 32, n), jnp.int32),
+        out_shape=out(16 * 4 * 32),
         interpret=interpret,
     )
     k3 = pl.pallas_call(
@@ -391,7 +394,7 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool):
         grid=(n // block,),
         in_specs=[spec(16 * 4 * 32), spec(128), spec(128), spec(8 * 32), spec(2), spec(1)],
         out_specs=spec(1),
-        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        out_shape=out(1),
         interpret=interpret,
     )
 

@@ -25,7 +25,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # The verify kernel is a large XLA program (~60s cold compile on one CPU
-# core); persist compiled executables across test sessions.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# core); persist compiled executables across test sessions. The cache is
+# keyed per-machine (CPU feature tag) — loading another host's XLA:CPU
+# AOT results risks SIGILL (tendermint_tpu.libs.jaxcache).
+from tendermint_tpu.libs import jaxcache  # noqa: E402
+
+jaxcache.enable(jax, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
