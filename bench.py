@@ -241,15 +241,25 @@ def worker() -> None:
     assert bool(res.all()), "all benchmark signatures must verify"
 
     # Single cold commit: one synchronous end-to-end verify (prep +
-    # transfer + kernel + result readback). On the relay-attached TPU this
-    # pays one full ~65ms round-trip — the latency a lone VerifyCommit
-    # call experiences, reported as single_* below.
+    # transfer + kernel + result readback) through the production batch
+    # path. On the relay-attached TPU this pays one full ~65ms round-trip
+    # — the latency a lone VerifyCommit call experiences.
     reps = 5 if on_accel else 1
     prep_t = 0.0
     t0 = time.perf_counter()
     for _ in range(reps):
         p0 = time.perf_counter()
-        if use_pallas:
+        if use_pallas and backend._use_rlc():
+            from tendermint_tpu.ops import pallas_rlc
+
+            _b, _g, _blk = pallas_rlc.plan_bucket(n_sigs)
+            args = pallas_rlc.prepare_rlc(entries, _b)
+            prep_t += time.perf_counter() - p0
+            lanes = pallas_rlc.verify_rlc_compact(
+                *args, block=_blk, interpret=not on_accel
+            )
+            assert bool(lanes.all())
+        elif use_pallas:
             from tendermint_tpu.ops import pallas_verify
 
             args = pallas_verify.prepare_compact(entries, bucket)
@@ -263,40 +273,49 @@ def worker() -> None:
     total = time.perf_counter() - t0
     single_s = total / reps / n_sigs
 
-    # Relay round-trip: a trivial device computation fetched synchronously
-    # — the irreducible latency floor every synchronous call pays here.
-    rtt_ms = 0.0
-    if on_accel:
+    def measure_rtt() -> float:
+        """Relay round-trip: a trivial device computation fetched
+        synchronously — the irreducible latency floor every synchronous
+        call pays, and the bench's relay-health signal."""
+        if not on_accel:
+            return 0.0
         one = jax.jit(lambda x: x + 1)
         _np.asarray(one(_np.int32(0)))  # warm
         t0 = time.perf_counter()
         for _ in range(3):
             _np.asarray(one(_np.int32(0)))
-        rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
+        return (time.perf_counter() - t0) / 3 * 1e3
 
-    # Primary metric: verify_commit THROUGHPUT the way the framework pays
-    # it — since round 4 the default VerifyCommit batch path rides the
-    # shared async pipeline (ops.pipeline.AsyncBatchVerifier: one worker
-    # thread, host prep overlapped with device compute, device->host
-    # copies started asynchronously behind the kernel). A consensus/
-    # blocksync node verifies a stream of commits; this measures that
-    # steady state over 8 back-to-back 10k-validator commits.
-    sus_rate = 0.0
+    rtt_ms = measure_rtt()
+
+    # Secondary: kernel-only stream (the figure rounds 3-4 reported as the
+    # headline) — prep in a helper thread, async dispatch, depth-3
+    # in-flight. Kept as `kernel_stream_sigs_per_s`; the HEADLINE below
+    # rides types.verify_commit end to end.
+    kern_rate = 0.0
     if on_accel and use_pallas:
         from concurrent.futures import ThreadPoolExecutor
 
-        from tendermint_tpu.ops import pallas_verify
+        if backend._use_rlc():
+            from tendermint_tpu.ops import pallas_rlc as _pk
 
+            rlc_bucket, g, blk = _pk.plan_bucket(n_sigs)
+            f = _pk._jitted_rlc_verify(g, blk, False)
+            prep_fn = lambda: _pk.prepare_rlc(entries, rlc_bucket)  # noqa: E731
+        else:
+            from tendermint_tpu.ops import pallas_verify as _pk
+
+            f = _pk._jitted_pallas_verify(bucket, _pk.BLOCK, False)
+            prep_fn = lambda: _pk.prepare_compact(entries, bucket)  # noqa: E731
         n_batches = 8
-        f = pallas_verify._jitted_pallas_verify(bucket, pallas_verify.BLOCK, False)
         with ThreadPoolExecutor(1) as ex:
             t0 = time.perf_counter()
-            prep = ex.submit(pallas_verify.prepare_compact, entries, bucket)
+            prep = ex.submit(prep_fn)
             inflight = []
             for i in range(n_batches):
                 args = prep.result()
                 if i + 1 < n_batches:
-                    prep = ex.submit(pallas_verify.prepare_compact, entries, bucket)
+                    prep = ex.submit(prep_fn)
                 o = f(*args)
                 try:
                     o.copy_to_host_async()
@@ -307,7 +326,28 @@ def worker() -> None:
                     assert _np.asarray(inflight.pop(0)).all()
             for o in inflight:
                 assert _np.asarray(o).all()
-            sus_rate = n_batches * n_sigs / (time.perf_counter() - t0)
+            kern_rate = n_batches * n_sigs / (time.perf_counter() - t0)
+
+    # HEADLINE: types.verify_commit end to end (VERDICT r4 item 3) — real
+    # Commit + ValidatorSet at n_sigs validators, 8 distinct commits
+    # streamed through the DEFAULT verification path (sign-bytes
+    # composition, seam dispatch, async pipeline, tally, blame), the way a
+    # blocksync/consensus node pays it. Relay-health-gated best-of
+    # (VERDICT r4 item 4): re-measure when the relay RTT is degraded or
+    # attempts disagree, keep every attempt in the log.
+    sus_rate = 0.0
+    attempts: list = []
+    if on_accel and use_pallas:
+        try:
+            jobs = _build_commit_jobs(n_sigs, n_commits=8)
+            sus_rate, attempts = _bench_verify_commit_stream(
+                jobs, n_sigs, measure_rtt
+            )
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            print(f"# verify_commit stream bench failed: {e}", file=sys.stderr)
     dev_s = 1.0 / sus_rate if sus_rate else single_s
 
     try:
@@ -324,9 +364,10 @@ def worker() -> None:
         "value": round(1.0 / dev_s, 1),
         "unit": "sigs/s",
         "vs_baseline": round(host_s / dev_s, 3),
-        "mode": "stream8_pipelined" if sus_rate else "single_sync",
+        "mode": "verify_commit_stream8" if sus_rate else "single_sync",
         "backend": backend_kind,
-        "kernel": "pallas" if use_pallas else "xla",
+        "kernel": ("pallas_rlc" if backend._use_rlc() else "pallas")
+        if use_pallas else "xla",
         "host_sigs_per_s": round(1.0 / host_s, 1),
         "host_multicore_sigs_per_s": round(host_mc, 1),
         "host_batch_sigs_per_s": round(host_batch_rate, 1),
@@ -334,6 +375,8 @@ def worker() -> None:
         "single_commit_sigs_per_s": round(1.0 / single_s, 1),
         "single_commit_vs_baseline": round(host_s / single_s, 3),
         "relay_rtt_ms": round(rtt_ms, 1),
+        "kernel_stream_sigs_per_s": round(kern_rate, 1),
+        "stream_attempts": attempts,
         "sustained_sigs_per_s": round(sus_rate, 1),
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
         "partial": True,
@@ -367,9 +410,10 @@ def worker() -> None:
         "value": round(1.0 / dev_s, 1),
         "unit": "sigs/s",
         "vs_baseline": round(host_s / dev_s, 3),
-        "mode": "stream8_pipelined" if sus_rate else "single_sync",
+        "mode": "verify_commit_stream8" if sus_rate else "single_sync",
         "backend": backend_kind,
-        "kernel": "pallas" if use_pallas else "xla",
+        "kernel": ("pallas_rlc" if backend._use_rlc() else "pallas")
+        if use_pallas else "xla",
         "host_sigs_per_s": round(1.0 / host_s, 1),
         "host_multicore_sigs_per_s": round(host_mc, 1),
         "vs_host_multicore": round(1.0 / dev_s / host_mc, 3) if host_mc else 0.0,
@@ -378,6 +422,8 @@ def worker() -> None:
         "single_commit_sigs_per_s": round(1.0 / single_s, 1),
         "single_commit_vs_baseline": round(host_s / single_s, 3),
         "relay_rtt_ms": round(rtt_ms, 1),
+        "kernel_stream_sigs_per_s": round(kern_rate, 1),
+        "stream_attempts": attempts,
         "sustained_sigs_per_s": round(sus_rate, 1),
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
         "mixed_curve_sigs_per_s": round(mixed_rate, 1),
@@ -387,11 +433,111 @@ def worker() -> None:
     print(
         f"# backend={backend_kind} bucket={bucket} warmup={warm:.1f}s "
         f"host={1.0/host_s:.0f} sigs/s host_mc={host_mc:.0f} sigs/s "
-        f"stream={1.0/dev_s:.0f} sigs/s single={1.0/single_s:.0f} sigs/s "
+        f"verify_commit_stream={1.0/dev_s:.0f} sigs/s "
+        f"kernel_stream={kern_rate:.0f} sigs/s "
+        f"single={1.0/single_s:.0f} sigs/s "
         f"rtt={rtt_ms:.0f}ms host_prep={prep_t/reps:.3f}s/batch "
         f"pipelined_headers={hdr_rate:.1f}/s",
         file=sys.stderr,
     )
+
+
+def _build_commit_jobs(n_vals: int, n_commits: int):
+    """Real ValidatorSet + n_commits distinct Commits at n_vals validators
+    (unique keys, canonical precommit sign-bytes), for the end-to-end
+    verify_commit headline. Commits are built directly from signed
+    CommitSigs (VoteSet.add_vote would re-verify every vote during
+    setup)."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.types import Validator, ValidatorSet, Vote
+    from tendermint_tpu.types.block import (
+        BlockID, Commit, CommitSig, PartSetHeader, BLOCK_ID_FLAG_COMMIT,
+    )
+    from tendermint_tpu.types.vote import PRECOMMIT_TYPE
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    chain_id = "bench-chain"
+    sks, vals = [], []
+    for i in range(n_vals):
+        sk = ed25519.gen_priv_key(i.to_bytes(32, "little"))
+        sks.append(sk)
+        vals.append(Validator.new(sk.pub_key(), 100))
+    vset = ValidatorSet.new(vals)
+    by_addr = {v.address: sk for sk, v in zip(sks, vals)}
+    ordered = [by_addr[v.address] for v in vset.validators]
+
+    jobs = []
+    for h in range(1, n_commits + 1):
+        bid = BlockID(
+            hash=bytes([h]) * 32,
+            part_set_header=PartSetHeader(total=1, hash=bytes([h]) * 32),
+        )
+        ts = Timestamp(seconds=1_600_000_000 + h)
+        sigs = []
+        for idx, sk in enumerate(ordered):
+            v = Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=ts,
+                validator_address=vset.validators[idx].address,
+                validator_index=idx,
+            )
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=vset.validators[idx].address,
+                    timestamp=ts,
+                    signature=sk.sign(v.sign_bytes(chain_id)),
+                )
+            )
+        commit = Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        jobs.append((chain_id, vset, bid, h, commit))
+    return jobs
+
+
+def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
+    """Stream the commits through types.verify_commit concurrently (their
+    device batches pipeline through the shared AsyncBatchVerifier) and
+    return (best_rate, attempts). Relay-health gating: retry when the RTT
+    exceeds RTT_HEALTHY_MS or the attempt disagrees with the best by >15%
+    — one bad-luck relay window must not record a 2x-low number."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tendermint_tpu.types import validation as _val
+
+    RTT_HEALTHY_MS = float(os.environ.get("TM_TPU_BENCH_RTT_HEALTHY_MS", "90"))
+    MAX_ATTEMPTS = int(os.environ.get("TM_TPU_BENCH_STREAM_ATTEMPTS", "3"))
+
+    def clear_caches() -> None:
+        # per-commit sign-bytes template + hash caches: the timed pass
+        # must pay the real host composition cost exactly once per commit
+        for _, _, _, _, commit in jobs:
+            commit._sb_tpl = None
+            commit._hash = None
+
+    def one_pass() -> float:
+        clear_caches()
+        with ThreadPoolExecutor(len(jobs)) as ex:
+            t0 = time.perf_counter()
+            futs = [
+                ex.submit(_val.verify_commit, cid, vs, bid, h, cm)
+                for cid, vs, bid, h, cm in jobs
+            ]
+            for f in futs:
+                f.result()  # raises on any verification failure
+            return len(jobs) * n_sigs / (time.perf_counter() - t0)
+
+    one_pass()  # warm: compiles shapes, fills ValidatorSet-level caches
+    attempts = []
+    for attempt in range(MAX_ATTEMPTS):
+        rtt = measure_rtt()
+        rate = one_pass()
+        attempts.append({"rate": round(rate, 1), "rtt_ms": round(rtt, 1)})
+        print(f"# verify_commit stream attempt {attempt}: {rate:.0f} sigs/s "
+              f"(rtt {rtt:.0f}ms)", file=sys.stderr)
+        best = max(a["rate"] for a in attempts)
+        if rtt <= RTT_HEALTHY_MS and rate >= 0.85 * best:
+            break
+    return max(a["rate"] for a in attempts), attempts
 
 
 def _bench_mixed_curve() -> float:

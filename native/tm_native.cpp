@@ -1662,9 +1662,71 @@ static PyObject *py_vote_sign_bytes_batch(PyObject *, PyObject *args) {
   return out;
 }
 
+// ed25519_rlc_scalars(s: n*32, k: n*32, z: n*32, m: int)
+//   -> bytes ((n/m)*32 S-scalars || n*32 u-scalars)
+//
+// Host scalar prep for the DEVICE per-lane RLC fast-accept kernel
+// (ops/pallas_rlc.py): lane g covers sigs j = g*m .. g*m+m-1 with
+// coefficients c_0 = 1, c_j = z_j (random 128-bit, caller-supplied;
+// slot-0 z entries are ignored). Per lane:
+//   S   = (s_0 + sum_{j>=1} z_j * s_j) mod L
+//   u_0 = k_0;  u_j = (z_j * k_j) mod L
+// Same RLC construction as batch_verify_rlc above (crypto/ed25519/
+// ed25519.go:192-227 semantics); the k inputs are already mod L, the s
+// inputs may be >= L for invalid signatures (reduced here — the lane's
+// s<L flag rejects them independently, this just keeps the math total).
+static PyObject *py_ed25519_rlc_scalars(PyObject *, PyObject *args) {
+  Py_buffer sb, kb, zb;
+  Py_ssize_t m;
+  if (!PyArg_ParseTuple(args, "y*y*y*n", &sb, &kb, &zb, &m)) return nullptr;
+  Py_ssize_t n = sb.len / 32;
+  if (m <= 0 || n % m || kb.len < 32 * n || zb.len < 32 * n) {
+    PyBuffer_Release(&sb);
+    PyBuffer_Release(&kb);
+    PyBuffer_Release(&zb);
+    PyErr_SetString(PyExc_ValueError, "bad rlc scalar input lengths");
+    return nullptr;
+  }
+  Py_ssize_t g = n / m;
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, 32 * (g + n));
+  if (!out) {
+    PyBuffer_Release(&sb);
+    PyBuffer_Release(&kb);
+    PyBuffer_Release(&zb);
+    return nullptr;
+  }
+  uint8_t *S = (uint8_t *)PyBytes_AS_STRING(out);
+  uint8_t *U = S + 32 * g;
+  const uint8_t *s = (const uint8_t *)sb.buf;
+  const uint8_t *k = (const uint8_t *)kb.buf;
+  const uint8_t *z = (const uint8_t *)zb.buf;
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t lane = 0; lane < g; lane++) {
+    Py_ssize_t base = lane * m;
+    // S init = s_0 mod L (s may be non-canonical; widen and reduce)
+    uint8_t wide[64] = {0};
+    memcpy(wide, s + 32 * base, 32);
+    sha512::mod_l(wide, S + 32 * lane);
+    memcpy(U + 32 * base, k + 32 * base, 32);
+    for (Py_ssize_t j = 1; j < m; j++) {
+      uint8_t zs[32];
+      ed::sc_mul(zs, z + 32 * (base + j), s + 32 * (base + j));
+      ed::sc_add(S + 32 * lane, S + 32 * lane, zs);
+      ed::sc_mul(U + 32 * (base + j), z + 32 * (base + j), k + 32 * (base + j));
+    }
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&sb);
+  PyBuffer_Release(&kb);
+  PyBuffer_Release(&zb);
+  return out;
+}
+
 static PyMethodDef Methods[] = {
     {"ed25519_batch_verify", py_ed25519_batch_verify, METH_VARARGS,
      "Host RLC batch ed25519 verification (Pippenger MSM); returns bool"},
+    {"ed25519_rlc_scalars", py_ed25519_rlc_scalars, METH_VARARGS,
+     "Per-lane RLC scalar prep for the device fast-accept kernel"},
     {"vote_sign_bytes_batch", py_vote_sign_bytes_batch, METH_VARARGS,
      "Batch canonical vote sign-bytes composition from a template"},
     {"ed25519_challenges", py_ed25519_challenges, METH_VARARGS,

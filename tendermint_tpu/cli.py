@@ -196,33 +196,69 @@ def cmd_testnet(args) -> int:
 
 
 def cmd_replay(args) -> int:
-    """replay (replay.go / replay_file.go): re-drive the consensus WAL
-    through the state machine against the stores — console mode prints
-    each record."""
-    from .consensus.wal import WAL
+    """replay / replay-console (replay_file.go:38-90): RE-DRIVE the
+    consensus WAL through the state machine against snapshot copies of
+    the stores. Without --console every record is applied and the final
+    round state printed; with --console the playback manager accepts
+    `next [N]`, `back [N]`, `rs [field]`, `n`, `quit` (replayConsoleLoop,
+    replay_file.go:199-305)."""
+    from .consensus.replay_console import Playback
 
     cfg = _cfg(args.home)
-    home = args.home
-    wal = WAL(cfg.consensus.wal_path(home))
-    count = 0
-    last_height = None
-    for rec in wal.iter_messages():
-        count += 1
-        if rec.end_height is not None:
-            last_height = rec.end_height
-        if args.console:
-            if rec.end_height is not None:
-                print(f"#{count}: ENDHEIGHT {rec.end_height}")
-            elif rec.timeout is not None:
-                d, h, r, st = rec.timeout
-                print(f"#{count}: TIMEOUT h={h} r={r} step={st} after {d}ms")
-            else:
+    cfg.base.home = args.home
+    pb = Playback(cfg)
+    if not args.console:
+        n = pb.step(len(pb._records))
+        print(
+            f"replayed {n} WAL records; round state: {pb.round_state()}; "
+            f"last committed height: {pb.cs.rs.height - 1}"
+        )
+        return 0
+    print(f"{pb.remaining()} WAL records loaded; type `next [N]`, `back [N]`, "
+          "`rs [field]`, `n`, or `quit`")
+    while True:
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return 0
+        if not line:
+            continue
+        tokens = line.split()
+        cmd = tokens[0]
+        if cmd in ("quit", "q", "exit"):
+            return 0
+        if cmd == "next":
+            n = 1
+            if len(tokens) > 1:
+                try:
+                    n = int(tokens[1])
+                except ValueError:
+                    print("next takes an integer argument")
+                    continue
+            applied = pb.step(n)
+            print(f"applied {applied} record(s); rs {pb.round_state()}")
+        elif cmd == "back":
+            n = 1
+            if len(tokens) > 1:
+                try:
+                    n = int(tokens[1])
+                except ValueError:
+                    print("back takes an integer argument")
+                    continue
+            if n < 1 or n > pb.count:
                 print(
-                    f"#{count}: {rec.msg_kind} ({len(rec.msg_payload)}B)"
-                    + (f" from {rec.peer_id}" if rec.peer_id else "")
+                    f"argument to back must be in 1..{pb.count} "
+                    "(the current count)"
                 )
-    print(f"replayed {count} WAL records; last committed height: {last_height}")
-    return 0
+                continue
+            pb.reset_back(n)
+            print(f"reset to record {pb.count}; rs {pb.round_state()}")
+        elif cmd == "rs":
+            print(pb.round_state(tokens[1] if len(tokens) > 1 else "short"))
+        elif cmd == "n":
+            print(pb.count)
+        else:
+            print(f"unknown command {cmd!r}")
 
 
 def cmd_debug(args) -> int:

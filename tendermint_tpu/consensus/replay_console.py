@@ -1,0 +1,194 @@
+"""WAL replay console — re-drive the consensus WAL through the state
+machine against COPIES of the node's stores.
+
+Reference parity: internal/consensus/replay_file.go:38-90 (RunReplayFile /
+State.ReplayFile) and the playback manager (:120-199): records decode from
+the WAL file and feed the real consensus State's handlers one at a time;
+`back N` rebuilds the State from the restart point and re-applies
+count - N records (replayReset — "back is not supported in the state
+machine design, so we restart and replay up to"). Unlike the reference,
+the stores are snapshotted into MemDBs first, so a console session can
+never corrupt the node's data directory (blocks re-applied during replay
+commit to the copies).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..db import DB, MemDB
+from ..types.part_set import Part
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+
+def _copy_db(src: DB) -> MemDB:
+    dst = MemDB()
+    for k, v in src.iterator(None, None):
+        dst.set(k, v)
+    return dst
+
+
+class Playback:
+    """replay_file.go:120 playback: a consensus State fed straight from
+    decoded WAL records, with reset-and-replay for `back`."""
+
+    def __init__(self, config, app=None):
+        self._config = config
+        self._app = app
+        self._records: List = []
+        self._load_stores()
+        self._build_cs()
+        self._read_wal()
+        self.count = 0  # records applied so far
+
+    # -- construction -------------------------------------------------------
+
+    def _load_stores(self) -> None:
+        from ..db import backend as db_backend
+        from ..state.store import StateStore
+        from ..store import BlockStore
+        from ..types.genesis import GenesisDoc
+
+        cfg = self._config
+        home = cfg.base.home
+
+        def _db(name: str):
+            if cfg.base.db_backend in ("memdb", "mem") or not home:
+                return MemDB()
+            return db_backend(cfg.base.db_backend, cfg.base.db_path(name))
+
+        # snapshot: replay APPLIES blocks (ABCI + store writes); the
+        # console must never touch the node's real data directory
+        self._block_db = _copy_db(_db("blockstore"))
+        self._state_db = _copy_db(_db("state"))
+        self._genesis = GenesisDoc.from_file(cfg.base.genesis_path())
+        self._genesis.validate_and_complete()
+        self.block_store = BlockStore(self._block_db)
+        self.state_store = StateStore(self._state_db)
+
+    def _build_cs(self) -> None:
+        """Mirror make_node's consensus wiring (node/__init__.py) on the
+        snapshotted stores, minus p2p/rpc/privval — and with wal=None:
+        a replay session must not append to the WAL it is reading
+        (ReplayFile refuses when cs.wal is open)."""
+        from ..abci.client import LocalClient, SocketClient
+        from ..abci.kvstore import KVStoreApplication
+        from ..consensus.replay import Handshaker
+        from ..consensus.state import ConsensusState
+        from ..eventbus import EventBus
+        from ..evidence import Pool as EvidencePool
+        from ..mempool import TxMempool
+        from ..state import make_genesis_state
+        from ..state.execution import BlockExecutor
+
+        cfg = self._config
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(self._genesis)
+            self.state_store.save(state)
+
+        if self._app is not None:
+            conn = LocalClient(self._app)
+        elif cfg.base.proxy_app in ("kvstore", "persistent_kvstore"):
+            conn = LocalClient(KVStoreApplication())
+        else:
+            conn = SocketClient(cfg.base.proxy_app)
+        event_bus = EventBus()
+        handshaker = Handshaker(
+            self.state_store, state, self.block_store, self._genesis, event_bus
+        )
+        state = handshaker.handshake(conn)
+        mempool = TxMempool(conn, cfg.mempool, height=state.last_block_height)
+        evpool = EvidencePool(
+            MemDB(), state_store=self.state_store, block_store=self.block_store
+        )
+        evpool.set_state(state)
+        block_exec = BlockExecutor(
+            self.state_store, conn, mempool=mempool, evpool=evpool,
+            block_store=self.block_store, event_bus=event_bus,
+        )
+        self.cs = ConsensusState(
+            cfg.consensus, state, block_exec, self.block_store,
+            mempool=mempool, evpool=evpool, event_bus=event_bus, wal=None,
+        )
+
+    def _read_wal(self) -> None:
+        from .wal import WAL
+
+        cfg = self._config
+        wal = WAL(cfg.consensus.wal_path(cfg.base.home))
+        self._records = list(wal.iter_messages())
+
+    # -- stepping -----------------------------------------------------------
+
+    def remaining(self) -> int:
+        return len(self._records) - self.count
+
+    def step(self, n: int = 1) -> int:
+        """Apply the next n records through the state machine handlers
+        (readReplayMessage, replay.go:41: msgInfo -> handleMsg paths,
+        timeouts -> handleTimeout, EndHeight -> marker). Returns how many
+        were applied."""
+        from ..wire.proto import decode_message, field_bytes, field_int
+        from .state import BlockPartMessage, TimeoutInfo
+
+        applied = 0
+        while applied < n and self.count < len(self._records):
+            rec = self._records[self.count]
+            self.count += 1
+            applied += 1
+            try:
+                if rec.end_height is not None:
+                    continue  # height marker; state advances via commits
+                if rec.timeout is not None:
+                    d, h, r, st = rec.timeout
+                    self.cs._handle_timeout(
+                        TimeoutInfo(duration=d / 1000.0, height=h, round=r, step=st)
+                    )
+                elif rec.msg_kind == "proposal":
+                    self.cs._set_proposal(Proposal.decode(rec.msg_payload))
+                elif rec.msg_kind == "block_part":
+                    f = decode_message(rec.msg_payload)
+                    self.cs._add_proposal_block_part(
+                        BlockPartMessage(
+                            height=field_int(f, 1),
+                            round=field_int(f, 2),
+                            part=Part.decode(field_bytes(f, 3)),
+                        ),
+                        rec.peer_id,
+                    )
+                elif rec.msg_kind == "vote":
+                    self.cs._try_add_vote(Vote.decode(rec.msg_payload), rec.peer_id)
+            except (ValueError, RuntimeError, KeyError):
+                # stale/duplicate records for already-committed heights are
+                # expected when replaying a full WAL over a caught-up state
+                continue
+        return applied
+
+    def reset_back(self, back: int) -> None:
+        """replayReset: rebuild the State from the restart point and
+        re-apply count - back records."""
+        target = max(self.count - back, 0)
+        self._load_stores()
+        self._build_cs()
+        self.count = 0
+        self.step(target)
+        # step() counts every record it consumed; make the position exact
+        self.count = target
+
+    # -- round state (the `rs` console command) ------------------------------
+
+    def round_state(self, field: Optional[str] = None) -> str:
+        from .types import STEP_NAMES
+
+        rs = self.cs.rs
+        if field in (None, "", "short"):
+            return f"{rs.height}/{rs.round}/{STEP_NAMES.get(rs.step, rs.step)}"
+        if field in (
+            "validators", "proposal", "proposal_block", "locked_round",
+            "locked_block", "votes", "valid_round", "valid_block",
+            "commit_round", "last_commit",
+        ):
+            return str(getattr(rs, field))
+        return f"unknown option {field}"

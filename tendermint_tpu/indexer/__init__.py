@@ -89,7 +89,10 @@ class KVSink(Sink):
         candidate_hashes: Optional[set] = None
         post_conditions = []
         for key, op, val in q.conditions:
-            if op == "=" and key not in ("tx.height",):
+            # index fast path only for string-typed equality: the kv index
+            # stores raw value strings, and typed numeric/time equality
+            # must coerce ("4" matches a stored "4.0") in the post-filter
+            if op == "=" and isinstance(val, str) and key not in ("tx.height",):
                 hashes = {
                     v
                     for _, v in self._db.iterator(
@@ -113,18 +116,7 @@ class KVSink(Sink):
         for rec in records:
             events = dict(rec.get("events", {}))
             events.setdefault("tx.height", [str(rec["height"])])
-            ok = True
-            for key, op, val in post_conditions:
-                vals = events.get(key)
-                if vals is None:
-                    ok = False
-                    break
-                if op != "EXISTS" and not any(
-                    Query._match_one(op, got, val) for got in vals
-                ):
-                    ok = False
-                    break
-            if ok:
+            if Query.match_conditions(events, post_conditions):
                 out.append(rec)
             if len(out) >= limit:
                 break
@@ -134,8 +126,9 @@ class KVSink(Sink):
     def search_blocks(self, query: str, limit: int = 100) -> List[int]:
         q = Query(query)
         candidate: Optional[set] = None
+        post_conditions = []
         for key, op, val in q.conditions:
-            if op == "=":
+            if op == "=" and isinstance(val, str):
                 hs = {
                     struct.unpack(">q", k[-8:])[0]
                     for k, _ in self._db.iterator(
@@ -144,11 +137,21 @@ class KVSink(Sink):
                     )
                 }
                 candidate = hs if candidate is None else candidate & hs
+            else:
+                post_conditions.append((key, op, val))
         if candidate is None:
             candidate = {
                 struct.unpack(">q", k[len(b"blk/"):])[0]
                 for k, _ in self._db.iterator(b"blk/", b"blk0")
             }
+        if post_conditions:
+            kept = set()
+            for h in candidate:
+                raw = self._db.get(b"blk/" + struct.pack(">q", h))
+                events = json.loads(raw) if raw is not None else {}
+                if Query.match_conditions(events, post_conditions):
+                    kept.add(h)
+            candidate = kept
         return sorted(candidate)[:limit]
 
 

@@ -2,9 +2,17 @@
 
 Reference parity: libs/pubsub/pubsub.go (Server with per-subscriber
 buffered channels) + libs/pubsub/query (the event query language:
-`tm.event='NewBlock' AND tx.height>5`). The query grammar here covers the
-operators the reference's PEG grammar defines: =, <, <=, >, >=, CONTAINS,
-EXISTS, AND (the reference has no OR — parity).
+`tm.event='NewBlock' AND tx.height>5`). The parser below is a
+recursive-descent implementation of the reference's PEG grammar
+(libs/pubsub/query/query.peg) with its typed operand semantics
+(libs/pubsub/query/query.go:140-200, matchValue :396-503): quoted
+strings, int64/float64 numbers, `TIME <RFC3339>` and `DATE <ISO-date>`
+literals, operators =, <, <=, >, >=, CONTAINS, EXISTS joined by AND (the
+reference has no OR — parity). Quoted values are tokenized, so a literal
+containing ` AND ` parses; event values matched against numeric operands
+are filtered through the reference's numRegex first (`8.045stake` > 7.0
+matches), and float values compared to int operands truncate exactly as
+strconv-then-int64 does.
 """
 
 from __future__ import annotations
@@ -13,7 +21,8 @@ import queue
 import re
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from datetime import datetime, timezone
+from typing import Dict, List, NamedTuple, Optional, Union
 
 
 @dataclass
@@ -22,56 +31,203 @@ class Message:
     events: Dict[str, List[str]] = field(default_factory=dict)
 
 
+Operand = Union[str, int, float, datetime]
+
+
+class Condition(NamedTuple):
+    """query.Condition: (CompositeKey, Op, Operand) with a TYPED operand:
+    str (quoted value), int, float, or tz-aware datetime (TIME/DATE)."""
+
+    key: str
+    op: str
+    operand: Optional[Operand]
+
+
+# tag <- (![ \t\n\r\\()"'=><] .)+
+_TAG_STOP = set(" \t\n\r\\()\"'=><")
+_NUM_RE = re.compile(r"(0|[1-9][0-9]*)(\.[0-9]*)?")
+# numRegex in query.go:23 — the value-side number filter
+_VAL_NUM_RE = re.compile(r"[0-9\.]+")
+_TIME_RE = re.compile(
+    r"[12][0-9]{3}-[01][0-9]-[0-3][0-9]T[0-9]{2}:[0-9]{2}:[0-9]{2}"
+    r"(?:[-+][0-9]{2}:[0-9]{2}|Z)"
+)
+_DATE_RE = re.compile(r"[12][0-9]{3}-[01][0-9]-[0-3][0-9]")
+
+
+def _parse_time(s: str) -> datetime:
+    dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+    return dt if dt.tzinfo else dt.replace(tzinfo=timezone.utc)
+
+
+def _parse_date(s: str) -> datetime:
+    return datetime.fromisoformat(s).replace(tzinfo=timezone.utc)
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def error(self, what: str) -> ValueError:
+        return ValueError(f"invalid query: expected {what} at offset {self.i} in {self.s!r}")
+
+    def spaces(self) -> None:
+        while self.i < len(self.s) and self.s[self.i] in " \t\n\r":
+            self.i += 1
+
+    def literal(self, lit: str) -> bool:
+        if self.s.startswith(lit, self.i):
+            self.i += len(lit)
+            return True
+        return False
+
+    def regex(self, rx: "re.Pattern") -> Optional[str]:
+        m = rx.match(self.s, self.i)
+        if m is None:
+            return None
+        self.i = m.end()
+        return m.group(0)
+
+    def tag(self) -> str:
+        j = self.i
+        while j < len(self.s) and self.s[j] not in _TAG_STOP:
+            j += 1
+        if j == self.i:
+            raise self.error("tag")
+        out = self.s[self.i : j]
+        self.i = j
+        return out
+
+    def quoted(self) -> str:
+        if not self.literal("'"):
+            raise self.error("quoted value")
+        j = self.s.find("'", self.i)
+        if j < 0:
+            raise self.error("closing quote")
+        out = self.s[self.i : j]
+        self.i = j + 1
+        return out
+
+    def number(self) -> Optional[Union[int, float]]:
+        text = self.regex(_NUM_RE)
+        if text is None:
+            return None
+        # number must end the operand (no trailing junk like `7stake`)
+        if self.i < len(self.s) and self.s[self.i] not in " \t\n\r":
+            raise self.error("end of number")
+        return float(text) if "." in text else int(text)
+
+    def operand(self, allow_string: bool) -> Operand:
+        if self.literal("TIME "):
+            self.spaces()
+            text = self.regex(_TIME_RE)
+            if text is None:
+                raise self.error("RFC3339 time after TIME")
+            return _parse_time(text)
+        if self.literal("DATE "):
+            self.spaces()
+            text = self.regex(_DATE_RE)
+            if text is None:
+                raise self.error("date after DATE")
+            return _parse_date(text)
+        num = self.number()
+        if num is not None:
+            return num
+        if allow_string and self.i < len(self.s) and self.s[self.i] == "'":
+            return self.quoted()
+        raise self.error("operand")
+
+    def condition(self) -> Condition:
+        key = self.tag()
+        self.spaces()
+        for op in ("<=", ">=", "<", ">", "="):
+            if self.literal(op):
+                self.spaces()
+                # inequalities take number/time/date only; = also strings
+                return Condition(key, op, self.operand(allow_string=op == "="))
+        if self.literal("CONTAINS"):
+            self.spaces()
+            return Condition(key, "CONTAINS", self.quoted())
+        if self.literal("EXISTS"):
+            return Condition(key, "EXISTS", None)
+        raise self.error("operator")
+
+    def parse(self) -> List[Condition]:
+        out = [self.condition()]
+        while True:
+            self.spaces()
+            if self.i >= len(self.s):
+                return out
+            if not self.literal("AND"):
+                raise self.error("AND")
+            self.spaces()
+            out.append(self.condition())
+
+
 class Query:
     """Parsed event query (libs/pubsub/query/query.go)."""
 
-    _COND_RE = re.compile(
-        r"\s*([\w.\-/]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*"
-        r"('(?:[^']*)'|\"(?:[^\"]*)\"|[\w.\-+]+)?\s*",
-    )
-
     def __init__(self, s: str):
         self._source = s
-        self.conditions: List[Tuple[str, str, Optional[str]]] = []
+        self.conditions: List[Condition] = []
         if not s.strip():
             return
-        for part in re.split(r"\bAND\b", s):
-            part = part.strip()
-            if not part:
-                continue
-            m = self._COND_RE.fullmatch(part)
-            if not m:
-                raise ValueError(f"invalid query condition {part!r}")
-            key, op, val = m.group(1), m.group(2), m.group(3)
-            if op != "EXISTS":
-                if val is None:
-                    raise ValueError(f"operator {op} needs a value in {part!r}")
-                if val[0] in "'\"":
-                    val = val[1:-1]
-            self.conditions.append((key, op, val))
+        self.conditions = _Parser(s.strip()).parse()
 
     def matches(self, events: Dict[str, List[str]]) -> bool:
-        for key, op, want in self.conditions:
+        return self.match_conditions(events, self.conditions)
+
+    @staticmethod
+    def match_conditions(events: Dict[str, List[str]], conditions) -> bool:
+        """AND-match a condition list against flattened events (shared by
+        pubsub matching and the indexer's search post-filters)."""
+        for key, op, want in conditions:
+            if op == "EXISTS":
+                # query.go:246-262: composite "type.attr" tags look up
+                # exactly; bare tags PREFIX-match ("sl" matches "slash.*")
+                if "." in key:
+                    if key not in events:
+                        return False
+                elif not any(k.startswith(key) for k in events):
+                    return False
+                continue
             values = events.get(key)
             if values is None:
                 return False
-            if op == "EXISTS":
-                continue
-            if not any(self._match_one(op, got, want) for got in values):
+            if not any(Query._match_one(op, got, want) for got in values):
                 return False
         return True
 
     @staticmethod
-    def _match_one(op: str, got: str, want: str) -> bool:
-        if op == "=":
-            return got == want
-        if op == "CONTAINS":
-            return want in got
-        try:
-            g, w = float(got), float(want)
-        except ValueError:
+    def _match_one(op: str, got: str, want: Operand) -> bool:
+        """matchValue (query.go:396-503): the event value `got` is coerced
+        toward the OPERAND's type; coercion failure is no-match."""
+        if isinstance(want, str):
+            if op == "=":
+                return got == want
+            if op == "CONTAINS":
+                return want in got
             return False
-        return {"<": g < w, "<=": g <= w, ">": g > w, ">=": g >= w}[op]
+        if isinstance(want, datetime):
+            try:
+                g = _parse_time(got) if "T" in got else _parse_date(got)
+            except ValueError:
+                return False
+        else:
+            m = _VAL_NUM_RE.search(got)
+            if m is None:
+                return False
+            try:
+                g = float(m.group(0))
+            except ValueError:
+                return False
+            if isinstance(want, int):
+                g = int(g) if "." in m.group(0) else int(m.group(0))
+        return {
+            "=": g == want, "<": g < want, "<=": g <= want,
+            ">": g > want, ">=": g >= want,
+        }[op]
 
     def __str__(self) -> str:
         return self._source

@@ -245,6 +245,20 @@ def _pallas_bucket(n: int) -> int:
     return max(b, min(((n + b - 1) // b) * b, BUCKETS[-1]))
 
 
+@functools.lru_cache(maxsize=1)
+def _use_rlc() -> bool:
+    """RLC fast-accept lane packing (ops.pallas_rlc): M signatures share
+    one ladder per lane — ~1.45x the per-sig kernel on hardware (22.8 vs
+    33 ms/10240). Default ON for the TPU pallas path; TM_TPU_RLC=1/0
+    forces either way (tests force 1 on the CPU interpret backend)."""
+    env = os.environ.get("TM_TPU_RLC")
+    if env is not None:
+        return env != "0"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """Run the device kernel over arbitrary batch size; returns (n,) bool."""
     if _use_pallas():
@@ -255,6 +269,10 @@ def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
 
         if jax.default_backend() != "tpu":
             interpret = True  # forced-on under tests: tiny batches only
+        if _use_rlc():
+            from . import pallas_rlc
+
+            return pallas_rlc.verify_batch_rlc(entries, interpret=interpret)
         out = []
         i = 0
         while i < len(entries):

@@ -68,35 +68,51 @@ class AsyncBatchVerifier:
     # -- worker ----------------------------------------------------------
 
     def _dispatch(self, entries):
-        """Host prep + async device dispatch (does not block on result)."""
+        """Host prep + async device dispatch (does not block on result).
+
+        Returns (device_value, rlc_entries): rlc_entries is None for the
+        per-signature kernels; for the RLC fast-accept kernel it is the
+        entry list _resolve needs to expand lane verdicts to per-sig
+        verdicts (and re-verify rejected lanes for blame)."""
         if _backend._use_pallas():
             import jax
 
             from . import pallas_verify
 
+            interpret = jax.default_backend() != "tpu"
+            if _backend._use_rlc():
+                from . import pallas_rlc
+
+                bucket, g, block = pallas_rlc.plan_bucket(len(entries))
+                args = pallas_rlc.prepare_rlc(entries, bucket)
+                f = pallas_rlc._jitted_rlc_verify(g, block, interpret)
+                return f(*args), list(entries)
             bucket = _backend._pallas_bucket(len(entries))
             args = pallas_verify.prepare_compact(entries, bucket)
-            interpret = jax.default_backend() != "tpu"
             f = pallas_verify._jitted_pallas_verify(
                 bucket, min(pallas_verify.BLOCK, bucket), interpret
             )
-            return f(*args)
+            return f(*args), None
         device_hash = not _backend.HOST_HASH and all(
             len(m) <= _backend.DEVICE_HASH_MAX_MSG for _, m, _ in entries
         )
         bucket = _backend._bucket_for(len(entries))
         if device_hash:
             args = _backend.prepare_batch_device_hash(entries, bucket)
-            return _kernel.jitted_verify_device_hash()(*args)
+            return _kernel.jitted_verify_device_hash()(*args), None
         args = _backend.prepare_batch(entries, bucket)
-        return _kernel.jitted_verify()(*args)
+        return _kernel.jitted_verify()(*args), None
 
     @staticmethod
-    def _resolve(spans, dev) -> None:
+    def _resolve(spans, dev, rlc_entries=None) -> None:
         try:
             arr = np.asarray(dev)
-            if arr.ndim == 2:  # pallas output is (1, N)
+            if arr.ndim == 2:  # pallas output is (1, N) / (1, lanes)
                 arr = arr[0].astype(bool)
+            if rlc_entries is not None:
+                from . import pallas_rlc
+
+                arr = pallas_rlc.expand_lanes(arr, rlc_entries)
         except Exception as e:  # noqa: BLE001
             for job, _, _ in spans:
                 job.future.set_exception(e)
@@ -153,7 +169,7 @@ class AsyncBatchVerifier:
                         spans.append((j, len(entries), len(j.entries)))
                         entries.extend(j.entries)
                     try:
-                        dev = self._dispatch(entries)
+                        dev, rlc_entries = self._dispatch(entries)
                         # start the device->host copy NOW: a blocking fetch
                         # through the relay costs a full ~65ms RTT, but an
                         # async copy rides behind the compute, so the later
@@ -163,7 +179,7 @@ class AsyncBatchVerifier:
                             dev.copy_to_host_async()
                         except AttributeError:
                             pass
-                        pending.append((spans, dev))
+                        pending.append((spans, dev, rlc_entries))
                     except Exception as e:  # noqa: BLE001
                         for j, _, _ in spans:
                             j.future.set_exception(e)

