@@ -35,6 +35,18 @@ class Ed25519HostBatchVerifier(BatchVerifier):
             raise ValueError("invalid signature length")
         self._entries.append((key.bytes(), msg, sig))
 
+    def add_entries(self, entries, lengths_checked: bool = False) -> None:
+        """Bulk add() — one pass. The key-type check always runs (a mixed
+        validator set must fail like per-entry add); lengths_checked=True
+        skips only the length scan for callers that already did it."""
+        if any(not isinstance(k, _ed25519.PubKey) for k, _, _ in entries):
+            raise TypeError("pubkey is not ed25519")
+        if not lengths_checked and any(
+            len(s) != _ed25519.SIGNATURE_SIZE for _, _, s in entries
+        ):
+            raise ValueError("invalid signature length")
+        self._entries.extend((k.bytes(), m, s) for k, m, s in entries)
+
     def verify(self) -> Tuple[bool, List[bool]]:
         # Random-linear-combination batch first when the native module is
         # built (one Pippenger MSM — crypto/ed25519/ed25519.go:219-227

@@ -118,10 +118,11 @@ class KVSink(Sink):
             events.setdefault("tx.height", [str(rec["height"])])
             if Query.match_conditions(events, post_conditions):
                 out.append(rec)
-            if len(out) >= limit:
-                break
+        # sort BEFORE applying the limit: records iterate in db/hash-set
+        # order, so an early break would return an arbitrary page instead
+        # of the first `limit` by (height, index)
         out.sort(key=lambda r: (r["height"], r["index"]))
-        return out
+        return out[:limit]
 
     def search_blocks(self, query: str, limit: int = 100) -> List[int]:
         q = Query(query)

@@ -50,7 +50,7 @@ _NUM_RE = re.compile(r"(0|[1-9][0-9]*)(\.[0-9]*)?")
 _VAL_NUM_RE = re.compile(r"[0-9\.]+")
 _TIME_RE = re.compile(
     r"[12][0-9]{3}-[01][0-9]-[0-3][0-9]T[0-9]{2}:[0-9]{2}:[0-9]{2}"
-    r"(?:[-+][0-9]{2}:[0-9]{2}|Z)"
+    r"(?:\.[0-9]+)?(?:[-+][0-9]{2}:[0-9]{2}|Z)"  # RFC3339 incl. fractions
 )
 _DATE_RE = re.compile(r"[12][0-9]{3}-[01][0-9]-[0-3][0-9]")
 

@@ -66,6 +66,14 @@ if M not in (2, 4, 8):
 # the per-sig kernel's 16-entry table at M x the lane count.
 BLOCK_LANES = int(os.environ.get("TM_TPU_RLC_BLOCK", "128"))
 
+# Max signatures per device batch. The relay-attached TPU pays a flat
+# ~14 ms per host->device transfer regardless of size (measured round 5),
+# so batches amortize it: 10240 sigs/batch tops out ~295k sigs/s while
+# 81920 reaches ~460k (transfer included). The async pipeline coalesces
+# concurrent commits up to this cap; HBM at 81920 is ~900 MB of
+# intermediates on a 16 GB part.
+MAX_SIGS = int(os.environ.get("TM_TPU_RLC_MAX_SIGS", "81920"))
+
 # Scalar q: 0 -> S, 1..M -> u_{q-1}, M+1..2M-1 -> z_{q-M}.
 N_SCAL = 2 * M
 # Table t pairs scalar lo=2t (low 2 bits of the entry index) with
@@ -253,17 +261,33 @@ def _k3_rlc_kernel(tbl_ref, dig_ref, coords_ref, ok_ref, sok_ref, out_ref):
 # -- pipeline ----------------------------------------------------------------
 
 
+# Quantized bucket ladder (in signatures): XLA compiles one executable
+# per shape, and the coalescing pipeline would otherwise produce a fresh
+# shape (and a ~25 s Mosaic compile) for every distinct batch total.
+RLC_BUCKETS = (512, 2048, 10240, 20480, 40960, MAX_SIGS)
+
+
 def plan_bucket(n: int, block: int = 0) -> tuple:
     """(bucket_sigs, g_lanes, block) covering n signatures such that the
     lane count divides evenly into kernel blocks. EVERY caller that feeds
     _jitted_rlc_verify must size via this: a g not divisible by block
     would truncate the pallas grid and leave trailing lanes' verdicts
-    uninitialized — read back as garbage 'valid' bits."""
+    uninitialized — read back as garbage 'valid' bits.
+
+    Buckets quantize to RLC_BUCKETS (pow2 single-block below 512 sigs) so
+    the compiled-shape set stays small under arbitrary coalesced sizes."""
     block = block or BLOCK_LANES
     lanes = max((n + M - 1) // M, 1)
-    block = min(block, 1 << (lanes - 1).bit_length())  # tiny batches shrink
-    g = ((lanes + block - 1) // block) * block
-    return g * M, g, block
+    if block < BLOCK_LANES or lanes <= block:
+        # explicit small blocks (tests) or tiny batches: pow2 single/multi
+        # block, lane count padded to a multiple of the block
+        block = min(block, 1 << (lanes - 1).bit_length())
+        g = ((lanes + block - 1) // block) * block
+        return g * M, g, block
+    for b in RLC_BUCKETS:
+        if n <= b:
+            return b, b // M, block
+    return RLC_BUCKETS[-1], RLC_BUCKETS[-1] // M, block
 
 
 @functools.lru_cache(maxsize=None)
@@ -383,13 +407,20 @@ def prepare_rlc(entries, bucket: int):
     if bucket % M:
         raise ValueError(f"bucket {bucket} not a multiple of M={M}")
     g = bucket // M
-    pub, r_enc, s_enc = _pack_rows(entries, bucket)
-    s_ok = _s_below_l(s_enc, n, bucket)
-    k_enc = np.zeros((bucket, 32), dtype=np.uint8)
+    # All host work runs over the LIVE lanes only; padding lanes get
+    # their constant pattern (identity-point A/R encodings, zero scalars,
+    # s_ok true) via broadcast assigns. A coalesced total just past a
+    # quantized bucket would otherwise pay the full bucket's packing and
+    # transposes on the host.
+    g_live = min((n + M - 1) // M, g)
+    live = g_live * M
+    pub, r_enc, s_enc = _pack_rows(entries, live)
+    s_ok = _s_below_l(s_enc, n, live)
+    k_enc = np.zeros((live, 32), dtype=np.uint8)
     if n:
         ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
         k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
-    z = _gen_z(bucket)
+    z = _gen_z(live)
 
     native = _load_native()
     s_b, k_b, z_b = s_enc.tobytes(), k_enc.tobytes(), z.tobytes()
@@ -397,25 +428,34 @@ def prepare_rlc(entries, bucket: int):
         raw = native.ed25519_rlc_scalars(s_b, k_b, z_b, M)
     else:
         raw = _rlc_scalars_py(s_b, k_b, z_b, M)
-    S = np.frombuffer(raw[: 32 * g], dtype=np.uint8).reshape(g, 32)
-    U = np.frombuffer(raw[32 * g :], dtype=np.uint8).reshape(g, M, 32)
+    S = np.frombuffer(raw[: 32 * g_live], dtype=np.uint8).reshape(g_live, 32)
+    U = np.frombuffer(raw[32 * g_live :], dtype=np.uint8).reshape(g_live, M, 32)
 
-    scal = np.zeros((g, N_SCAL, 32), dtype=np.uint8)
+    scal = np.zeros((g_live, N_SCAL, 32), dtype=np.uint8)
     scal[:, 0] = S
     scal[:, 1 : M + 1] = U
-    scal[:, M + 1 :] = z.reshape(g, M, 32)[:, 1:]
+    scal[:, M + 1 :] = z.reshape(g_live, M, 32)[:, 1:]
 
-    def slotmajor(arr):  # (bucket, 32) -> (M*32, g)
+    def slotmajor(arr):  # (live, 32) -> (M*32, g_live)
         return np.ascontiguousarray(
-            arr.reshape(g, M, 32).transpose(1, 2, 0).reshape(M * 32, g)
+            arr.reshape(g_live, M, 32).transpose(1, 2, 0).reshape(M * 32, g_live)
         )
 
-    return (
-        slotmajor(pub),
-        slotmajor(r_enc),
-        np.ascontiguousarray(scal.transpose(1, 2, 0).reshape(N_SCAL * 32, g)),
-        np.ascontiguousarray(s_ok.reshape(g, M).T.astype(np.int32)),
-    )
+    a_t = np.zeros((M * 32, g), dtype=np.uint8)
+    r_t = np.zeros((M * 32, g), dtype=np.uint8)
+    scal_t = np.zeros((N_SCAL * 32, g), dtype=np.uint8)
+    sok_t = np.ones((M, g), dtype=np.int32)
+    # padding lanes: identity encoding = byte 0 of each slot set to 1
+    a_t[np.arange(M) * 32, g_live:] = 1
+    r_t[np.arange(M) * 32, g_live:] = 1
+    if g_live:
+        a_t[:, :g_live] = slotmajor(pub)
+        r_t[:, :g_live] = slotmajor(r_enc)
+        scal_t[:, :g_live] = np.ascontiguousarray(
+            scal.transpose(1, 2, 0).reshape(N_SCAL * 32, g_live)
+        )
+        sok_t[:, :g_live] = s_ok.reshape(g_live, M).T.astype(np.int32)
+    return a_t, r_t, scal_t, sok_t
 
 
 def verify_rlc_compact(a_t, r_t, scal_t, sok_t, block: int = 0,
@@ -450,7 +490,7 @@ def expand_lanes(lane_valid: np.ndarray, entries) -> np.ndarray:
 def verify_batch_rlc(entries, block: int = 0, interpret: bool = False) -> np.ndarray:
     """Arbitrary-size batch through the RLC fast-accept path; returns
     per-signature (n,) bool with exact per-sig ZIP-215 blame."""
-    sigs_per_call = 10240
+    sigs_per_call = MAX_SIGS
     out = []
     i = 0
     while i < len(entries):
