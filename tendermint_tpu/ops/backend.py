@@ -383,9 +383,9 @@ class Ed25519DeviceBatchVerifier(BatchVerifier):
         else:
             res = verify_batch(self._entries)
         res = np.asarray(res).astype(bool)
-        # numpy verdicts: .all() in C and the array itself as the per-sig
-        # list (callers only iterate it on the blame path)
-        return bool(res.all()), res
+        # .all() and .tolist() both run in C — keeps the documented
+        # (bool, List[bool]) interface without a 10k-iteration Python loop
+        return bool(res.all()), res.tolist()
 
 
 def warmup(bucket: int = BUCKETS[0]) -> None:
