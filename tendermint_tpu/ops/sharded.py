@@ -239,3 +239,101 @@ def _bucket_pow2(n: int, nd: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+# ---------------------------------------------------------------------------
+# Flagship-kernel sharding: the RLC fast-accept pipeline (ops.pallas_rlc —
+# the engine VerifyCommit dispatches on TPU since round 5) under shard_map.
+# The LANE axis shards over the mesh; the psum tally sums voting power of
+# signatures in accepted lanes; rejected lanes re-verify on the host for
+# blame exactly like the single-chip path (expand_lanes semantics).
+# ---------------------------------------------------------------------------
+
+
+def sharded_rlc_verifier(mesh: Mesh, g_per_shard: int, block: int,
+                         interpret: bool):
+    from jax import shard_map
+
+    from . import pallas_rlc as _pr
+
+    if interpret:
+        kern = _pr._jitted_rlc_verify(g_per_shard, block, interpret)
+    else:
+        kern = _pr._jitted_rlc_verify(
+            g_per_shard, block, interpret, vma=frozenset({AXIS})
+        )
+    m = _pr.M
+
+    def _step(a_t, r_t, scal_t, sok_t, power, live):
+        lane_valid = kern(a_t, r_t, scal_t, sok_t)[0].astype(bool)
+        sig_valid = jnp.repeat(lane_valid, m)  # fast-accept: lane -> sigs
+        ok = sig_valid & live
+        lanes = jnp.sum(jnp.where(ok[..., None], power, 0), axis=0)
+        lanes = jax.lax.psum(lanes, AXIS)
+        all_valid = (
+            jax.lax.psum(jnp.sum(jnp.where(live & ~sig_valid, 1, 0)), AXIS) == 0
+        )
+        return lane_valid, lanes, all_valid
+
+    fn = shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            P(None, AXIS), P(None, AXIS), P(None, AXIS), P(None, AXIS),
+            P(AXIS), P(AXIS),
+        ),
+        out_specs=(P(AXIS), P(), P()),
+        # same rationale as sharded_pallas_verifier above
+        check_vma=not interpret,
+    )
+    return jax.jit(fn)
+
+
+def verify_commit_sharded_rlc(
+    entries: List[Tuple[bytes, bytes, bytes]],
+    powers: List[int],
+    mesh: Mesh,
+) -> Tuple[np.ndarray, int, bool]:
+    """verify_commit_sharded on the FLAGSHIP (RLC fast-accept) kernel:
+    lanes shard across the mesh, accepted-lane voting power rides a psum,
+    rejected lanes fall back to host per-sig verification for blame (and
+    their valid signatures' power is added back on the host — identical
+    accept/tally semantics to the single-chip RLC path). The batch size
+    is derived from the mesh (per-shard lane count is pow2) — unlike the
+    siblings there is no bucket parameter to pin."""
+    from . import pallas_rlc as _pr
+
+    n = len(entries)
+    nd = int(np.prod(mesh.devices.shape))
+    m = _pr.M
+    lanes_needed = max((n + m - 1) // m, 1)
+    # per-shard lane count: pow2, >= 1, such that total lanes covers n
+    g_shard = 1
+    while g_shard * nd < lanes_needed:
+        g_shard *= 2
+    block = min(g_shard, 128)  # pow2 g_shard: block always divides
+    g = g_shard * nd
+    bucket = g * m
+
+    a_t, r_t, scal_t, sok_t = _pr.prepare_rlc(entries, bucket)
+    live = np.zeros((bucket,), dtype=bool)
+    live[:n] = True
+    pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
+    pw[:n] = split_power(np.asarray(powers[:n]))
+    interpret = jax.default_backend() != "tpu"
+    key = ("rlc", tuple(d.id for d in mesh.devices.flat), g_shard, block,
+           interpret)
+    if key not in _mesh_cache:
+        _mesh_cache[key] = sharded_rlc_verifier(mesh, g_shard, block, interpret)
+    lane_valid, lanes_pw, all_valid = _mesh_cache[key](
+        a_t, r_t, scal_t, sok_t, pw, live
+    )
+    lane_valid = np.asarray(lane_valid)
+    tallied = join_power(lanes_pw)
+    # lane verdicts -> per-sig verdicts + host re-verify of rejected
+    # lanes (shared with the single-chip path), then add the rescued
+    # signatures' power back into the device tally
+    per_sig = _pr.expand_lanes(lane_valid, entries)
+    rescued = per_sig & ~np.repeat(lane_valid, m)[:n]
+    tallied += sum(int(powers[i]) for i in np.nonzero(rescued)[0])
+    return per_sig, tallied, bool(per_sig.all()) if n else bool(all_valid)
