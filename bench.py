@@ -244,9 +244,18 @@ def worker() -> None:
     # transfer + kernel + result readback) through the production batch
     # path. On the relay-attached TPU this pays one full ~65ms round-trip
     # — the latency a lone VerifyCommit call experiences.
+    # Span-traced reps: the tracer records host-prep vs device spans so
+    # the JSON line carries a per-component breakdown (ISSUE 1 satellite —
+    # BENCH_r*.json trajectories get a host/device split, not just a
+    # single rate). Record overhead is ~µs on ~100ms ops.
+    from tendermint_tpu.observability import trace as _tr
+
+    _tr.TRACER.clear()
+    _tr.configure(enabled=True)
     reps = 5 if on_accel else 1
     rep_times = []
     rep_preps = []
+    pad_bucket = bucket
     for _ in range(reps):
         prep_t = 0.0
         t0 = time.perf_counter()
@@ -255,23 +264,30 @@ def worker() -> None:
             from tendermint_tpu.ops import pallas_rlc
 
             _b, _g, _blk = pallas_rlc.plan_bucket(n_sigs)
-            args = pallas_rlc.prepare_rlc(entries, _b)
+            pad_bucket = _b
+            with _tr.span("bench.host_prep", n=n_sigs, bucket=_b):
+                args = pallas_rlc.prepare_rlc(entries, _b)
             prep_t += time.perf_counter() - p0
-            lanes = pallas_rlc.verify_rlc_compact(
-                *args, block=_blk, interpret=not on_accel
-            )
+            with _tr.span("bench.device", bucket=_b):
+                lanes = pallas_rlc.verify_rlc_compact(
+                    *args, block=_blk, interpret=not on_accel
+                )
             assert bool(lanes.all())
         elif use_pallas:
             from tendermint_tpu.ops import pallas_verify
 
-            args = pallas_verify.prepare_compact(entries, bucket)
+            with _tr.span("bench.host_prep", n=n_sigs, bucket=bucket):
+                args = pallas_verify.prepare_compact(entries, bucket)
             prep_t += time.perf_counter() - p0
-            pallas_verify.verify_compact(*args, interpret=not on_accel)
+            with _tr.span("bench.device", bucket=bucket):
+                pallas_verify.verify_compact(*args, interpret=not on_accel)
         else:
-            args = backend.prepare_batch_device_hash(entries, bucket)
+            with _tr.span("bench.host_prep", n=n_sigs, bucket=bucket):
+                args = backend.prepare_batch_device_hash(entries, bucket)
             prep_t += time.perf_counter() - p0
             kern = backend.ed25519_verify.jitted_verify_device_hash()
-            _np.asarray(kern(*args))
+            with _tr.span("bench.device", bucket=bucket):
+                _np.asarray(kern(*args))
         rep_times.append(time.perf_counter() - t0)
         rep_preps.append(prep_t)
     # median rep: one relay hiccup (tens of ms on a ~100ms op) must not
@@ -281,6 +297,26 @@ def worker() -> None:
 
     single_s = statistics.median(rep_times) / n_sigs
     prep_med = statistics.median(rep_preps)
+
+    _span_stats = _tr.TRACER.summary()
+    _tr.configure(enabled=False)
+    span_summary = {
+        "host_prep_ms_p50": round(
+            _span_stats.get("bench.host_prep", {}).get("p50_ms", 0.0), 3
+        ),
+        "host_prep_ms_p95": round(
+            _span_stats.get("bench.host_prep", {}).get("p95_ms", 0.0), 3
+        ),
+        "device_ms_p50": round(
+            _span_stats.get("bench.device", {}).get("p50_ms", 0.0), 3
+        ),
+        "device_ms_p95": round(
+            _span_stats.get("bench.device", {}).get("p95_ms", 0.0), 3
+        ),
+        "pad_waste_ratio": round(
+            (pad_bucket - n_sigs) / pad_bucket if pad_bucket else 0.0, 4
+        ),
+    }
 
     def measure_rtt() -> float:
         """Relay round-trip: a trivial device computation fetched
@@ -419,6 +455,7 @@ def worker() -> None:
         "stream_attempts": attempts,
         "sustained_sigs_per_s": round(sus_rate, 1),
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
+        "span_summary": span_summary,
         "partial": True,
     }
     print(json.dumps(partial), flush=True)
@@ -469,6 +506,7 @@ def worker() -> None:
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
         "mixed_curve_sigs_per_s": round(mixed_rate, 1),
         "pipelined_headers_per_s": round(hdr_rate, 1),
+        "span_summary": span_summary,
     }
     print(json.dumps(out))
     print(

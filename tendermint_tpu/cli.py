@@ -131,6 +131,8 @@ def cmd_show_validator(args) -> int:
 
 def cmd_start(args) -> int:
     """start (run_node.go): run a node until interrupted."""
+    import signal
+
     from .node import make_node
     from .abci import KVStoreApplication
 
@@ -141,6 +143,15 @@ def cmd_start(args) -> int:
     node = make_node(cfg, app=app, with_rpc=True)
     node.start()
     print(f"node {node.node_id} started; RPC at {cfg.rpc.laddr}", flush=True)
+
+    # SIGTERM must take the same orderly path as ^C: node.stop() flushes
+    # the span-trace ring to a COMPLETE Chrome-trace file and shuts the
+    # metrics scrape endpoint down (OnStop hooks), instead of the default
+    # hard exit leaving a truncated dump.
+    def _terminate(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _terminate)
     try:
         while True:
             time.sleep(1)

@@ -175,6 +175,14 @@ class InstrumentationConfig:
     prometheus_listen_addr: str = ":26660"
     max_open_connections: int = 3
     namespace: str = "tendermint"
+    # Span tracing (observability.trace): off by default — the tracer's
+    # disabled path is a single attribute check on the hot path. When on,
+    # spans land in a fixed-size ring buffer served by the /dump_trace RPC
+    # and (if trace_dump_path is set, resolved under <home>) flushed as a
+    # Chrome-trace JSON file on node stop. TM_TPU_TRACE=1 also enables.
+    tracing: bool = False
+    trace_buffer_size: int = 16384
+    trace_dump_path: str = ""
 
 
 @dataclass
@@ -211,7 +219,10 @@ class Config:
 
     @classmethod
     def load(cls, path: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # Python < 3.11
+            import tomli as tomllib
 
         with open(path, "rb") as fh:
             data = tomllib.load(fh)

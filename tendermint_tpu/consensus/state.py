@@ -97,6 +97,7 @@ class ConsensusState(BaseService):
         event_bus=None,
         wal: Optional[WAL] = None,
         priv_validator=None,
+        metrics=None,  # libs.metrics.ConsensusMetrics (None = no-op)
     ):
         super().__init__("ConsensusState")
         self._cfg = config
@@ -106,6 +107,7 @@ class ConsensusState(BaseService):
         self._evpool = evpool
         self._event_bus = event_bus
         self._wal = wal
+        self._metrics = metrics
         self._priv_validator = priv_validator
         self._priv_validator_pub_key = (
             priv_validator.get_pub_key() if priv_validator else None
@@ -365,6 +367,8 @@ class ConsensusState(BaseService):
         rs.round = round_
         rs.step = STEP_NEW_ROUND
         rs.validators = validators
+        if self._metrics is not None:
+            self._metrics.rounds.set(round_)
         if round_ != 0:
             rs.proposal = None
             rs.proposal_block = None
@@ -649,6 +653,8 @@ class ConsensusState(BaseService):
         if self._wal is not None:
             self._wal.write_sync(WALMessage(end_height=height))
 
+        self._record_metrics(block, block_parts)
+
         state_copy = self._state.copy()
         new_state = self._block_exec.apply_block(state_copy, block_id, block)
 
@@ -656,6 +662,48 @@ class ConsensusState(BaseService):
         self._update_to_state(new_state)
         self._done_first_block.set()
         self._schedule_round_0()
+
+    def _record_metrics(self, block, block_parts) -> None:
+        """state.go:1702-1757 recordMetrics — called with the pre-apply
+        state still current, so last_block_time and last_validators refer
+        to the previous height (what the interval and the missing-set
+        accounting need)."""
+        m = self._metrics
+        if m is None:
+            return
+        try:
+            hdr = block.header
+            m.height.set(hdr.height)
+            n_txs = len(block.data.txs)
+            m.num_txs.set(n_txs)
+            m.total_txs.inc(n_txs)
+            # the part set already carries the wire size — no re-encode
+            m.block_size_bytes.set(block_parts.byte_size())
+            vals = self._state.validators
+            m.validators.set(vals.size())
+            m.validators_power.set(vals.total_voting_power())
+            m.byzantine_validators.set(len(block.evidence))
+            # the block's LastCommit is over the previous height's set
+            last_vals = self._state.last_validators
+            if block.last_commit is not None and last_vals is not None and \
+                    last_vals.size() == len(block.last_commit.signatures):
+                missing = 0
+                missing_power = 0
+                for i, cs in enumerate(block.last_commit.signatures):
+                    if cs.is_absent():
+                        missing += 1
+                        missing_power += last_vals.validators[i].voting_power
+                m.missing_validators.set(missing)
+                m.missing_validators_power.set(missing_power)
+            last_t = self._state.last_block_time
+            if self._state.last_block_height > 0 and last_t is not None:
+                dt = (hdr.time.seconds - last_t.seconds) + (
+                    hdr.time.nanos - last_t.nanos
+                ) / 1e9
+                if dt >= 0:
+                    m.block_interval_seconds.observe(dt)
+        except Exception:  # noqa: BLE001 — metrics must never break commit
+            pass
 
     # ------------------------------------------------------------------
     # proposals / parts / votes

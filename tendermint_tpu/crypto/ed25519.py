@@ -18,11 +18,23 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.exceptions import InvalidSignature
+try:  # OpenSSL fast path. With TM_TPU_PUREPY_CRYPTO=1 a container
+    # without the wheel runs the pure-Python _edwards implementation
+    # instead (identical bytes, ~3ms/op — far too slow for a validator,
+    # useful for airgapped tooling and tests); without the opt-in a
+    # missing wheel stays a hard import error rather than a silent
+    # 1000x slowdown.
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+
+    _HAVE_OPENSSL = True
+except ModuleNotFoundError:
+    if not os.environ.get("TM_TPU_PUREPY_CRYPTO"):
+        raise
+    _HAVE_OPENSSL = False
 
 from . import PrivKey as _PrivKey, PubKey as _PubKey, address_hash, register_key_type
 from . import _edwards
@@ -41,11 +53,12 @@ def verify_zip215_fast(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """ZIP-215 verify with OpenSSL fast path (see module docstring)."""
     if len(sig) != SIGNATURE_SIZE or len(pub) != PUB_KEY_SIZE:
         return False
-    try:
-        Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
-        return True
-    except (InvalidSignature, ValueError):
-        pass
+    if _HAVE_OPENSSL:
+        try:
+            Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            pass
     return _edwards.verify_zip215(pub, msg, sig)
 
 
@@ -77,10 +90,16 @@ class PrivKey(_PrivKey):
         if len(data) != PRIV_KEY_SIZE:
             raise ValueError(f"ed25519 privkey must be {PRIV_KEY_SIZE} bytes")
         self._bytes = bytes(data)
-        self._sk = Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+        self._sk = (
+            Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+            if _HAVE_OPENSSL
+            else None
+        )
 
     def sign(self, msg: bytes) -> bytes:
-        return self._sk.sign(msg)
+        if self._sk is not None:
+            return self._sk.sign(msg)
+        return _edwards.sign(self._bytes[:SEED_SIZE], msg)
 
     def pub_key(self) -> PubKey:
         return PubKey(self._bytes[SEED_SIZE:])
@@ -98,8 +117,11 @@ def gen_priv_key(seed: bytes | None = None) -> PrivKey:
         seed = os.urandom(SEED_SIZE)
     if len(seed) != SEED_SIZE:
         raise ValueError(f"seed must be {SEED_SIZE} bytes")
-    sk = Ed25519PrivateKey.from_private_bytes(seed)
-    pub = sk.public_key().public_bytes_raw()
+    if _HAVE_OPENSSL:
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        pub = sk.public_key().public_bytes_raw()
+    else:
+        pub = _edwards.pubkey_from_seed(seed)
     return PrivKey(seed + pub)
 
 

@@ -16,16 +16,32 @@ from __future__ import annotations
 import hashlib
 import os
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
+try:  # OpenSSL-backed. Under TM_TPU_PUREPY_CRYPTO=1 (see crypto/ed25519)
+    # the module still imports without the wheel (key registration, sizes,
+    # address math) and only the ECDSA ops raise at use.
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+
+    _HAVE_OPENSSL = True
+except ModuleNotFoundError:
+    if not os.environ.get("TM_TPU_PUREPY_CRYPTO"):
+        raise
+    _HAVE_OPENSSL = False
 
 from . import PrivKey as _PrivKey, PubKey as _PubKey, register_key_type
+
+
+def _require_openssl() -> None:
+    if not _HAVE_OPENSSL:
+        raise RuntimeError(
+            "secp256k1 ECDSA requires the `cryptography` OpenSSL wheel"
+        )
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33
@@ -37,7 +53,7 @@ PRIV_KEY_NAME = "tendermint/PrivKeySecp256k1"
 
 # Curve order of secp256k1.
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
-_CURVE = ec.SECP256K1()
+_CURVE = ec.SECP256K1() if _HAVE_OPENSSL else None
 
 
 class PubKey(_PubKey):
@@ -64,6 +80,7 @@ class PubKey(_PubKey):
             return False
         if s > _N // 2:  # reject non-lower-S (nocgo:35,41-44)
             return False
+        _require_openssl()
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self._bytes)
             pub.verify(
@@ -89,6 +106,7 @@ class PrivKey(_PrivKey):
         d = int.from_bytes(data, "big")
         if not (0 < d < _N):
             raise ValueError("invalid secp256k1 scalar")
+        _require_openssl()
         self._sk = ec.derive_private_key(d, _CURVE)
 
     def sign(self, msg: bytes) -> bytes:

@@ -4,13 +4,30 @@ Reference parity: the go-kit metric sets wired in node/setup.go
 defaultMetricsProvider (internal/consensus/metrics.go:8+, p2p/mempool/
 state/proxy metric sets) and the Prometheus scrape endpoint from the
 instrumentation config. Text exposition format, stdlib HTTP server.
+
+Beyond the reference: `OpsMetrics` — the device verification engine's
+metric set (sigs verified, batches by bucket, pad waste, host-prep vs
+device-seconds histograms) — lives on a process-wide registry
+(`global_registry()`), because the device engine is shared by every node
+in the process; a node's MetricsServer serves both its own registry and
+the global one.
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label value escaping: backslash, quote, LF."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(pairs: Tuple[Tuple[str, str], ...]) -> str:
+    """('a','1'),('b','x') -> 'a="1",b="x"' (values escaped)."""
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
 
 
 class _Metric:
@@ -22,18 +39,33 @@ class _Metric:
         self._mtx = threading.Lock()
 
     def _key(self, labels: Dict[str, str]) -> Tuple:
-        return tuple(sorted(labels.items()))
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
         with self._mtx:
-            for key, val in self._values.items():
+            for key in sorted(self._values):
+                val = self._values[key]
                 if key:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
-                    out.append(f"{self.name}{{{lbl}}} {val}")
+                    out.append(f"{self.name}{{{_fmt_labels(key)}}} {val}")
                 else:
                     out.append(f"{self.name} {val}")
         return out
+
+    # -- introspection (for /status verify-engine stats & tests) --------
+
+    def value(self, **labels) -> float:
+        with self._mtx:
+            return self._values.get(self._key(labels), 0.0)
+
+    def total(self) -> float:
+        """Sum over every labelset (e.g. counter total across labels)."""
+        with self._mtx:
+            return sum(self._values.values())
+
+    def by_label(self) -> Dict[Tuple, float]:
+        with self._mtx:
+            return dict(self._values)
 
 
 class Counter(_Metric):
@@ -61,43 +93,96 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
-    """Prometheus histogram with fixed buckets."""
+    """Prometheus histogram with fixed buckets and label support.
 
-    def __init__(self, name: str, help_: str = "", buckets=None):
+    Each labelset gets its own (counts, sum, total) series; exposition
+    merges the series labels with the cumulative `le` label per bucket
+    line and always ends with the `+Inf` bucket equal to `_count` — the
+    cumulative-bucket invariant scrapers check. The unlabeled series is
+    pre-created so an unobserved histogram still exposes zeroed lines
+    (go-kit/prometheus client behavior).
+    """
+
+    def __init__(self, name: str, help_: str = "", buckets=None,
+                 labeled: bool = False):
         super().__init__(name, help_, "histogram")
-        self.buckets = buckets or [0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10]
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._total = 0
+        self.buckets = list(buckets or [0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10])
+        # labelset key -> [counts list (len(buckets)+1), sum, total]
+        self._series: Dict[Tuple, list] = {}
+        if not labeled:
+            self._series[()] = [[0] * (len(self.buckets) + 1), 0.0, 0]
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
         with self._mtx:
-            self._sum += value
-            self._total += 1
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            s[1] += value
+            s[2] += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    s[0][i] += 1
                     return
-            self._counts[-1] += 1
+            s[0][-1] += 1
+
+    @staticmethod
+    def _fmt_le(b) -> str:
+        return str(b)
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._mtx:
-            cumulative = 0
-            for i, b in enumerate(self.buckets):
-                cumulative += self._counts[i]
-                out.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
-            cumulative += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-            out.append(f"{self.name}_sum {self._sum}")
-            out.append(f"{self.name}_count {self._total}")
+            for key in sorted(self._series):
+                counts, sum_, total = self._series[key]
+                base = _fmt_labels(key)
+                cumulative = 0
+                for i, b in enumerate(self.buckets):
+                    cumulative += counts[i]
+                    lbl = (base + "," if base else "") + f'le="{self._fmt_le(b)}"'
+                    out.append(f"{self.name}_bucket{{{lbl}}} {cumulative}")
+                cumulative += counts[-1]
+                lbl = (base + "," if base else "") + 'le="+Inf"'
+                out.append(f"{self.name}_bucket{{{lbl}}} {cumulative}")
+                suffix = f"{{{base}}}" if base else ""
+                out.append(f"{self.name}_sum{suffix} {sum_}")
+                out.append(f"{self.name}_count{suffix} {total}")
         return out
+
+    # -- introspection --------------------------------------------------
+    # _Metric.value()/by_label() read _values, which a histogram never
+    # writes — override them onto _series so the Counter/Gauge-shaped API
+    # returns observation counts instead of silent zeros.
+
+    def value(self, **labels) -> float:
+        """Observation count for the labelset (use snapshot() for sums)."""
+        with self._mtx:
+            s = self._series.get(self._key(labels))
+            return float(s[2]) if s else 0.0
+
+    def by_label(self) -> Dict[Tuple, float]:
+        with self._mtx:
+            return {k: float(s[2]) for k, s in self._series.items()}
+
+    def snapshot(self) -> Dict[Tuple, Tuple[float, int]]:
+        """labelset -> (sum, count)."""
+        with self._mtx:
+            return {k: (s[1], s[2]) for k, s in self._series.items()}
+
+    def total(self) -> float:
+        with self._mtx:
+            return sum(s[2] for s in self._series.values())
+
+    def sum_all(self) -> float:
+        with self._mtx:
+            return sum(s[1] for s in self._series.values())
 
 
 class Registry:
     def __init__(self, namespace: str = "tendermint"):
         self.namespace = namespace
         self._metrics: List[_Metric] = []
+        self._collect_hooks: List[Callable[[], None]] = []
         self._mtx = threading.Lock()
 
     def counter(self, subsystem: str, name: str, help_: str = "") -> Counter:
@@ -112,17 +197,33 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def histogram(self, subsystem: str, name: str, help_: str = "", buckets=None) -> Histogram:
-        m = Histogram(f"{self.namespace}_{subsystem}_{name}", help_, buckets)
+    def histogram(self, subsystem: str, name: str, help_: str = "",
+                  buckets=None, labeled: bool = False) -> Histogram:
+        m = Histogram(f"{self.namespace}_{subsystem}_{name}", help_, buckets,
+                      labeled=labeled)
         with self._mtx:
             self._metrics.append(m)
         return m
 
+    def add_collect_hook(self, fn: Callable[[], None]) -> None:
+        """Run `fn` at the top of every expose() — for pull-style gauges
+        (mempool size, connected peers, pipeline queue depth) that are
+        cheaper to sample at scrape time than to push on every change."""
+        with self._mtx:
+            self._collect_hooks.append(fn)
+
     def expose(self) -> str:
         with self._mtx:
-            lines: List[str] = []
-            for m in self._metrics:
-                lines.extend(m.expose())
+            hooks = list(self._collect_hooks)
+            metrics = list(self._metrics)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a scrape must never 500
+                pass
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
 
@@ -139,6 +240,10 @@ class ConsensusMetrics:
         self.missing_validators = registry.gauge(
             "consensus", "missing_validators", "Validators missing from the last commit."
         )
+        self.missing_validators_power = registry.gauge(
+            "consensus", "missing_validators_power",
+            "Voting power of the missing validators.",
+        )
         self.byzantine_validators = registry.gauge(
             "consensus", "byzantine_validators", "Validators that equivocated."
         )
@@ -152,21 +257,170 @@ class ConsensusMetrics:
         )
 
 
-class MetricsServer:
-    """The instrumentation scrape endpoint (config.instrumentation)."""
+class MempoolMetrics:
+    """internal/mempool/metrics.go — the mempool metric set. size/
+    size_bytes are sampled by a registry collect hook at scrape time; the
+    rest are pushed from TxMempool when a metrics set is attached."""
 
-    def __init__(self, registry: Registry, laddr: str):
+    def __init__(self, registry: Registry):
+        self.size = registry.gauge("mempool", "size", "Number of uncommitted txs.")
+        self.size_bytes = registry.gauge(
+            "mempool", "size_bytes", "Total byte size of uncommitted txs."
+        )
+        self.tx_size_bytes = registry.histogram(
+            "mempool", "tx_size_bytes", "Tx sizes in bytes.",
+            buckets=[32, 128, 512, 2048, 8192, 32768, 131072, 1048576],
+        )
+        self.failed_txs = registry.counter(
+            "mempool", "failed_txs", "Txs that failed CheckTx."
+        )
+        self.evicted_txs = registry.counter(
+            "mempool", "evicted_txs", "Txs evicted to make room for higher priority."
+        )
+        self.recheck_times = registry.counter(
+            "mempool", "recheck_times", "Txs rechecked after a block commit."
+        )
+
+
+class P2PMetrics:
+    """p2p/metrics.go — the router metric set. peers is sampled by a
+    registry collect hook at scrape time."""
+
+    def __init__(self, registry: Registry):
+        self.peers = registry.gauge("p2p", "peers", "Connected peers.")
+        self.peer_receive_bytes_total = registry.counter(
+            "p2p", "peer_receive_bytes_total", "Bytes received from peers."
+        )
+        self.peer_send_bytes_total = registry.counter(
+            "p2p", "peer_send_bytes_total", "Bytes sent to peers."
+        )
+
+
+class OpsMetrics:
+    """The device verification engine's metric set (ops/backend.py +
+    ops/pipeline.py). Batch-labeled series carry a `bucket` label — the
+    padded device batch size the batch compiled/dispatched as."""
+
+    # seconds-scale buckets tuned to the measured path: host prep is
+    # ~1-50 ms/batch, device batches ~10-300 ms through the relay
+    _TIME_BUCKETS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5]
+
+    def __init__(self, registry: Registry):
+        self.sigs_verified = registry.counter(
+            "ops", "sigs_verified_total",
+            "Signatures verified, by path label (device|host).",
+        )
+        self.batches = registry.counter(
+            "ops", "batches_total", "Device batches dispatched, by bucket label."
+        )
+        self.padded_lanes = registry.counter(
+            "ops", "padded_lanes_total",
+            "Padding lanes dispatched (bucket size minus live signatures).",
+        )
+        self.pad_waste_ratio = registry.gauge(
+            "ops", "pad_waste_ratio", "Pad fraction of the last device batch."
+        )
+        self.host_prep_seconds = registry.histogram(
+            "ops", "host_prep_seconds",
+            "Host-side batch prep (pack/hash/limb) seconds, by bucket label.",
+            buckets=self._TIME_BUCKETS, labeled=True,
+        )
+        self.device_seconds = registry.histogram(
+            "ops", "device_seconds",
+            "Dispatch-to-materialized device seconds, by bucket label.",
+            buckets=self._TIME_BUCKETS, labeled=True,
+        )
+        self.host_fallback = registry.counter(
+            "ops", "host_fallback_total",
+            "Batches below DEVICE_THRESHOLD verified on the host path.",
+        )
+        self.pipeline_queue_depth = registry.gauge(
+            "ops", "pipeline_queue_depth", "Jobs waiting in the async verifier queue."
+        )
+        self.pipeline_inflight = registry.gauge(
+            "ops", "pipeline_inflight", "Device batches in flight (dispatched, not resolved)."
+        )
+        self.pipeline_coalesced_jobs = registry.histogram(
+            "ops", "pipeline_coalesced_jobs",
+            "Jobs fused into one device batch by the coalescing worker.",
+            buckets=[1, 2, 4, 8, 16, 32, 64],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Process-wide registry: the device engine is shared by every node in the
+# process, so its metrics live here; node MetricsServers serve this
+# registry alongside their own.
+# ---------------------------------------------------------------------------
+
+# RLock: ops_metrics() calls global_registry() while holding it
+_global_mtx = threading.RLock()
+_global_registry: Optional[Registry] = None
+_global_ops: Optional[OpsMetrics] = None
+
+
+def global_registry() -> Registry:
+    global _global_registry
+    with _global_mtx:
+        if _global_registry is None:
+            _global_registry = Registry("tendermint")
+        return _global_registry
+
+
+def ops_metrics() -> OpsMetrics:
+    global _global_ops
+    with _global_mtx:
+        if _global_ops is None:
+            _global_ops = OpsMetrics(global_registry())
+        return _global_ops
+
+
+def ops_stats() -> dict:
+    """Verify-engine snapshot for /status — no jax import, cheap reads."""
+    m = ops_metrics()
+    sigs_device = m.sigs_verified.value(path="device")
+    sigs_host = m.sigs_verified.value(path="host")
+    padded = m.padded_lanes.total()
+    dispatched = sigs_device + padded
+    prep_sum = m.host_prep_seconds.sum_all()
+    prep_n = m.host_prep_seconds.total()
+    dev_sum = m.device_seconds.sum_all()
+    dev_n = m.device_seconds.total()
+    return {
+        "sigs_verified_device": int(sigs_device),
+        "sigs_verified_host": int(sigs_host),
+        "batches_by_bucket": {
+            (dict(k).get("bucket", "") or "unbucketed"): int(v)
+            for k, v in m.batches.by_label().items()
+        },
+        "pad_waste_ratio": (padded / dispatched) if dispatched else 0.0,
+        "host_fallback_batches": int(m.host_fallback.total()),
+        "host_prep_seconds_avg": (prep_sum / prep_n) if prep_n else 0.0,
+        "device_seconds_avg": (dev_sum / dev_n) if dev_n else 0.0,
+        "pipeline_queue_depth": int(m.pipeline_queue_depth.value()),
+        "pipeline_inflight": int(m.pipeline_inflight.value()),
+    }
+
+
+class MetricsServer:
+    """The instrumentation scrape endpoint (config.instrumentation).
+
+    Accepts one registry or a list of registries (a node serves its own
+    consensus/mempool/p2p registry plus the process-wide ops registry).
+    """
+
+    def __init__(self, registry, laddr: str):
+        regs = list(registry) if isinstance(registry, (list, tuple)) else [registry]
         addr = laddr.replace("tcp://", "")
         host, _, port = addr.rpartition(":")
-
-        reg = registry
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # noqa: A003
                 pass
 
             def do_GET(self):  # noqa: N802
-                body = reg.expose().encode()
+                body = "".join(r.expose() for r in regs).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))

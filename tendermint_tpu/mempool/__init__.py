@@ -93,6 +93,9 @@ class TxMempool:
         self._pre_check: Optional[Callable] = None
         self._post_check: Optional[Callable] = None
         self._notify_available: Optional[Callable] = None
+        # libs.metrics.MempoolMetrics, attached by node setup when the
+        # instrumentation config enables prometheus (None = no-op)
+        self.metrics = None
 
     # -- config hooks ---------------------------------------------------
 
@@ -148,6 +151,8 @@ class TxMempool:
                         self._remove_tx(v.key, compact=False)
                         self._cache.remove(v.tx)
                     self._compact_fifo()
+                    if self.metrics is not None:
+                        self.metrics.evicted_txs.inc(len(victims))
                 was_empty = not self._tx_by_key
                 wtx = _WrappedTx(
                     sort_key=(-res.priority, next(self._seq)),
@@ -164,7 +169,11 @@ class TxMempool:
                 self._size_bytes += len(tx)
             if was_empty and self._notify_available is not None:
                 self._notify_available()
+            if self.metrics is not None:
+                self.metrics.tx_size_bytes.observe(len(tx))
         else:
+            if self.metrics is not None:
+                self.metrics.failed_txs.inc()
             if not self._cfg.keep_invalid_txs_in_cache:
                 self._cache.remove(tx)
         if callback is not None:
@@ -293,6 +302,8 @@ class TxMempool:
 
     def _recheck_txs(self) -> None:
         """mempool.go:580-620: re-CheckTx all remaining txs."""
+        if self.metrics is not None:
+            self.metrics.recheck_times.inc(len(self._tx_by_key))
         for wtx in list(self._tx_by_key.values()):
             res = self._proxy.check_tx(
                 abci.RequestCheckTx(tx=wtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)

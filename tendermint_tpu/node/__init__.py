@@ -65,6 +65,8 @@ class Node:
     proxy_app: object = None
     indexer_service: object = None
     tx_index_sink: object = None
+    metrics_server: object = None       # libs.metrics.MetricsServer
+    metrics_registry: object = None     # this node's Registry
     _started: bool = False
     _stopping: threading.Event = field(default_factory=threading.Event)
     # serializes startup-mode handoffs against stop(): a handoff holds it
@@ -76,6 +78,8 @@ class Node:
     def start(self) -> None:
         """OnStart (node.go:490-560) + startup-mode selection
         (node.go:217-247,323-343): statesync -> blocksync -> consensus."""
+        if self.metrics_server is not None:
+            self.metrics_server.start()
         if self.indexer_service is not None:
             self.indexer_service.start()
         if self.router is not None:
@@ -243,6 +247,26 @@ class Node:
             self.router.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        self._flush_trace()
+
+    def _flush_trace(self) -> None:
+        """OnStop trace flush: leave a COMPLETE Chrome-trace file on
+        shutdown (SIGTERM included — cli start routes SIGTERM here)."""
+        from ..observability import trace as _trace
+
+        if not _trace.TRACER.enabled:
+            return
+        path = self.config.instrumentation.trace_dump_path
+        if not path:
+            return
+        if not os.path.isabs(path) and self.config.base.home:
+            path = os.path.join(self.config.base.home, path)
+        try:
+            _trace.TRACER.dump(path)
+        except OSError as e:
+            print(f"trace flush to {path} failed: {e}", flush=True)
 
     @property
     def node_id(self) -> str:
@@ -335,6 +359,30 @@ def make_node(
             config.priv_validator.state_path(home),
         )
 
+    # instrumentation (node.go:377 createAndStartPrometheusServer + the
+    # defaultMetricsProvider wiring in setup.go): per-node registry for
+    # consensus/mempool/p2p sets; the process-wide ops registry (device
+    # verify engine) is served alongside it.
+    registry = None
+    cons_metrics = None
+    mp_metrics = None
+    p2p_metrics = None
+    if config.instrumentation.prometheus:
+        from ..libs import metrics as _metrics
+
+        registry = _metrics.Registry(config.instrumentation.namespace)
+        cons_metrics = _metrics.ConsensusMetrics(registry)
+        mp_metrics = _metrics.MempoolMetrics(registry)
+        p2p_metrics = _metrics.P2PMetrics(registry)
+        mempool.metrics = mp_metrics
+        _metrics.ops_metrics()  # eager: ops families expose before traffic
+    if config.instrumentation.tracing:
+        from ..observability import trace as _trace
+
+        _trace.configure(
+            enabled=True, capacity=config.instrumentation.trace_buffer_size
+        )
+
     wal = None
     if home:
         import os as _os
@@ -360,6 +408,7 @@ def make_node(
         event_bus=event_bus,
         wal=wal,
         priv_validator=priv_validator,
+        metrics=cons_metrics,
     )
 
     # p2p (node.go createTransport/createPeerManager/createRouter)
@@ -459,6 +508,23 @@ def make_node(
     node.statesync_reactor = statesync_reactor
     node.indexer_service = indexer_service
     node.tx_index_sink = tx_index_sink
+    if registry is not None:
+        from ..libs import metrics as _metrics
+
+        def _collect() -> None:
+            # pull-style gauges sampled at scrape time
+            mp_metrics.size.set(mempool.size())
+            mp_metrics.size_bytes.set(mempool.size_bytes())
+            p2p_metrics.peers.set(
+                len(node.router.connected()) if node.router else 0
+            )
+
+        registry.add_collect_hook(_collect)
+        node.metrics_registry = registry
+        node.metrics_server = _metrics.MetricsServer(
+            [registry, _metrics.global_registry()],
+            config.instrumentation.prometheus_listen_addr,
+        )
     if with_rpc and config.rpc.laddr:
         from ..rpc.server import RPCServer
         from ..rpc.core import Environment

@@ -1,0 +1,16 @@
+"""Observability — hot-path span tracing and live introspection.
+
+The commit-verify path (VoteSet.add_vote → VerifyCommit → the bucketed
+device batch verifier) is the north-star workload; this package makes its
+wall-clock visible: `trace` provides a low-overhead span tracer with a
+thread-safe ring buffer and Chrome-trace (Perfetto) export, and
+`libs.metrics` (re-exported here for convenience) carries the Prometheus
+metric sets the node serves on the instrumentation scrape endpoint.
+
+Tracing is off by default and costs ~nothing when off: every instrument
+site guards on `trace.TRACER.enabled` (a plain attribute read) before any
+clock read, dict build, or string work happens.
+"""
+
+from . import trace  # noqa: F401
+from .trace import TRACER, configure, span  # noqa: F401

@@ -1,0 +1,246 @@
+"""Low-overhead span tracer for the commit-verify hot path.
+
+Design constraints (ISSUE 1):
+
+- **~zero cost when disabled.** `span()` checks one module-global's
+  `enabled` attribute and returns a shared null context manager before any
+  clock read happens; no strings are formatted, no dicts are stored.
+- **Thread-safe ring buffer.** Records are fixed-size tuples written under
+  a lock into a preallocated ring; the buffer never grows, old spans are
+  overwritten (wraparound), and recording is O(1) per span. Spans are
+  recorded per *batch* (host prep, device dispatch, device wait), never
+  per signature, so the lock is uncontended in practice.
+- **Nested spans.** Nesting falls out of the `with` discipline: a child's
+  [start, end) interval is contained in its parent's on the same thread,
+  which is exactly how Chrome-trace/Perfetto reconstruct the flame graph
+  from "X" (complete) events sharing a tid.
+- **Chrome-trace export.** `export_chrome()` emits the Trace Event Format
+  JSON (`{"traceEvents": [...]}`) loadable in chrome://tracing or
+  https://ui.perfetto.dev; `dump(path)` writes it to disk (the node's
+  OnStop flushes through this so a SIGTERM run leaves a complete file).
+
+Enable via config (`[instrumentation] tracing = true`), env
+(`TM_TPU_TRACE=1`), or `configure(enabled=True)`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_PID = os.getpid()
+
+# A record is (name, start_s, end_s, tid, args_or_None); start/end are
+# time.perf_counter() readings against the tracer's epoch.
+_Record = Tuple[str, float, float, int, Optional[dict]]
+
+
+class SpanTracer:
+    """Ring-buffered span recorder. One process-wide instance (TRACER)."""
+
+    def __init__(self, capacity: int = 16384):
+        self.enabled = False
+        self._cap = max(int(capacity), 16)
+        self._buf: List[Optional[_Record]] = [None] * self._cap
+        self._n = 0  # monotonic write index; wraps over _cap
+        self._mtx = threading.Lock()
+        self._epoch = time.perf_counter()
+
+    # -- recording -----------------------------------------------------
+
+    def record(self, name: str, start: float, end: float,
+               args: Optional[dict] = None) -> None:
+        """Record one completed span (perf_counter start/end)."""
+        rec = (name, start, end, threading.get_ident(), args)
+        with self._mtx:
+            self._buf[self._n % self._cap] = rec
+            self._n += 1
+
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None) -> None:
+        with self._mtx:
+            if capacity is not None and int(capacity) != self._cap:
+                self._cap = max(int(capacity), 16)
+                self._buf = [None] * self._cap
+                self._n = 0
+        if enabled is not None:
+            self.enabled = bool(enabled)
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._buf = [None] * self._cap
+            self._n = 0
+
+    # -- reading -------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def recorded_total(self) -> int:
+        """Total spans ever recorded (>= len(events()) after wraparound)."""
+        return self._n
+
+    def events(self) -> List[_Record]:
+        """Retained records, oldest first."""
+        with self._mtx:
+            if self._n <= self._cap:
+                return [r for r in self._buf[: self._n] if r is not None]
+            head = self._n % self._cap
+            return [r for r in self._buf[head:] + self._buf[:head]
+                    if r is not None]
+
+    def export_chrome(self) -> dict:
+        """Trace Event Format dict (chrome://tracing / Perfetto JSON)."""
+        evs = []
+        epoch = self._epoch
+        for name, start, end, tid, args in self.events():
+            ev = {
+                "name": name,
+                "cat": "tendermint_tpu",
+                "ph": "X",
+                "pid": _PID,
+                "tid": tid,
+                "ts": (start - epoch) * 1e6,   # microseconds
+                "dur": (end - start) * 1e6,
+            }
+            if args:
+                ev["args"] = args
+            evs.append(ev)
+        evs.sort(key=lambda e: e["ts"])
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> str:
+        """Write the Chrome-trace JSON to `path` (returns the path)."""
+        doc = self.export_chrome()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)  # atomic: a SIGTERM mid-dump never leaves
+        return path            # a truncated file at the advertised path
+
+    def summary(self) -> Dict[str, dict]:
+        return summarize_events(self.export_chrome())
+
+
+class _Span:
+    """Active span: records on exit. Only built when tracing is enabled."""
+
+    __slots__ = ("_name", "_args", "_t0")
+
+    def __init__(self, name: str, args: Optional[dict]):
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        TRACER.record(self._name, self._t0, time.perf_counter(), self._args)
+        return False
+
+
+class _NullSpan:
+    """Disabled-path context manager: shared, allocation-free."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+TRACER = SpanTracer(int(os.environ.get("TM_TPU_TRACE_BUFFER", "16384")))
+if os.environ.get("TM_TPU_TRACE", "0") not in ("", "0"):
+    TRACER.enabled = True
+
+
+def span(name: str, **args) -> object:
+    """Context manager recording `name` with optional args.
+
+    The disabled path returns a shared null object after a single attribute
+    check — hot-path call sites need no `if` of their own (though sites
+    that build expensive kwargs should still guard on `TRACER.enabled`).
+    """
+    if not TRACER.enabled:
+        return _NULL_SPAN
+    return _Span(name, args or None)
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None) -> None:
+    TRACER.configure(enabled=enabled, capacity=capacity)
+
+
+# ---------------------------------------------------------------------------
+# Summaries (shared by tools/trace_report.py, bench.py, and /dump_trace)
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = (len(sorted_vals) - 1) * q
+    lo = int(k)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = k - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def summarize_events(trace_doc: dict) -> Dict[str, dict]:
+    """Per-span-name stats over a Chrome-trace dict: count, total/p50/p95/
+    p99 ms. The `_wall` pseudo-entry carries the trace's wall-clock extent
+    and `device_utilization` (fraction of wall covered by spans whose name
+    contains "device", merged across overlaps)."""
+    evs = trace_doc.get("traceEvents", [])
+    by_name: Dict[str, List[float]] = {}
+    t_min, t_max = float("inf"), float("-inf")
+    device_iv: List[Tuple[float, float]] = []
+    for ev in evs:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur", 0.0))
+        ts = float(ev.get("ts", 0.0))
+        by_name.setdefault(ev["name"], []).append(dur)
+        t_min = min(t_min, ts)
+        t_max = max(t_max, ts + dur)
+        if "device" in ev["name"]:
+            device_iv.append((ts, ts + dur))
+    out: Dict[str, dict] = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "total_ms": sum(durs) / 1e3,
+            "p50_ms": _percentile(durs, 0.50) / 1e3,
+            "p95_ms": _percentile(durs, 0.95) / 1e3,
+            "p99_ms": _percentile(durs, 0.99) / 1e3,
+        }
+    wall_us = (t_max - t_min) if evs and t_max > t_min else 0.0
+    # merge overlapping device intervals so concurrent dispatches do not
+    # count double against the wall clock
+    device_us = 0.0
+    last_e = float("-inf")
+    for s, e in sorted(device_iv):
+        if s < last_e:
+            if e > last_e:
+                device_us += e - last_e
+                last_e = e
+        else:
+            device_us += e - s
+            last_e = e
+    out["_wall"] = {
+        "wall_ms": wall_us / 1e3,
+        "device_utilization": (device_us / wall_us) if wall_us else 0.0,
+        "events": len(evs),
+    }
+    return out

@@ -27,9 +27,12 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import trace as _trace
 from ..types.validation import ErrNotEnoughVotingPowerSigned
 from . import backend as _backend
 from . import ed25519_verify as _kernel
+
+_span = _trace.span
 
 
 class _Job:
@@ -60,6 +63,7 @@ class AsyncBatchVerifier:
             raise RuntimeError("verifier is closed")
         job = _Job(list(entries))
         self._q.put(job)
+        _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
 
     def close(self) -> None:
@@ -73,10 +77,12 @@ class AsyncBatchVerifier:
         """Host prep only (runs on the prep pool — CPU-heavy, largely
         GIL-releasing: native SHA-512 challenges, numpy packing).
 
-        Returns (kernel_fn, args, rlc_entries): rlc_entries is None for
-        the per-signature kernels; for the RLC fast-accept kernel it is
-        the entry list _resolve needs to expand lane verdicts to per-sig
-        verdicts (and re-verify rejected lanes for blame)."""
+        Returns (kernel_fn, args, rlc_entries, bucket): rlc_entries is
+        None for the per-signature kernels; for the RLC fast-accept kernel
+        it is the entry list _resolve needs to expand lane verdicts to
+        per-sig verdicts (and re-verify rejected lanes for blame). bucket
+        is the padded device batch size (signature lanes) for metric
+        labels."""
         if _backend._use_pallas():
             import jax
 
@@ -87,35 +93,60 @@ class AsyncBatchVerifier:
                 from . import pallas_rlc
 
                 bucket, g, block = pallas_rlc.plan_bucket(len(entries))
-                args = pallas_rlc.prepare_rlc(entries, bucket)
+                t0 = time.perf_counter()
+                with _span("pipeline.prep", n=len(entries), bucket=bucket):
+                    args = pallas_rlc.prepare_rlc(entries, bucket)
+                _backend._note_device_batch(
+                    len(entries), bucket, prep_s=time.perf_counter() - t0
+                )
                 f = pallas_rlc._jitted_rlc_verify(g, block, interpret)
-                return f, args, list(entries)
+                return f, args, list(entries), bucket
             bucket = _backend._pallas_bucket(len(entries))
-            args = pallas_verify.prepare_compact(entries, bucket)
+            t0 = time.perf_counter()
+            with _span("pipeline.prep", n=len(entries), bucket=bucket):
+                args = pallas_verify.prepare_compact(entries, bucket)
+            _backend._note_device_batch(
+                len(entries), bucket, prep_s=time.perf_counter() - t0
+            )
             f = pallas_verify._jitted_pallas_verify(
                 bucket, min(pallas_verify.BLOCK, bucket), interpret
             )
-            return f, args, None
+            return f, args, None, bucket
         device_hash = not _backend.HOST_HASH and all(
             len(m) <= _backend.DEVICE_HASH_MAX_MSG for _, m, _ in entries
         )
         bucket = _backend._bucket_for(len(entries))
-        if device_hash:
-            args = _backend.prepare_batch_device_hash(entries, bucket)
-            return _kernel.jitted_verify_device_hash(), args, None
-        args = _backend.prepare_batch(entries, bucket)
-        return _kernel.jitted_verify(), args, None
+        # prep timing histograms are recorded inside prepare_batch*;
+        # only the dispatch counters are noted here
+        with _span("pipeline.prep", n=len(entries), bucket=bucket):
+            if device_hash:
+                args = _backend.prepare_batch_device_hash(entries, bucket)
+                kern = _kernel.jitted_verify_device_hash()
+            else:
+                args = _backend.prepare_batch(entries, bucket)
+                kern = _kernel.jitted_verify()
+        _backend._note_device_batch(len(entries), bucket)
+        return kern, args, None, bucket
 
     def _dispatch(self, entries):
         """Synchronous prep + async device dispatch (kept for callers and
         tests that bypass the worker's prep pool)."""
-        f, args, rlc_entries = self._prepare(entries)
+        f, args, rlc_entries, _bucket = self._prepare(entries)
         return f(*args), rlc_entries
 
     @staticmethod
-    def _resolve(spans, dev, rlc_entries=None) -> None:
+    def _resolve(spans, dev, rlc_entries=None, t_dispatch: float = 0.0,
+                 bucket: int = 0) -> None:
         try:
-            arr = np.asarray(dev)
+            with _span("pipeline.device_wait"):
+                arr = np.asarray(dev)
+            if t_dispatch:
+                # dispatch-to-materialized: the device+transfer time this
+                # batch actually cost the pipeline
+                _backend._ops_m().device_seconds.observe(
+                    time.perf_counter() - t_dispatch,
+                    bucket=str(bucket or arr.shape[-1]),
+                )
             if arr.ndim == 2:  # pallas output is (1, N) / (1, lanes)
                 arr = arr[0].astype(bool)
             if rlc_entries is not None:
@@ -209,6 +240,7 @@ class AsyncBatchVerifier:
                         hold = jobs.pop()
                         total -= len(hold.entries)
                 if jobs:
+                    _backend._ops_m().pipeline_coalesced_jobs.observe(len(jobs))
                     if total > max_b:
                         # single oversized job: chunked synchronous fallback
                         for j in jobs:
@@ -235,8 +267,9 @@ class AsyncBatchVerifier:
                 ):
                     spans, fut = preps.popleft()
                     try:
-                        f, args, rlc_entries = fut.result()
-                        dev = f(*args)
+                        f, args, rlc_entries, bucket = fut.result()
+                        with _span("pipeline.dispatch", bucket=bucket):
+                            dev = f(*args)
                         # start the device->host copy NOW: a blocking fetch
                         # through the relay costs a full ~65ms RTT, but an
                         # async copy rides behind the compute, so the later
@@ -246,7 +279,10 @@ class AsyncBatchVerifier:
                             dev.copy_to_host_async()
                         except AttributeError:
                             pass
-                        pending.append((spans, dev, rlc_entries))
+                        pending.append(
+                            (spans, dev, rlc_entries, time.perf_counter(),
+                             bucket)
+                        )
                     except Exception as e:  # noqa: BLE001
                         for j, _, _ in spans:
                             j.future.set_exception(e)
@@ -254,6 +290,12 @@ class AsyncBatchVerifier:
                     self._resolve(*pending.popleft())
                 if not jobs and not preps and pending:
                     self._resolve(*pending.popleft())
+                # refresh the backlog gauges every iteration — including
+                # the drain-to-idle one, so they read 0 when idle instead
+                # of going stale at the last busy value
+                m = _backend._ops_m()
+                m.pipeline_inflight.set(len(pending))
+                m.pipeline_queue_depth.set(self._q.qsize())
         finally:
             prep_pool.shutdown(wait=False)
 

@@ -22,8 +22,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import trace as _trace
 from . import backend as _backend
 from . import ed25519_verify as _kernel
+
+_span = _trace.span
 
 AXIS = "dp"
 
@@ -111,15 +114,18 @@ def verify_commit_sharded(
     bucket = bucket or _backend._bucket_for(max(n, int(nd)))
     if bucket % nd:
         bucket += int(nd) - bucket % int(nd)
-    args = _backend.prepare_batch(entries, bucket)
-    live = np.zeros((bucket,), dtype=bool)
-    live[:n] = True
-    pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
-    pw[:n] = split_power(np.asarray(powers[:n]))
+    with _span("sharded.host_prep", n=n, bucket=bucket):
+        args = _backend.prepare_batch(entries, bucket)
+        live = np.zeros((bucket,), dtype=bool)
+        live[:n] = True
+        pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
+        pw[:n] = split_power(np.asarray(powers[:n]))
     fn, _ = _jitted_for(mesh)
-    valid, lanes, all_valid = fn(*args, pw, live)
+    with _span("sharded.device", n=n, bucket=bucket):
+        valid, lanes, all_valid = fn(*args, pw, live)
+        valid = np.asarray(valid)
     return (
-        np.asarray(valid)[:n],
+        valid[:n],
         join_power(lanes),
         bool(np.asarray(all_valid)),
     )
@@ -214,21 +220,24 @@ def verify_commit_sharded_pallas(
             block = cand
             break
     interpret = jax.default_backend() != "tpu"
-    a_t, r_t, s_t, k_t, sok_t = _pv.prepare_compact(entries, bucket)
-    live = np.zeros((bucket,), dtype=bool)
-    live[:n] = True
-    pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
-    pw[:n] = split_power(np.asarray(powers[:n]))
+    with _span("sharded.host_prep", n=n, bucket=bucket):
+        a_t, r_t, s_t, k_t, sok_t = _pv.prepare_compact(entries, bucket)
+        live = np.zeros((bucket,), dtype=bool)
+        live[:n] = True
+        pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
+        pw[:n] = split_power(np.asarray(powers[:n]))
     key = ("pallas", tuple(d.id for d in mesh.devices.flat), per_shard, block,
            interpret)
     if key not in _mesh_cache:
         _mesh_cache[key] = sharded_pallas_verifier(mesh, per_shard, block,
                                                    interpret)
-    valid, lanes, all_valid = _mesh_cache[key](
-        a_t, r_t, s_t, k_t, sok_t, pw, live
-    )
+    with _span("sharded.device", n=n, bucket=bucket):
+        valid, lanes, all_valid = _mesh_cache[key](
+            a_t, r_t, s_t, k_t, sok_t, pw, live
+        )
+        valid = np.asarray(valid)
     return (
-        np.asarray(valid)[:n],
+        valid[:n],
         join_power(lanes),
         bool(np.asarray(all_valid)),
     )
@@ -315,20 +324,22 @@ def verify_commit_sharded_rlc(
     g = g_shard * nd
     bucket = g * m
 
-    a_t, r_t, scal_t, sok_t = _pr.prepare_rlc(entries, bucket)
-    live = np.zeros((bucket,), dtype=bool)
-    live[:n] = True
-    pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
-    pw[:n] = split_power(np.asarray(powers[:n]))
+    with _span("sharded.host_prep", n=n, bucket=bucket):
+        a_t, r_t, scal_t, sok_t = _pr.prepare_rlc(entries, bucket)
+        live = np.zeros((bucket,), dtype=bool)
+        live[:n] = True
+        pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
+        pw[:n] = split_power(np.asarray(powers[:n]))
     interpret = jax.default_backend() != "tpu"
     key = ("rlc", tuple(d.id for d in mesh.devices.flat), g_shard, block,
            interpret)
     if key not in _mesh_cache:
         _mesh_cache[key] = sharded_rlc_verifier(mesh, g_shard, block, interpret)
-    lane_valid, lanes_pw, all_valid = _mesh_cache[key](
-        a_t, r_t, scal_t, sok_t, pw, live
-    )
-    lane_valid = np.asarray(lane_valid)
+    with _span("sharded.device", n=n, bucket=bucket):
+        lane_valid, lanes_pw, all_valid = _mesh_cache[key](
+            a_t, r_t, scal_t, sok_t, pw, live
+        )
+        lane_valid = np.asarray(lane_valid)
     tallied = join_power(lanes_pw)
     # lane verdicts -> per-sig verdicts + host re-verify of rejected
     # lanes (shared with the single-chip path), then add the rescued

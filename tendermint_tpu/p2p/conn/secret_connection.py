@@ -20,13 +20,23 @@ import struct
 import threading
 from typing import Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:  # X25519/AEAD need the OpenSSL wheel. Under TM_TPU_PUREPY_CRYPTO=1
+    # (see crypto/ed25519) the p2p package still imports without it
+    # (memory transports, router, peer manager) and only establishing a
+    # SecretConnection raises.
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+
+    _HAVE_OPENSSL = True
+except ModuleNotFoundError:
+    if not os.environ.get("TM_TPU_PUREPY_CRYPTO"):
+        raise
+    _HAVE_OPENSSL = False
 
 from ...crypto import PrivKey, PubKey, ed25519
 from ...wire.proto import ProtoWriter, decode_message, field_bytes
@@ -61,6 +71,11 @@ class SecretConnection:
     """Wraps a duplex stream-like object with read(n)/write(b)/close()."""
 
     def __init__(self, conn, local_priv: PrivKey):
+        if not _HAVE_OPENSSL:
+            raise RuntimeError(
+                "SecretConnection requires the `cryptography` OpenSSL wheel "
+                "(X25519/ChaCha20-Poly1305)"
+            )
         self._conn = conn
         self._send_mtx = threading.Lock()
         self._recv_mtx = threading.Lock()

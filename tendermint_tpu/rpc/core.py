@@ -166,7 +166,21 @@ class Environment:
                 ),
                 "voting_power": str(self._own_voting_power()),
             },
+            # beyond the reference: live device verify-engine stats (the
+            # north-star hot path) — counters only, no jax import, so a
+            # /status poll stays cheap even mid-verification
+            "verify_engine": self._verify_engine_stats(),
         }
+
+    @staticmethod
+    def _verify_engine_stats() -> dict:
+        from ..libs.metrics import ops_stats
+        from ..observability import trace as _trace
+
+        stats = ops_stats()
+        stats["tracing"] = _trace.TRACER.enabled
+        stats["trace_spans_recorded"] = _trace.TRACER.recorded_total
+        return stats
 
     def _own_voting_power(self) -> int:
         cs = self._node.consensus
@@ -178,6 +192,25 @@ class Environment:
 
     def health(self) -> dict:
         return {}
+
+    def dump_trace(self, summary: bool = False) -> dict:
+        """Live span-trace introspection (num_unconfirmed_txs-style
+        read-only endpoint): the tracer ring buffer as Chrome-trace JSON
+        (load the `trace` value in chrome://tracing / Perfetto), plus a
+        per-span p50/p95/p99 summary. `summary=true` omits the raw events
+        for a cheap poll."""
+        from ..observability import trace as _trace
+
+        out = {
+            "enabled": _trace.TRACER.enabled,
+            "capacity": _trace.TRACER.capacity,
+            "recorded_total": _trace.TRACER.recorded_total,
+            "summary": _trace.TRACER.summary(),
+        }
+        # GET params arrive as strings — accept the usual truthy spellings
+        if str(summary).lower() not in ("true", "1", "yes", "on"):
+            out["trace"] = _trace.TRACER.export_chrome()
+        return out
 
     def net_info(self) -> dict:
         router = self._node.router
@@ -573,6 +606,7 @@ ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "tx", "tx_search", "block_search", "num_unconfirmed_txs",
     "unconfirmed_txs", "check_tx", "remove_tx", "broadcast_evidence",
+    "dump_trace",
 ]
 
 # routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured

@@ -15,8 +15,26 @@ from typing import Callable, Optional
 
 from ..crypto import batch as _batch
 from ..crypto import tmhash
+from ..observability import trace as _trace
 from .block import BlockID, Commit, CommitSig
 from .validator_set import ErrNotEnoughVotingPowerSigned, ValidatorSet, safe_mul
+
+_span = _trace.span
+
+_OPS = None
+
+
+def _note_host_verified(n: int) -> None:
+    """Per-signature host verifications (the sub-threshold single path)
+    count toward the ops sigs_verified series like every other path."""
+    global _OPS
+    if not n:
+        return
+    if _OPS is None:
+        from ..libs import metrics as _metrics
+
+        _OPS = _metrics.ops_metrics()
+    _OPS.sigs_verified.inc(n, path="host")
 
 BATCH_VERIFY_THRESHOLD = 2  # validation.go:12
 
@@ -80,14 +98,15 @@ def verify_commit(
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = _ignore_absent
     count = _count_for_block
-    if _should_batch_verify(vals, commit):
-        _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
-        )
-    else:
-        _verify_commit_single(
-            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
-        )
+    with _span("verify_commit", n=len(commit.signatures), height=height):
+        if _should_batch_verify(vals, commit):
+            _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+            )
+        else:
+            _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+            )
 
 
 def verify_commit_light(
@@ -98,14 +117,16 @@ def verify_commit_light(
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = _ignore_not_for_block
     count = _count_all
-    if _should_batch_verify(vals, commit):
-        _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
-        )
-    else:
-        _verify_commit_single(
-            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
-        )
+    with _span("verify_commit", n=len(commit.signatures), height=height,
+               mode="light"):
+        if _should_batch_verify(vals, commit):
+            _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+            )
+        else:
+            _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+            )
 
 
 def verify_commit_light_trusting(
@@ -221,7 +242,10 @@ def _verify_commit_batch(
     # one batch sign-bytes composition for all selected lanes (native
     # composer; the per-lane Python encode was the dominant host cost on
     # large commits)
-    sign_bytes = commit.vote_sign_bytes_many(chain_id, [i for i, _ in selected])
+    with _span("verify_commit.sign_bytes", n=len(selected)):
+        sign_bytes = commit.vote_sign_bytes_many(
+            chain_id, [i for i, _ in selected]
+        )
     batch_sig_idxs = [idx for idx, _ in selected]
     add_many = getattr(bv, "add_entries", None)
     if add_many is not None:
@@ -241,7 +265,8 @@ def _verify_commit_batch(
     else:
         for (idx, val), sb in zip(selected, sign_bytes, strict=True):
             bv.add(val.pub_key, sb, commit.signatures[idx].signature)
-    ok, valid_sigs = bv.verify()
+    with _span("verify_commit.verify", n=len(selected)):
+        ok, valid_sigs = bv.verify()
     if ok:
         return
     for i, sig_ok in enumerate(valid_sigs):
@@ -266,6 +291,7 @@ def _verify_commit_single(
 ) -> None:
     """validation.go:265-334."""
     tallied = 0
+    checked = 0
     seen_vals: dict = {}
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
@@ -286,10 +312,13 @@ def _verify_commit_single(
             raise ValueError(
                 f"wrong signature (#{idx}): {commit_sig.signature.hex().upper()}"
             )
+        checked += 1
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
+            _note_host_verified(checked)
             return
+    _note_host_verified(checked)
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
 

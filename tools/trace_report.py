@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Summarize a Chrome-trace dump from the span tracer.
+
+Usage:
+    python tools/trace_report.py <trace.json> [--json]
+
+<trace.json> is a Trace Event Format file — what `/dump_trace` returns
+under "trace", what the node's OnStop flush writes to
+instrumentation.trace_dump_path, or any hand-rolled
+observability.trace.TRACER.dump() output. Prints a per-span table
+(count, total, p50/p95/p99 ms) plus the wall-clock extent and device
+utilization (fraction of wall covered by device-side spans); --json
+emits the same summary as one JSON object for scripting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.observability.trace import summarize_events  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_report")
+    ap.add_argument("trace_file", help="Chrome-trace JSON file")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the summary as JSON")
+    args = ap.parse_args(argv)
+
+    with open(args.trace_file) as fh:
+        doc = json.load(fh)
+    if "traceEvents" not in doc:
+        # tolerate a /dump_trace response body saved verbatim
+        doc = doc.get("trace", doc.get("result", {}).get("trace", {}))
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        print("error: no traceEvents found in input", file=sys.stderr)
+        return 1
+
+    summary = summarize_events(doc)
+    if args.as_json:
+        print(json.dumps(summary))
+        return 0
+
+    wall = summary.pop("_wall")
+    name_w = max([len(n) for n in summary] + [len("span")])
+    hdr = (f"{'span':<{name_w}}  {'count':>7}  {'total ms':>10}  "
+           f"{'p50 ms':>9}  {'p95 ms':>9}  {'p99 ms':>9}")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, s in sorted(summary.items(),
+                          key=lambda kv: -kv[1]["total_ms"]):
+        print(f"{name:<{name_w}}  {s['count']:>7}  {s['total_ms']:>10.3f}  "
+              f"{s['p50_ms']:>9.3f}  {s['p95_ms']:>9.3f}  {s['p99_ms']:>9.3f}")
+    print("-" * len(hdr))
+    print(f"wall clock: {wall['wall_ms']:.3f} ms over {wall['events']} events; "
+          f"device utilization: {wall['device_utilization'] * 100:.1f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
