@@ -300,10 +300,29 @@ def worker() -> None:
 
     _span_stats = _tr.TRACER.summary()
     _tr.configure(enabled=False)
+    # host_gil_ms_per_commit: estimated GIL-HELD host milliseconds per
+    # n_sigs commit prep — the quantity that bounds concurrent
+    # verify_commit throughput (PERF_r05: ~40 ms/commit GIL time vs
+    # ~23 ms device time made the host the binding constraint, the
+    # EntryBlock representation's target). Estimate = host_prep p50 minus
+    # the stages that run GIL-RELEASED in native code (challenges /
+    # fused prep) when the native module is loaded; paths without inner
+    # spans (prepare_rlc) degrade to the conservative full-prep figure.
+    _prep_p50 = _span_stats.get("bench.host_prep", {}).get("p50_ms", 0.0)
+    _released_ms = sum(
+        _span_stats.get(s, {}).get("p50_ms", 0.0)
+        for s in ("ops.challenges", "ops.prep_fused")
+    )
+    from tendermint_tpu.native import load as _load_native_for_gil
+
+    _gil_ms = _prep_p50 - (
+        _released_ms if _load_native_for_gil() is not None else 0.0
+    )
     span_summary = {
         "host_prep_ms_p50": round(
             _span_stats.get("bench.host_prep", {}).get("p50_ms", 0.0), 3
         ),
+        "host_gil_ms_per_commit": round(max(_gil_ms, 0.0), 3),
         "host_prep_ms_p95": round(
             _span_stats.get("bench.host_prep", {}).get("p95_ms", 0.0), 3
         ),

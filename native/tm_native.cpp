@@ -1733,6 +1733,488 @@ static PyObject *py_ed25519_rlc_scalars(PyObject *, PyObject *args) {
   return out;
 }
 
+// --------------------------------------------------------------------------
+// Columnar (EntryBlock) prep — the zero-copy commit path. All entry points
+// below consume contiguous buffers (pubs n*32, sigs n*64, one concatenated
+// sign-bytes buffer + an (n+1) int64 offset table) and run with the GIL
+// RELEASED end to end: no per-signature Python objects are touched between
+// commit selection and the kernel argument arrays (ops/entry_block.py).
+
+// Shared per-range worker pool sizing (same policy as sr25519_verify_batch:
+// affinity-mask CPU count, TM_NATIVE_THREADS override).
+static unsigned native_pool_width() {
+  unsigned hw = 0;
+  cpu_set_t setmask;
+  if (sched_getaffinity(0, sizeof(setmask), &setmask) == 0)
+    hw = (unsigned)CPU_COUNT(&setmask);
+  if (!hw) hw = std::thread::hardware_concurrency();
+  const char *env = getenv("TM_NATIVE_THREADS");
+  if (env && *env) {
+    long v = strtol(env, nullptr, 10);
+    if (v > 0 && v < 1024) hw = (unsigned)v;
+  }
+  return hw ? hw : 1;
+}
+
+template <typename Fn>
+static void parallel_ranges(Py_ssize_t n, Py_ssize_t min_serial, Fn fn) {
+  Py_ssize_t nthreads = (Py_ssize_t)native_pool_width();
+  if (nthreads > n) nthreads = n > 0 ? n : 1;
+  if (nthreads <= 1 || n < min_serial) {
+    fn((Py_ssize_t)0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  Py_ssize_t chunk = (n + nthreads - 1) / nthreads;
+  for (Py_ssize_t t = 0; t < nthreads; t++) {
+    Py_ssize_t lo = t * chunk;
+    Py_ssize_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(fn, lo, hi);
+  }
+  for (auto &th : pool) th.join();
+}
+
+// Offset-table validation shared by the columnar entry points. Runs
+// before any GIL-released work: a non-monotonic table would make
+// offs[i+1]-offs[i] wrap to a huge size_t inside the threaded hash loop.
+static bool offsets_valid(const int64_t *op, Py_ssize_t n,
+                          Py_ssize_t msgs_len) {
+  if (n < 0) return false;
+  if (n == 0) return true;
+  if (op[0] != 0 || op[n] > msgs_len) return false;
+  for (Py_ssize_t i = 0; i < n; i++)
+    if (op[i + 1] < op[i]) return false;
+  return true;
+}
+
+// k_i = SHA512(R_i || A_i || M_i) mod L over columnar buffers.
+static void challenges_range(const uint8_t *rs, const uint8_t *pubs,
+                             const uint8_t *msgs, const int64_t *offs,
+                             Py_ssize_t lo, Py_ssize_t hi, uint8_t *dst,
+                             ossl_sha512_fn fast) {
+  std::vector<uint8_t> cat;
+  for (Py_ssize_t i = lo; i < hi; i++) {
+    size_t mlen = (size_t)(offs[i + 1] - offs[i]);
+    const uint8_t *m = msgs + offs[i];
+    uint8_t digest[64];
+    if (fast) {
+      cat.resize(64 + mlen);
+      memcpy(cat.data(), rs + 32 * i, 32);
+      memcpy(cat.data() + 32, pubs + 32 * i, 32);
+      if (mlen) memcpy(cat.data() + 64, m, mlen);
+      fast(cat.data(), cat.size(), digest);
+    } else {
+      sha512::Ctx c;
+      sha512::init(&c);
+      sha512::update(&c, rs + 32 * i, 32);
+      sha512::update(&c, pubs + 32 * i, 32);
+      sha512::update(&c, m, mlen);
+      sha512::final(&c, digest);
+    }
+    sha512::mod_l(digest, dst + 32 * i);
+  }
+}
+
+// 32B LE encoding -> 20 radix-2^13 limbs of the low 255 bits.
+static inline void pack_limbs_row(const uint8_t enc[32], int32_t out[20]) {
+  uint64_t w[4];
+  for (int j = 0; j < 4; j++) {
+    w[j] = 0;
+    for (int b = 0; b < 8; b++) w[j] |= uint64_t(enc[8 * j + b]) << (8 * b);
+  }
+  w[3] &= 0x7fffffffffffffffULL;
+  for (int limb = 0; limb < 20; limb++) {
+    int bit = limb * 13;
+    int word = bit >> 6, off = bit & 63;
+    uint64_t v = w[word] >> off;
+    if (off > 64 - 13 && word < 3) v |= w[word + 1] << (64 - off);
+    out[limb] = int32_t(v & 0x1fff);
+  }
+}
+
+static inline bool scalar_below_l(const uint8_t s[32]) {
+  static const uint8_t L_BYTES[32] = {
+      0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58, 0xd6, 0x9c, 0xf7,
+      0xa2, 0xde, 0xf9, 0xde, 0x14, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+  for (int j = 31; j >= 0; j--) {
+    if (s[j] < L_BYTES[j]) return true;
+    if (s[j] > L_BYTES[j]) return false;
+  }
+  return false;  // s == L
+}
+
+// ed25519_challenges_buf(rs: n*32, pubs: n*32, msgs: buffer,
+//                        offsets: (n+1)*int64) -> bytes (n*32)
+// Columnar variant of ed25519_challenges: the whole batch hashes in one
+// GIL-released call with no PySequence walk (message i is
+// msgs[offsets[i]:offsets[i+1]]).
+static PyObject *py_ed25519_challenges_buf(PyObject *, PyObject *args) {
+  Py_buffer rs, pubs, msgs, offs;
+  int no_ossl = 0;  // tests force the scalar fallback path
+  if (!PyArg_ParseTuple(args, "y*y*y*y*|p", &rs, &pubs, &msgs, &offs,
+                        &no_ossl))
+    return nullptr;
+  Py_ssize_t n = offs.len / 8 - 1;
+  const int64_t *op = (const int64_t *)offs.buf;
+  bool ok = n >= 0 && offs.len % 8 == 0 && rs.len >= 32 * n &&
+            pubs.len >= 32 * n && offsets_valid(op, n, msgs.len);
+  PyObject *out = ok ? PyBytes_FromStringAndSize(nullptr, n * 32) : nullptr;
+  if (!out) {
+    PyBuffer_Release(&rs);
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&msgs);
+    PyBuffer_Release(&offs);
+    if (ok) return nullptr;
+    PyErr_SetString(PyExc_ValueError, "bad columnar challenge inputs");
+    return nullptr;
+  }
+  uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(out);
+  const uint8_t *rp = (const uint8_t *)rs.buf;
+  const uint8_t *pp = (const uint8_t *)pubs.buf;
+  const uint8_t *mp = (const uint8_t *)msgs.buf;
+  ossl_sha512_fn fast = no_ossl ? nullptr : ossl_sha512();
+  Py_BEGIN_ALLOW_THREADS
+  parallel_ranges(n, 2048, [&](Py_ssize_t lo, Py_ssize_t hi) {
+    challenges_range(rp, pp, mp, op, lo, hi, dst, fast);
+  });
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&rs);
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&offs);
+  return out;
+}
+
+// ed25519_prep_fused(pubs: n*32, sigs: n*64, msgs: buffer,
+//                    offsets: (n+1)*int64, bucket) ->
+//   (pub_limbs (bucket*20 i32), a_sign (bucket i32),
+//    r_limbs (bucket*20 i32), r_sign (bucket i32),
+//    s_bits (253*bucket i32, transposed), k_bits (253*bucket i32),
+//    s_ok (bucket u8))
+// The ENTIRE host prep of the XLA per-signature kernel (ops/backend.py
+// prepare_batch: row pack + SHA-512 challenges + limb/bit pack + s<L) in
+// one GIL-released native call. Padding lanes carry the identity layout
+// (A = R = identity encoding, s = k = 0, s_ok = 1) like _pack_rows.
+static PyObject *py_ed25519_prep_fused(PyObject *, PyObject *args) {
+  Py_buffer pubs, sigs, msgs, offs;
+  Py_ssize_t bucket;
+  int no_ossl = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*n|p", &pubs, &sigs, &msgs, &offs,
+                        &bucket, &no_ossl))
+    return nullptr;
+  Py_ssize_t n = offs.len / 8 - 1;
+  const int64_t *op = (const int64_t *)offs.buf;
+  bool ok = n >= 0 && offs.len % 8 == 0 && bucket >= n && bucket > 0 &&
+            pubs.len >= 32 * n && sigs.len >= 64 * n &&
+            offsets_valid(op, n, msgs.len);
+  if (!ok) {
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    PyBuffer_Release(&msgs);
+    PyBuffer_Release(&offs);
+    PyErr_SetString(PyExc_ValueError, "bad fused prep inputs");
+    return nullptr;
+  }
+  PyObject *pub_limbs = PyBytes_FromStringAndSize(nullptr, bucket * 20 * 4);
+  PyObject *a_sign = PyBytes_FromStringAndSize(nullptr, bucket * 4);
+  PyObject *r_limbs = PyBytes_FromStringAndSize(nullptr, bucket * 20 * 4);
+  PyObject *r_sign = PyBytes_FromStringAndSize(nullptr, bucket * 4);
+  PyObject *s_bits = PyBytes_FromStringAndSize(nullptr, 253 * bucket * 4);
+  PyObject *k_bits = PyBytes_FromStringAndSize(nullptr, 253 * bucket * 4);
+  PyObject *s_okb = PyBytes_FromStringAndSize(nullptr, bucket);
+  if (!pub_limbs || !a_sign || !r_limbs || !r_sign || !s_bits || !k_bits ||
+      !s_okb) {
+    Py_XDECREF(pub_limbs); Py_XDECREF(a_sign); Py_XDECREF(r_limbs);
+    Py_XDECREF(r_sign); Py_XDECREF(s_bits); Py_XDECREF(k_bits);
+    Py_XDECREF(s_okb);
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    PyBuffer_Release(&msgs);
+    PyBuffer_Release(&offs);
+    return nullptr;
+  }
+  int32_t *pl = (int32_t *)PyBytes_AS_STRING(pub_limbs);
+  int32_t *as_ = (int32_t *)PyBytes_AS_STRING(a_sign);
+  int32_t *rl = (int32_t *)PyBytes_AS_STRING(r_limbs);
+  int32_t *rsn = (int32_t *)PyBytes_AS_STRING(r_sign);
+  int32_t *sb = (int32_t *)PyBytes_AS_STRING(s_bits);
+  int32_t *kb = (int32_t *)PyBytes_AS_STRING(k_bits);
+  uint8_t *sok = (uint8_t *)PyBytes_AS_STRING(s_okb);
+  const uint8_t *pp = (const uint8_t *)pubs.buf;
+  const uint8_t *gp = (const uint8_t *)sigs.buf;
+  const uint8_t *mp = (const uint8_t *)msgs.buf;
+  ossl_sha512_fn fast = no_ossl ? nullptr : ossl_sha512();
+  Py_BEGIN_ALLOW_THREADS
+  // padding lanes first (bulk): zero bits/limbs, identity encodings
+  memset(sb, 0, 253 * (size_t)bucket * 4);
+  memset(kb, 0, 253 * (size_t)bucket * 4);
+  memset(pl, 0, (size_t)bucket * 80);
+  memset(rl, 0, (size_t)bucket * 80);
+  memset(as_, 0, (size_t)bucket * 4);
+  memset(rsn, 0, (size_t)bucket * 4);
+  for (Py_ssize_t i = n; i < bucket; i++) {
+    pl[20 * i] = 1;  // identity encoding y=1 -> limb0 = 1
+    rl[20 * i] = 1;
+    sok[i] = 1;
+  }
+  // per-row work is row-disjoint (the transposed bit arrays write column
+  // i only), so the whole pack+hash pass fans out across the pool
+  parallel_ranges(n, 1024, [&](Py_ssize_t lo, Py_ssize_t hi) {
+    std::vector<uint8_t> cat;
+    for (Py_ssize_t i = lo; i < hi; i++) {
+      const uint8_t *pub = pp + 32 * i;
+      const uint8_t *r = gp + 64 * i;
+      const uint8_t *s = gp + 64 * i + 32;
+      pack_limbs_row(pub, pl + 20 * i);
+      pack_limbs_row(r, rl + 20 * i);
+      as_[i] = pub[31] >> 7;
+      rsn[i] = r[31] >> 7;
+      sok[i] = scalar_below_l(s) ? 1 : 0;
+      uint8_t digest[64], k[32];
+      size_t mlen = (size_t)(op[i + 1] - op[i]);
+      const uint8_t *m = mp + op[i];
+      if (fast) {
+        cat.resize(64 + mlen);
+        memcpy(cat.data(), r, 32);
+        memcpy(cat.data() + 32, pub, 32);
+        if (mlen) memcpy(cat.data() + 64, m, mlen);
+        fast(cat.data(), cat.size(), digest);
+      } else {
+        sha512::Ctx c;
+        sha512::init(&c);
+        sha512::update(&c, r, 32);
+        sha512::update(&c, pub, 32);
+        sha512::update(&c, m, mlen);
+        sha512::final(&c, digest);
+      }
+      sha512::mod_l(digest, k);
+      for (int b = 0; b < 253; b++) {
+        sb[(Py_ssize_t)b * bucket + i] = (s[b >> 3] >> (b & 7)) & 1;
+        kb[(Py_ssize_t)b * bucket + i] = (k[b >> 3] >> (b & 7)) & 1;
+      }
+    }
+  });
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&sigs);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&offs);
+  PyObject *tup = PyTuple_Pack(7, pub_limbs, a_sign, r_limbs, r_sign, s_bits,
+                               k_bits, s_okb);
+  Py_DECREF(pub_limbs); Py_DECREF(a_sign); Py_DECREF(r_limbs);
+  Py_DECREF(r_sign); Py_DECREF(s_bits); Py_DECREF(k_bits); Py_DECREF(s_okb);
+  return tup;
+}
+
+// ed25519_rlc_prep(pubs: n*32, sigs: n*64, msgs: buffer,
+//                  offsets: (n+1)*int64, z: total*32, m, total) ->
+//   (k_enc (n*32), S||U ((total/m + total)*32), s_ok (total u8))
+// Fused host prep of the device RLC fast-accept kernel: SHA-512
+// challenges + the per-lane 128x256-bit mod-L scalar mul-adds + s<L flags
+// in one GIL-released call (ops/pallas_rlc.py prepare_rlc). total (a
+// multiple of m, >= n) is the padded live-lane signature count; rows
+// n..total-1 are padding lanes (s = k = 0, s_ok = 1, U = 0).
+static PyObject *py_ed25519_rlc_prep(PyObject *, PyObject *args) {
+  Py_buffer pubs, sigs, msgs, offs, zb;
+  Py_ssize_t m, total;
+  int no_ossl = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*y*nn|p", &pubs, &sigs, &msgs, &offs,
+                        &zb, &m, &total, &no_ossl))
+    return nullptr;
+  Py_ssize_t n = offs.len / 8 - 1;
+  const int64_t *op = (const int64_t *)offs.buf;
+  bool ok = n >= 0 && offs.len % 8 == 0 && m > 0 && total >= n &&
+            total % m == 0 && pubs.len >= 32 * n && sigs.len >= 64 * n &&
+            zb.len >= 32 * total && offsets_valid(op, n, msgs.len);
+  PyObject *k_out = nullptr, *su_out = nullptr, *sok_out = nullptr;
+  Py_ssize_t g = ok ? total / m : 0;
+  if (ok) {
+    k_out = PyBytes_FromStringAndSize(nullptr, n * 32);
+    su_out = PyBytes_FromStringAndSize(nullptr, 32 * (g + total));
+    sok_out = PyBytes_FromStringAndSize(nullptr, total);
+  }
+  if (!k_out || !su_out || !sok_out) {
+    Py_XDECREF(k_out); Py_XDECREF(su_out); Py_XDECREF(sok_out);
+    PyBuffer_Release(&pubs);
+    PyBuffer_Release(&sigs);
+    PyBuffer_Release(&msgs);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&zb);
+    if (!ok) PyErr_SetString(PyExc_ValueError, "bad rlc fused prep inputs");
+    return nullptr;
+  }
+  uint8_t *kd = (uint8_t *)PyBytes_AS_STRING(k_out);
+  uint8_t *S = (uint8_t *)PyBytes_AS_STRING(su_out);
+  uint8_t *U = S + 32 * g;
+  uint8_t *sok = (uint8_t *)PyBytes_AS_STRING(sok_out);
+  const uint8_t *pp = (const uint8_t *)pubs.buf;
+  const uint8_t *gp = (const uint8_t *)sigs.buf;
+  const uint8_t *mp = (const uint8_t *)msgs.buf;
+  const uint8_t *zp = (const uint8_t *)zb.buf;
+  ossl_sha512_fn fast = no_ossl ? nullptr : ossl_sha512();
+  Py_BEGIN_ALLOW_THREADS
+  // lane-disjoint: each lane reads rows base..base+m-1 and writes only
+  // its own S/U/k/s_ok slots
+  parallel_ranges(g, 256, [&](Py_ssize_t lane_lo, Py_ssize_t lane_hi) {
+    std::vector<uint8_t> cat;
+    for (Py_ssize_t lane = lane_lo; lane < lane_hi; lane++) {
+      Py_ssize_t base = lane * m;
+      for (Py_ssize_t i = base; i < base + m && i < n; i++) {
+        const uint8_t *pub = pp + 32 * i;
+        const uint8_t *r = gp + 64 * i;
+        sok[i] = scalar_below_l(gp + 64 * i + 32) ? 1 : 0;
+        uint8_t digest[64];
+        size_t mlen = (size_t)(op[i + 1] - op[i]);
+        const uint8_t *msg = mp + op[i];
+        if (fast) {
+          cat.resize(64 + mlen);
+          memcpy(cat.data(), r, 32);
+          memcpy(cat.data() + 32, pub, 32);
+          if (mlen) memcpy(cat.data() + 64, msg, mlen);
+          fast(cat.data(), cat.size(), digest);
+        } else {
+          sha512::Ctx c;
+          sha512::init(&c);
+          sha512::update(&c, r, 32);
+          sha512::update(&c, pub, 32);
+          sha512::update(&c, msg, mlen);
+          sha512::final(&c, digest);
+        }
+        sha512::mod_l(digest, kd + 32 * i);
+      }
+      for (Py_ssize_t i = base < n ? (base + m < n ? base + m : n) : base;
+           i < base + m; i++)
+        sok[i] = 1;  // padding rows: s = 0 < L
+      // per-lane scalar mul-adds (ed25519_rlc_scalars semantics);
+      // padding rows contribute s = k = 0 -> U = 0, no S term
+      uint8_t wide[64] = {0};
+      if (base < n) memcpy(wide, gp + 64 * base + 32, 32);
+      sha512::mod_l(wide, S + 32 * lane);
+      if (base < n)
+        memcpy(U + 32 * base, kd + 32 * base, 32);
+      else
+        memset(U + 32 * base, 0, 32);
+      for (Py_ssize_t j = 1; j < m; j++) {
+        Py_ssize_t i = base + j;
+        if (i >= n) {
+          memset(U + 32 * i, 0, 32);
+          continue;
+        }
+        uint8_t zs[32];
+        ed::sc_mul(zs, zp + 32 * i, gp + 64 * i + 32);
+        ed::sc_add(S + 32 * lane, S + 32 * lane, zs);
+        ed::sc_mul(U + 32 * i, zp + 32 * i, kd + 32 * i);
+      }
+    }
+  });
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&pubs);
+  PyBuffer_Release(&sigs);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&offs);
+  PyBuffer_Release(&zb);
+  PyObject *tup = PyTuple_Pack(3, k_out, su_out, sok_out);
+  Py_DECREF(k_out); Py_DECREF(su_out); Py_DECREF(sok_out);
+  return tup;
+}
+
+// vote_sign_bytes_batch_buf(prefix, suffix, times: n*16B LE int64 pairs)
+//   -> (bytes buffer, bytes offsets ((n+1) int64 LE))
+// Buffer-writing variant of vote_sign_bytes_batch: composes every
+// signature's canonical sign bytes into ONE contiguous buffer + offset
+// table (the EntryBlock msgs form) with the GIL released — no per-lane
+// PyBytes objects or list handling.
+static PyObject *py_vote_sign_bytes_batch_buf(PyObject *, PyObject *args) {
+  Py_buffer prefix, suffix, times;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &prefix, &suffix, &times))
+    return nullptr;
+  if (times.len % 16) {
+    PyBuffer_Release(&prefix);
+    PyBuffer_Release(&suffix);
+    PyBuffer_Release(&times);
+    PyErr_SetString(PyExc_ValueError,
+                    "times must be n*16 bytes of (seconds, nanos) pairs");
+    return nullptr;
+  }
+  Py_ssize_t n = times.len / 16;
+  const uint8_t *tp = (const uint8_t *)times.buf;
+  PyObject *offs_out = PyBytes_FromStringAndSize(nullptr, (n + 1) * 8);
+  if (!offs_out) {
+    PyBuffer_Release(&prefix);
+    PyBuffer_Release(&suffix);
+    PyBuffer_Release(&times);
+    return nullptr;
+  }
+  int64_t *offs = (int64_t *)PyBytes_AS_STRING(offs_out);
+  // pass 1: exact per-record lengths -> offsets (GIL released; raw bufs)
+  Py_BEGIN_ALLOW_THREADS
+  offs[0] = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int64_t secs, nanos;
+    memcpy(&secs, tp + 16 * i, 8);
+    memcpy(&nanos, tp + 16 * i + 8, 8);
+    uint8_t scratch[10];
+    size_t tn = 0;
+    if (secs != 0) tn += 1 + put_uvarint(scratch, (uint64_t)secs);
+    if (nanos != 0) tn += 1 + put_uvarint(scratch, (uint64_t)nanos);
+    uint8_t tscratch[10];
+    size_t mn = 1 + put_uvarint(tscratch, tn) + tn;
+    size_t body = (size_t)prefix.len + mn + (size_t)suffix.len;
+    size_t hn = put_uvarint(tscratch, body);
+    offs[i + 1] = offs[i] + (int64_t)(hn + body);
+  }
+  Py_END_ALLOW_THREADS
+  PyObject *buf_out = PyBytes_FromStringAndSize(nullptr, offs[n]);
+  if (!buf_out) {
+    Py_DECREF(offs_out);
+    PyBuffer_Release(&prefix);
+    PyBuffer_Release(&suffix);
+    PyBuffer_Release(&times);
+    return nullptr;
+  }
+  uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(buf_out);
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int64_t secs, nanos;
+    memcpy(&secs, tp + 16 * i, 8);
+    memcpy(&nanos, tp + 16 * i + 8, 8);
+    uint8_t ts_body[22];
+    size_t tn = 0;
+    if (secs != 0) {
+      ts_body[tn++] = 0x08;
+      tn += put_uvarint(ts_body + tn, (uint64_t)secs);
+    }
+    if (nanos != 0) {
+      ts_body[tn++] = 0x10;
+      tn += put_uvarint(ts_body + tn, (uint64_t)nanos);
+    }
+    uint8_t mid[32];
+    size_t mn = 0;
+    mid[mn++] = 0x2a;
+    mn += put_uvarint(mid + mn, tn);
+    memcpy(mid + mn, ts_body, tn);
+    mn += tn;
+    size_t body = (size_t)prefix.len + mn + (size_t)suffix.len;
+    uint8_t *p = dst + offs[i];
+    p += put_uvarint(p, body);
+    memcpy(p, prefix.buf, prefix.len);
+    p += prefix.len;
+    memcpy(p, mid, mn);
+    p += mn;
+    memcpy(p, suffix.buf, suffix.len);
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&prefix);
+  PyBuffer_Release(&suffix);
+  PyBuffer_Release(&times);
+  PyObject *tup = PyTuple_Pack(2, buf_out, offs_out);
+  Py_DECREF(buf_out);
+  Py_DECREF(offs_out);
+  return tup;
+}
+
 static PyMethodDef Methods[] = {
     {"ed25519_batch_verify", py_ed25519_batch_verify, METH_VARARGS,
      "Host RLC batch ed25519 verification (Pippenger MSM); returns bool"},
@@ -1742,6 +2224,14 @@ static PyMethodDef Methods[] = {
      "Batch canonical vote sign-bytes composition from a template"},
     {"ed25519_challenges", py_ed25519_challenges, METH_VARARGS,
      "Batch k = SHA512(R||A||M) mod L challenge scalars (32B LE each)"},
+    {"ed25519_challenges_buf", py_ed25519_challenges_buf, METH_VARARGS,
+     "Columnar challenge scalars from a concatenated msgs buffer + offsets"},
+    {"ed25519_prep_fused", py_ed25519_prep_fused, METH_VARARGS,
+     "Fused columnar host prep for the XLA per-sig kernel (one GIL-released call)"},
+    {"ed25519_rlc_prep", py_ed25519_rlc_prep, METH_VARARGS,
+     "Fused columnar challenges + per-lane RLC scalar prep + s<L flags"},
+    {"vote_sign_bytes_batch_buf", py_vote_sign_bytes_batch_buf, METH_VARARGS,
+     "Batch sign-bytes composed into one contiguous buffer + offset table"},
     {"sr25519_verify_batch", py_sr25519_verify_batch, METH_VARARGS,
      "Batch schnorrkel sr25519 verification (R == [s]B - [k]A)"},
     {"merkle_root", py_merkle_root, METH_VARARGS,

@@ -37,6 +37,7 @@ class Playback:
         self._config = config
         self._app = app
         self._records: List = []
+        self.warnings: List[str] = []  # per-record corrupt/malformed notes
         self._load_stores()
         self._build_cs()
         self._read_wal()
@@ -125,11 +126,31 @@ class Playback:
     def remaining(self) -> int:
         return len(self._records) - self.count
 
+    def _warn_record(self, index: int, kind: str, err: Exception) -> None:
+        """Corrupt or unexpectedly-failing WAL records are surfaced once
+        per record (never silently dropped): stderr line + self.warnings
+        for the console session to inspect."""
+        import sys
+
+        msg = f"wal record #{index} ({kind}): {type(err).__name__}: {err}"
+        self.warnings.append(msg)
+        print(f"replay: {msg}", file=sys.stderr)
+
     def step(self, n: int = 1) -> int:
         """Apply the next n records through the state machine handlers
         (readReplayMessage, replay.go:41: msgInfo -> handleMsg paths,
         timeouts -> handleTimeout, EndHeight -> marker). Returns how many
-        were applied."""
+        were applied.
+
+        Error handling is deliberately narrow: records addressed to an
+        ALREADY-COMMITTED height (rec height < the state's height) are the
+        expected stale/duplicate case when replaying a full WAL over a
+        caught-up state and are skipped silently, as are stale-step votes
+        (ErrVoteUnexpectedStep). Anything else — a record that fails to
+        decode, or a current-height record the handlers reject — is a
+        corrupt/malformed WAL entry and gets a per-record warning instead
+        of a silent skip."""
+        from ..types.vote_set import ErrVoteUnexpectedStep
         from ..wire.proto import decode_message, field_bytes, field_int
         from .state import BlockPartMessage, TimeoutInfo
 
@@ -138,32 +159,55 @@ class Playback:
             rec = self._records[self.count]
             self.count += 1
             applied += 1
+            if rec.end_height is not None:
+                continue  # height marker; state advances via commits
+            kind = "timeout" if rec.timeout is not None else (rec.msg_kind or "?")
+            # decode phase: a payload that does not parse is corrupt, full
+            # stop — there is no stale interpretation of it
             try:
-                if rec.end_height is not None:
-                    continue  # height marker; state advances via commits
+                call = None
                 if rec.timeout is not None:
                     d, h, r, st = rec.timeout
-                    self.cs._handle_timeout(
-                        TimeoutInfo(duration=d / 1000.0, height=h, round=r, step=st)
+                    rec_height = h
+                    ti = TimeoutInfo(
+                        duration=d / 1000.0, height=h, round=r, step=st
                     )
+                    call = lambda: self.cs._handle_timeout(ti)  # noqa: E731
                 elif rec.msg_kind == "proposal":
-                    self.cs._set_proposal(Proposal.decode(rec.msg_payload))
+                    p = Proposal.decode(rec.msg_payload)
+                    rec_height = p.height
+                    call = lambda: self.cs._set_proposal(p)  # noqa: E731
                 elif rec.msg_kind == "block_part":
                     f = decode_message(rec.msg_payload)
-                    self.cs._add_proposal_block_part(
-                        BlockPartMessage(
-                            height=field_int(f, 1),
-                            round=field_int(f, 2),
-                            part=Part.decode(field_bytes(f, 3)),
-                        ),
-                        rec.peer_id,
+                    bp = BlockPartMessage(
+                        height=field_int(f, 1),
+                        round=field_int(f, 2),
+                        part=Part.decode(field_bytes(f, 3)),
+                    )
+                    rec_height = bp.height
+                    call = lambda: self.cs._add_proposal_block_part(  # noqa: E731
+                        bp, rec.peer_id
                     )
                 elif rec.msg_kind == "vote":
-                    self.cs._try_add_vote(Vote.decode(rec.msg_payload), rec.peer_id)
-            except (ValueError, RuntimeError, KeyError):
-                # stale/duplicate records for already-committed heights are
-                # expected when replaying a full WAL over a caught-up state
+                    v = Vote.decode(rec.msg_payload)
+                    rec_height = v.height
+                    call = lambda: self.cs._try_add_vote(v, rec.peer_id)  # noqa: E731
+                else:
+                    continue  # unknown kinds are ignored as before
+            except Exception as e:  # noqa: BLE001 - decode = corrupt record
+                self._warn_record(self.count - 1, kind, e)
                 continue
+            try:
+                call()
+            except ErrVoteUnexpectedStep:
+                continue  # stale-step vote: expected during catch-up replay
+            except (ValueError, RuntimeError, KeyError) as e:
+                if rec_height < self.cs.rs.height:
+                    # stale/duplicate record for an already-committed
+                    # height: the expected case replaying a full WAL over
+                    # a caught-up state
+                    continue
+                self._warn_record(self.count - 1, kind, e)
         return applied
 
     def reset_back(self, back: int) -> None:

@@ -47,6 +47,18 @@ class Ed25519HostBatchVerifier(BatchVerifier):
             raise ValueError("invalid signature length")
         self._entries.extend((k.bytes(), m, s) for k, m, s in entries)
 
+    def add_block(self, block, keys=None) -> None:
+        """Columnar bulk add (ops.entry_block.EntryBlock). The host
+        verifier is the no-device fallback, so the block is expanded to
+        tuples here; the device verifier keeps it by reference. `keys`
+        runs the same per-key TYPE check as add()/add_entries; lengths
+        are structural in the block's (n, 32)/(n, 64) shape."""
+        if keys is not None and any(
+            not isinstance(k, _ed25519.PubKey) for k in keys
+        ):
+            raise TypeError("pubkey is not ed25519")
+        self._entries.extend(block.iter_entries())
+
     def verify(self) -> Tuple[bool, List[bool]]:
         # Random-linear-combination batch first when the native module is
         # built (one Pippenger MSM — crypto/ed25519/ed25519.go:219-227

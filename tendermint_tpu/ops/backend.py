@@ -35,6 +35,7 @@ from ..crypto._edwards import L
 from ..libs import metrics as _metrics
 from ..observability import trace as _trace
 from . import ed25519_verify
+from .entry_block import EntryBlock, as_block
 
 _span = _trace.span
 
@@ -100,11 +101,22 @@ def _pack_le_limbs(enc: np.ndarray) -> np.ndarray:
     if native is not None:
         raw = native.pack_le_limbs(np.ascontiguousarray(enc).tobytes(), n)
         return np.frombuffer(raw, dtype=np.int32).reshape(n, 20).copy()
-    bits = np.unpackbits(enc, axis=1, bitorder="little")[:, :255]
-    pad = np.zeros((bits.shape[0], 20 * 13 - 255), dtype=bits.dtype)
-    bits = np.concatenate([bits, pad], axis=1)
-    weights = (1 << np.arange(13, dtype=np.int32)).astype(np.int32)
-    return (bits.reshape(-1, 20, 13) * weights).sum(axis=2).astype(np.int32)
+    # vectorized word-shift extraction (mirrors the C packer): 4 uint64
+    # words per row, 20 shifted 13-bit windows — ~6x the old
+    # unpackbits-weights path, which built a (B, 20, 13) int32 transient
+    w = np.ascontiguousarray(enc).view("<u8")  # (n, 4) LE words
+    cols = [w[:, 0], w[:, 1], w[:, 2],
+            w[:, 3] & np.uint64(0x7FFFFFFFFFFFFFFF)]  # bit 255 excluded
+    out = np.empty((n, 20), dtype=np.int32)
+    mask = np.uint64(0x1FFF)
+    for limb in range(20):
+        bit = limb * 13
+        word, off = bit >> 6, bit & 63
+        v = cols[word] >> np.uint64(off)
+        if off > 64 - 13 and word < 3:
+            v = v | (cols[word + 1] << np.uint64(64 - off))
+        out[:, limb] = (v & mask).astype(np.int32)
+    return out
 
 
 def _bits_253(le32: np.ndarray) -> np.ndarray:
@@ -117,17 +129,22 @@ def _bits_253(le32: np.ndarray) -> np.ndarray:
     if native is not None:
         raw = native.pack_bits_le(np.ascontiguousarray(le32).tobytes(), n, 253)
         return np.frombuffer(raw, dtype=np.int32).reshape(253, n).copy()
-    bits = np.unpackbits(le32, axis=1, bitorder="little")[:, :253]
-    return np.ascontiguousarray(bits.T).astype(np.int32)
+    # extract bits along the TRANSPOSED byte axis so the result lands
+    # directly in ladder row order — no (B, 253) -> (253, B) strided
+    # transpose copy (which dominated the old fallback at 10k lanes)
+    tt = np.ascontiguousarray(le32.T)  # (32, B)
+    bits = (tt[:, None, :] >> np.arange(8, dtype=np.uint8)[None, :, None]) & 1
+    return bits.reshape(256, n)[:253].astype(np.int32)
 
 
 _L_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
 
 
 def _pack_rows(entries, bucket: int):
-    """Bulk-pack (pub32, msg, sig64) triples into padded (bucket, 32)
-    pub/R/s arrays via two joins — no per-signature Python loop (SURVEY.md
-    §7 hard-part 3: host prep must not dominate the batch).
+    """Bulk-pack a batch into padded (bucket, 32) pub/R/s arrays. For an
+    EntryBlock the columns already exist — three slice-assigns, no joins
+    or per-signature Python objects; tuple lists keep the two-join path
+    (SURVEY.md §7 hard-part 3: host prep must not dominate the batch).
 
     Padding lanes: A = R = identity encoding (y=1), s = 0 — these verify
     trivially and keep the ladder numerically meaningful."""
@@ -136,18 +153,24 @@ def _pack_rows(entries, bucket: int):
     r_enc = np.zeros((bucket, 32), dtype=np.uint8)
     s_enc = np.zeros((bucket, 32), dtype=np.uint8)
     if n:
-        # length check before the joins: a single wrong-length key would
-        # otherwise silently shift every later lane after the reshape
-        if any(len(pk) != 32 or len(s) != 64 for pk, _, s in entries):
-            raise ValueError("entries must be (pub32, msg, sig64) triples")
-        pub[:n] = np.frombuffer(
-            b"".join(pk for pk, _, _ in entries), dtype=np.uint8
-        ).reshape(n, 32)
-        sig = np.frombuffer(
-            b"".join(s for _, _, s in entries), dtype=np.uint8
-        ).reshape(n, 64)
-        r_enc[:n] = sig[:, :32]
-        s_enc[:n] = sig[:, 32:]
+        if isinstance(entries, EntryBlock):
+            pub[:n] = entries.pub
+            r_enc[:n] = entries.sig[:, :32]
+            s_enc[:n] = entries.sig[:, 32:]
+        else:
+            # length check before the joins: a single wrong-length key
+            # would otherwise silently shift every later lane after the
+            # reshape
+            if any(len(pk) != 32 or len(s) != 64 for pk, _, s in entries):
+                raise ValueError("entries must be (pub32, msg, sig64) triples")
+            pub[:n] = np.frombuffer(
+                b"".join(pk for pk, _, _ in entries), dtype=np.uint8
+            ).reshape(n, 32)
+            sig = np.frombuffer(
+                b"".join(s for _, _, s in entries), dtype=np.uint8
+            ).reshape(n, 64)
+            r_enc[:n] = sig[:, :32]
+            s_enc[:n] = sig[:, 32:]
     pub[n:, 0] = 1
     r_enc[n:, 0] = 1
     return pub, r_enc, s_enc
@@ -167,20 +190,57 @@ def _challenges(r_enc: np.ndarray, pub: np.ndarray, msgs) -> bytes:
             np.ascontiguousarray(pub).tobytes(),
             msgs,
         )
-    r_b = np.ascontiguousarray(r_enc).tobytes()
-    p_b = np.ascontiguousarray(pub).tobytes()
+    # pure-Python fallback: one R||A prefix pre-join, then the hashlib +
+    # bigint-mod floor per signature (CPython's 512-by-253-bit % beats a
+    # vectorized numpy limb reduction here — measured 6.5 vs 19 ms/10k)
+    n = len(msgs)
+    ra = np.empty((n, 64), dtype=np.uint8)
+    ra[:, :32] = r_enc[:n]
+    ra[:, 32:] = pub[:n]
+    ra_b = ra.tobytes()
+    sha = hashlib.sha512
     return b"".join(
         (
             int.from_bytes(
-                hashlib.sha512(
-                    r_b[32 * i : 32 * i + 32] + p_b[32 * i : 32 * i + 32] + m
-                ).digest(),
-                "little",
+                sha(ra_b[64 * i : 64 * i + 64] + m).digest(), "little"
             )
             % L
         ).to_bytes(32, "little")
         for i, m in enumerate(msgs)
     )
+
+
+def _challenges_block(r_enc: np.ndarray, pub: np.ndarray,
+                      block: EntryBlock) -> bytes:
+    """Columnar _challenges: the whole batch's sign-bytes live in ONE
+    buffer + offset table, so the native path is a single GIL-released
+    call with no per-message Python objects; the hashlib fallback hashes
+    zero-copy memoryview slices."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None and hasattr(native, "ed25519_challenges_buf"):
+        buf, offs = block.msgs_contiguous()
+        return native.ed25519_challenges_buf(
+            np.ascontiguousarray(r_enc).tobytes(),
+            np.ascontiguousarray(pub).tobytes(),
+            buf,
+            np.ascontiguousarray(offs).tobytes(),
+        )
+    # bytes slices (not memoryviews): hashlib's C fast path and the older
+    # native sequence API both run measurably faster on real bytes
+    buf, offs = block.msgs_contiguous()
+    b = buf if isinstance(buf, bytes) else bytes(buf)
+    o = offs.tolist()
+    msgs = [b[o[i] : o[i + 1]] for i in range(len(block))]
+    return _challenges(r_enc, pub, msgs)
+
+
+def _challenges_any(r_enc: np.ndarray, pub: np.ndarray, entries) -> bytes:
+    """Dispatch on the batch representation (EntryBlock vs tuple list)."""
+    if isinstance(entries, EntryBlock):
+        return _challenges_block(r_enc, pub, entries)
+    return _challenges(r_enc, pub, [m for _, m, _ in entries])
 
 
 def _s_below_l(s_enc: np.ndarray, n: int, bucket: int) -> np.ndarray:
@@ -198,24 +258,55 @@ def _s_below_l(s_enc: np.ndarray, n: int, bucket: int) -> np.ndarray:
     return s_ok
 
 
-def prepare_batch(
-    entries: List[Tuple[bytes, bytes, bytes]], bucket: int
-) -> tuple:
-    """entries: (pub32, msg, sig64) triples, len <= bucket. Returns the
-    kernel argument tuple, padded to `bucket` lanes. The challenge scalar
-    k = SHA512(R||A||M) mod L is computed host-side here (hashlib is
-    C-speed; the device-hash path in prepare_batch_device_hash avoids even
-    this)."""
+def prepare_batch(entries, bucket: int) -> tuple:
+    """entries: EntryBlock or (pub32, msg, sig64) triples, len <= bucket.
+    Returns the kernel argument tuple, padded to `bucket` lanes.
+
+    EntryBlock + native module: the ENTIRE prep (row pack + SHA-512
+    challenges + limb/bit pack + s<L) is ONE GIL-released C call
+    (tm_native.ed25519_prep_fused) over the block's contiguous buffers —
+    the per-commit GIL share this stage used to hold is what capped
+    concurrent verify_commit throughput (PERF_r05). Columnar numpy and
+    tuple-list fallbacks keep parity."""
     n = len(entries)
     t0 = time.perf_counter()
     with _span("ops.host_prep", n=n, bucket=bucket):
+        if isinstance(entries, EntryBlock) and n:
+            from ..native import load as _load_native
+
+            native = _load_native()
+            if native is not None and hasattr(native, "ed25519_prep_fused"):
+                buf, offs = entries.msgs_contiguous()
+                with _span("ops.prep_fused"):
+                    pl, a_sign, rl, r_sign, sb, kb, sok = (
+                        native.ed25519_prep_fused(
+                            entries.pub.tobytes(),
+                            entries.sig.tobytes(),
+                            buf,
+                            np.ascontiguousarray(offs).tobytes(),
+                            bucket,
+                        )
+                    )
+                args = (
+                    np.frombuffer(pl, dtype=np.int32).reshape(bucket, 20),
+                    np.frombuffer(a_sign, dtype=np.int32),
+                    np.frombuffer(rl, dtype=np.int32).reshape(bucket, 20),
+                    np.frombuffer(r_sign, dtype=np.int32),
+                    np.frombuffer(sb, dtype=np.int32).reshape(253, bucket),
+                    np.frombuffer(kb, dtype=np.int32).reshape(253, bucket),
+                    np.frombuffer(sok, dtype=np.uint8).astype(bool),
+                )
+                _ops_m().host_prep_seconds.observe(
+                    time.perf_counter() - t0, bucket=str(bucket)
+                )
+                return args
         with _span("ops.pack_rows"):
             pub, r_enc, s_enc = _pack_rows(entries, bucket)
         k_enc = np.zeros((bucket, 32), dtype=np.uint8)
         s_ok = _s_below_l(s_enc, n, bucket)
         if n:
             with _span("ops.challenges"):
-                ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
+                ks = _challenges_any(r_enc[:n], pub[:n], entries)
             k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
 
         a_sign = (pub[:, 31] >> 7).astype(np.int32)
@@ -236,11 +327,10 @@ def prepare_batch(
     return args
 
 
-def prepare_batch_device_hash(
-    entries: List[Tuple[bytes, bytes, bytes]], bucket: int
-) -> tuple:
+def prepare_batch_device_hash(entries, bucket: int) -> tuple:
     """Device-hash argument prep: no host SHA-512 — messages ship as padded
-    R||A||M SHA blocks."""
+    R||A||M SHA blocks. EntryBlock input pads columnar (pad_ram_block);
+    tuple lists build the per-message R||A||M bytes as before."""
     from . import sha512 as _sha
 
     n = len(entries)
@@ -249,10 +339,19 @@ def prepare_batch_device_hash(
         with _span("ops.pack_rows"):
             pub, r_enc, s_enc = _pack_rows(entries, bucket)
         s_ok = _s_below_l(s_enc, n, bucket)
-        msgs = [sig[:32] + pk + msg for pk, msg, sig in entries]
-        msgs += [b"\x01" + bytes(31) + b"\x01" + bytes(31)] * (bucket - n)
         with _span("ops.sha_pad"):
-            hi, lo, counts = _sha.pad_messages(msgs, 64 + DEVICE_HASH_MAX_MSG)
+            if isinstance(entries, EntryBlock):
+                hi, lo, counts = _sha.pad_ram_block(
+                    entries, bucket, 64 + DEVICE_HASH_MAX_MSG
+                )
+            else:
+                msgs = [sig[:32] + pk + msg for pk, msg, sig in entries]
+                msgs += [b"\x01" + bytes(31) + b"\x01" + bytes(31)] * (
+                    bucket - n
+                )
+                hi, lo, counts = _sha.pad_messages(
+                    msgs, 64 + DEVICE_HASH_MAX_MSG
+                )
         a_sign = (pub[:, 31] >> 7).astype(np.int32)
         r_sign = (r_enc[:, 31] >> 7).astype(np.int32)
         with _span("ops.limb_pack"):
@@ -331,8 +430,19 @@ def _use_rlc() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """Run the device kernel over arbitrary batch size; returns (n,) bool."""
+def _max_msg_len(entries) -> int:
+    """Longest message in a batch — O(1) columnar from an EntryBlock's
+    offset table, a generator scan for tuple lists."""
+    if isinstance(entries, EntryBlock):
+        if not len(entries):
+            return 0
+        return int(np.diff(entries.offsets).max())
+    return max((len(m) for _, m, _ in entries), default=0)
+
+
+def verify_batch(entries) -> np.ndarray:
+    """Run the device kernel over arbitrary batch size (EntryBlock or
+    tuple list); returns (n,) bool."""
     if _use_pallas():
         from . import pallas_verify
 
@@ -381,9 +491,7 @@ def verify_batch(entries: List[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
             i += len(chunk)
         return np.concatenate(out) if out else np.zeros((0,), dtype=bool)
 
-    device_hash = not HOST_HASH and all(
-        len(m) <= DEVICE_HASH_MAX_MSG for _, m, _ in entries
-    )
+    device_hash = not HOST_HASH and _max_msg_len(entries) <= DEVICE_HASH_MAX_MSG
     out: List[np.ndarray] = []
     i = 0
     while i < len(entries):
@@ -420,6 +528,7 @@ class Ed25519DeviceBatchVerifier(BatchVerifier):
 
     def __init__(self, force_device: bool = False):
         self._entries: List[Tuple[bytes, bytes, bytes]] = []
+        self._blocks: List[EntryBlock] = []
         self._force = force_device or bool(
             int(os.environ.get("TM_TPU_FORCE_DEVICE", "0"))
         )
@@ -448,8 +557,32 @@ class Ed25519DeviceBatchVerifier(BatchVerifier):
             raise ValueError("invalid signature length")
         self._entries.extend((k.bytes(), m, s) for k, m, s in entries)
 
+    def add_block(self, block: EntryBlock, keys=None) -> None:
+        """Columnar bulk add: the block rides BY REFERENCE to the device
+        prep — no per-signature tuples at any point. `keys` (optional
+        iterable of the lanes' PubKey objects) runs the same per-key TYPE
+        check as add()/add_entries; lengths are structural in the block's
+        (n, 32)/(n, 64) shape."""
+        if keys is not None and any(
+            not isinstance(k, _ed25519.PubKey) for k in keys
+        ):
+            raise TypeError("pubkey is not ed25519")
+        if len(block):
+            # flush interleaved add() entries first so verify order (and
+            # blame indices) match submission order
+            if self._entries:
+                self._blocks.append(EntryBlock.from_entries(self._entries))
+                self._entries = []
+            self._blocks.append(block)
+
+    def _collect(self) -> EntryBlock:
+        blocks = list(self._blocks)
+        if self._entries:
+            blocks.append(EntryBlock.from_entries(self._entries))
+        return EntryBlock.concat(blocks)
+
     def verify(self) -> Tuple[bool, List[bool]]:
-        n = len(self._entries)
+        n = len(self._entries) + sum(len(b) for b in self._blocks)
         if n == 0:
             return False, []
         if n < DEVICE_THRESHOLD and not self._force:
@@ -459,9 +592,10 @@ class Ed25519DeviceBatchVerifier(BatchVerifier):
             with _span("ops.verify_host", n=n):
                 valid = [
                     _ed25519.verify_zip215_fast(pk, mg, s)
-                    for pk, mg, s in self._entries
+                    for pk, mg, s in self._collect().iter_entries()
                 ]
             return all(valid), valid
+        block = self._collect()
         # Default path is the shared async pipeline (VERDICT r3 item 1b):
         # one worker thread owns every device dispatch, so concurrent
         # commit verifies coalesce into full buckets and overlap host prep
@@ -470,9 +604,9 @@ class Ed25519DeviceBatchVerifier(BatchVerifier):
             from .pipeline import shared_verifier
 
             with _span("ops.pipeline_wait", n=n):
-                res = shared_verifier().submit(self._entries).result(timeout=600)
+                res = shared_verifier().submit(block).result(timeout=600)
         else:
-            res = verify_batch(self._entries)
+            res = verify_batch(block)
         res = np.asarray(res).astype(bool)
         # .all() and .tolist() both run in C — keeps the documented
         # (bool, List[bool]) interface without a 10k-iteration Python loop

@@ -1,0 +1,195 @@
+"""Columnar signature-batch representation — the zero-copy commit prep.
+
+PERF_r05: the RLC kernel sustains ~476k sigs/s but end-to-end
+types.verify_commit peaked at 143k because the host path between
+verify_commit and the kernel was built from per-signature Python objects:
+a (pub32, msg, sig64) tuple per lane, PyBytes sign-bytes, and b"".join
+re-copies in every prep stage — all GIL-held, so under concurrent commits
+the orchestration language (not the device) was the binding constraint.
+
+An EntryBlock carries one commit's (or one coalesced device batch's)
+signatures as contiguous columnar buffers built ONCE and handed by
+reference:
+
+    pub     (n, 32) uint8   public keys, row per signature
+    sig     (n, 64) uint8   signatures (R || s)
+    msgs    bytes/memoryview  all sign-bytes concatenated
+    offsets (n+1,) int64    msgs[offsets[i]:offsets[i+1]] is message i
+
+Downstream consumers (ops.backend prepare_batch*, ops.pallas_verify
+prepare_compact, ops.pallas_rlc prepare_rlc, the async pipeline's
+coalescer) slice these arrays directly: no per-signature Python objects
+are created between commit selection and the kernel argument arrays, and
+batch concatenation is np.concatenate instead of list-extend. The
+tuple-list API everywhere remains a thin shim over `as_block`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple, Union
+
+import numpy as np
+
+Entry = Tuple[bytes, bytes, bytes]
+
+_EMPTY_OFFSETS = np.zeros(1, dtype=np.int64)
+
+
+class EntryBlock:
+    """Columnar (pub, msg, sig) batch; see module docstring."""
+
+    __slots__ = ("pub", "sig", "msgs", "offsets")
+
+    def __init__(self, pub: np.ndarray, sig: np.ndarray,
+                 msgs: Union[bytes, memoryview], offsets: np.ndarray):
+        n = pub.shape[0]
+        if pub.shape != (n, 32) or sig.shape != (n, 64):
+            raise ValueError("pub must be (n, 32) and sig (n, 64) uint8")
+        if offsets.shape != (n + 1,):
+            raise ValueError("offsets must be (n+1,)")
+        # monotonicity is load-bearing: downstream native code derives
+        # per-message lengths as offsets[i+1]-offsets[i] in GIL-released
+        # C, where a negative difference wraps to a huge size_t
+        if n and bool((np.diff(offsets) < 0).any()):
+            raise ValueError("offsets must be non-decreasing")
+        self.pub = pub
+        self.sig = sig
+        self.msgs = msgs
+        self.offsets = offsets
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def empty(cls) -> "EntryBlock":
+        return cls(
+            np.zeros((0, 32), dtype=np.uint8),
+            np.zeros((0, 64), dtype=np.uint8),
+            b"",
+            _EMPTY_OFFSETS,
+        )
+
+    @classmethod
+    def from_entries(cls, entries: Sequence[Entry]) -> "EntryBlock":
+        """Tuple-list shim: one validation pass + two joins, the same cost
+        the old per-batch _pack_rows paid — conversion happens once at the
+        API boundary instead of in every downstream stage."""
+        n = len(entries)
+        if n == 0:
+            return cls.empty()
+        if any(len(pk) != 32 or len(s) != 64 for pk, _, s in entries):
+            raise ValueError("entries must be (pub32, msg, sig64) triples")
+        pub = np.frombuffer(
+            b"".join(pk for pk, _, _ in entries), dtype=np.uint8
+        ).reshape(n, 32)
+        sig = np.frombuffer(
+            b"".join(s for _, _, s in entries), dtype=np.uint8
+        ).reshape(n, 64)
+        lens = np.fromiter((len(m) for _, m, _ in entries), dtype=np.int64,
+                           count=n)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        msgs = b"".join(m for _, m, _ in entries)
+        return cls(pub, sig, msgs, offsets)
+
+    # -- shape --------------------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self.pub.shape[0]
+
+    def __len__(self) -> int:
+        return self.pub.shape[0]
+
+    def msg_nbytes(self) -> int:
+        return int(self.offsets[-1] - self.offsets[0])
+
+    # -- access -------------------------------------------------------------
+
+    def msg(self, i: int) -> bytes:
+        o = self.offsets
+        return bytes(memoryview(self.msgs)[int(o[i]) : int(o[i + 1])])
+
+    def entry(self, i: int) -> Entry:
+        """Materialize ONE (pub32, msg, sig64) tuple — the blame path's
+        per-lane re-verify, not a bulk conversion."""
+        return self.pub[i].tobytes(), self.msg(i), self.sig[i].tobytes()
+
+    def iter_entries(self) -> Iterator[Entry]:
+        for i in range(self.n):
+            yield self.entry(i)
+
+    def to_entries(self) -> List[Entry]:
+        return list(self.iter_entries())
+
+    def msg_views(self) -> List[memoryview]:
+        """Per-message zero-copy views (hashlib and the native sequence
+        APIs both accept memoryview)."""
+        mv = memoryview(self.msgs)
+        o = self.offsets
+        return [mv[int(o[i]) : int(o[i + 1])] for i in range(self.n)]
+
+    def msgs_contiguous(self) -> Tuple[Union[bytes, memoryview], np.ndarray]:
+        """(buffer, offsets) with the buffer trimmed to exactly the message
+        window and offsets rebased to start at 0 — the form the native
+        *_buf calls consume."""
+        base = int(self.offsets[0])
+        end = int(self.offsets[-1])
+        buf = self.msgs
+        if base != 0 or end != len(buf):
+            buf = memoryview(buf)[base:end]
+        if base == 0:
+            return buf, self.offsets
+        return buf, self.offsets - base
+
+    def __getitem__(self, key: slice) -> "EntryBlock":
+        """Zero-copy sub-block (numpy views + a rebased offset window) —
+        how a coalesced job straddles two device batches without
+        rebuilding per-signature objects."""
+        if not isinstance(key, slice):
+            raise TypeError("EntryBlock indexing takes a slice")
+        start, stop, step = key.indices(self.n)
+        if step != 1:
+            raise ValueError("EntryBlock slices must be contiguous")
+        o = self.offsets
+        base = int(o[start])
+        mv = memoryview(self.msgs)[base : int(o[stop])]
+        return EntryBlock(
+            self.pub[start:stop],
+            self.sig[start:stop],
+            mv,
+            o[start : stop + 1] - base,
+        )
+
+    # -- combination --------------------------------------------------------
+
+    @staticmethod
+    def concat(blocks: Sequence["EntryBlock"]) -> "EntryBlock":
+        """One np.concatenate per column + one msgs join — the coalescing
+        pipeline's replacement for per-signature list.extend."""
+        blocks = [b for b in blocks if len(b)]
+        if not blocks:
+            return EntryBlock.empty()
+        if len(blocks) == 1:
+            return blocks[0]
+        pub = np.concatenate([b.pub for b in blocks])
+        sig = np.concatenate([b.sig for b in blocks])
+        msgs = b"".join(b.msgs_contiguous()[0] for b in blocks)
+        offsets = np.zeros(len(pub) + 1, dtype=np.int64)
+        pos = 0
+        base = 0
+        for b in blocks:
+            buf, o = b.msgs_contiguous()
+            offsets[pos + 1 : pos + len(b) + 1] = o[1:] + base
+            pos += len(b)
+            base += int(o[-1])
+        return EntryBlock(pub, sig, msgs, offsets)
+
+
+EntriesLike = Union[EntryBlock, Sequence[Entry]]
+
+
+def as_block(entries: EntriesLike) -> EntryBlock:
+    """Normalize the public tuple-list API onto the columnar form."""
+    if isinstance(entries, EntryBlock):
+        return entries
+    return EntryBlock.from_entries(list(entries))

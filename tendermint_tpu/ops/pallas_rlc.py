@@ -72,7 +72,18 @@ BLOCK_LANES = int(os.environ.get("TM_TPU_RLC_BLOCK", "128"))
 # 81920 reaches ~460k (transfer included). The async pipeline coalesces
 # concurrent commits up to this cap; HBM at 81920 is ~900 MB of
 # intermediates on a 16 GB part.
+#
+# Validated at import (ADVICE r5): every bucket plan_bucket can select —
+# the cap included — must divide into whole kernel blocks (M * BLOCK_LANES
+# signatures each) or the truncated pallas grid would leave trailing
+# lanes' verdicts uninitialized, and a cap below the smallest quantized
+# bucket would make plan_bucket select ABOVE it.
 MAX_SIGS = int(os.environ.get("TM_TPU_RLC_MAX_SIGS", "81920"))
+if MAX_SIGS < 512 or MAX_SIGS % (M * BLOCK_LANES):
+    raise ValueError(
+        f"TM_TPU_RLC_MAX_SIGS={MAX_SIGS} must be >= 512 and a multiple of "
+        f"M*BLOCK_LANES={M * BLOCK_LANES}"
+    )
 
 # Scalar q: 0 -> S, 1..M -> u_{q-1}, M+1..2M-1 -> z_{q-M}.
 N_SCAL = 2 * M
@@ -264,7 +275,17 @@ def _k3_rlc_kernel(tbl_ref, dig_ref, coords_ref, ok_ref, sok_ref, out_ref):
 # Quantized bucket ladder (in signatures): XLA compiles one executable
 # per shape, and the coalescing pipeline would otherwise produce a fresh
 # shape (and a ~25 s Mosaic compile) for every distinct batch total.
-RLC_BUCKETS = (512, 2048, 10240, 20480, 40960, MAX_SIGS)
+# Built as a sorted tuple filtered to <= MAX_SIGS (and to whole kernel
+# blocks) so plan_bucket can never select above the cap or hand the
+# jitted kernel a lane count that truncates its grid.
+RLC_BUCKETS = tuple(
+    sorted(
+        b
+        for b in {512, 2048, 10240, 20480, 40960, 81920, MAX_SIGS}
+        if b <= MAX_SIGS and b % (M * BLOCK_LANES) == 0
+    )
+)
+assert RLC_BUCKETS and RLC_BUCKETS[-1] == MAX_SIGS
 
 
 def plan_bucket(n: int, block: int = 0) -> tuple:
@@ -311,6 +332,10 @@ def _jitted_rlc_verify(g: int, block: int, interpret: bool,
         return spec
 
     def out(rows):
+        # positional-only when vma is unset: older jax releases predate
+        # the vma kwarg, and an explicit vma=None still TypeErrors there
+        if vma is None:
+            return jax.ShapeDtypeStruct((rows, g), jnp.int32)
         return jax.ShapeDtypeStruct((rows, g), jnp.int32, vma=vma)
 
     spec = mkspec(block)
@@ -378,12 +403,42 @@ def _rlc_scalars_py(s_enc: bytes, k_enc: bytes, z_enc: bytes, m: int) -> bytes:
     return bytes(S) + bytes(U)
 
 
+def _seed_allowed() -> bool:
+    """Security gate for TM_TPU_RLC_SEED (ADVICE r5): deterministic RLC
+    coefficients turn the 2^-125 soundness bound into 'attacker picks the
+    coefficients', so the seed is honored only where no production verify
+    can run — a non-TPU (interpret) backend — or under the explicit
+    TM_TPU_RLC_SEED_UNSAFE=1 test override. On a TPU backend without the
+    override it is refused: warn once + ignore."""
+    if os.environ.get("TM_TPU_RLC_SEED_UNSAFE") == "1":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+_seed_refused = False
+
+
 def _gen_z(bucket: int) -> np.ndarray:
     """(bucket, 32) uint8 random 128-bit coefficients (top 16 bytes 0).
     Slot-0 entries are ignored by the scalar prep (coefficient 1).
-    TM_TPU_RLC_SEED makes them deterministic for tests."""
+    TM_TPU_RLC_SEED makes them deterministic for tests — subject to
+    _seed_allowed; a production TPU backend always gets CSPRNG draws."""
     z = np.zeros((bucket, 32), dtype=np.uint8)
     seed = os.environ.get("TM_TPU_RLC_SEED")
+    if seed is not None and not _seed_allowed():
+        global _seed_refused
+        if not _seed_refused:
+            _seed_refused = True
+            import warnings
+
+            warnings.warn(
+                "TM_TPU_RLC_SEED ignored on the TPU backend: predictable "
+                "RLC coefficients would break batch soundness (set "
+                "TM_TPU_RLC_SEED_UNSAFE=1 only in tests)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        seed = None
     if seed is not None:
         z[:, :16] = np.random.RandomState(int(seed)).randint(
             0, 256, size=(bucket, 16), dtype=np.uint8
@@ -396,11 +451,16 @@ def _gen_z(bucket: int) -> np.ndarray:
 
 
 def prepare_rlc(entries, bucket: int):
-    """(pub32, msg, sig64) triples -> RLC kernel args, padded to `bucket`
-    signatures (bucket % M == 0, bucket // M lanes). Host work on top of
-    the per-sig prep (pack + SHA-512 challenges + s<L): one 128x256-bit
-    mod-L mul-add per signature (native C helper, Python fallback)."""
-    from .backend import _challenges, _pack_rows, _s_below_l
+    """EntryBlock or (pub32, msg, sig64) triples -> RLC kernel args,
+    padded to `bucket` signatures (bucket % M == 0, bucket // M lanes).
+    Host work on top of the per-sig prep (pack + SHA-512 challenges +
+    s<L): one 128x256-bit mod-L mul-add per signature. For an EntryBlock
+    with the native module built, challenges + scalar mul-adds + s<L run
+    as ONE GIL-released call over the block's contiguous buffers
+    (tm_native.ed25519_rlc_prep); tuple lists and native-absent builds
+    keep the split numpy/Python path with identical outputs."""
+    from .backend import _challenges_any, _pack_rows, _s_below_l
+    from .entry_block import EntryBlock
     from ..native import load as _load_native
 
     n = len(entries)
@@ -415,19 +475,37 @@ def prepare_rlc(entries, bucket: int):
     g_live = min((n + M - 1) // M, g)
     live = g_live * M
     pub, r_enc, s_enc = _pack_rows(entries, live)
-    s_ok = _s_below_l(s_enc, n, live)
-    k_enc = np.zeros((live, 32), dtype=np.uint8)
-    if n:
-        ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
-        k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
     z = _gen_z(live)
 
     native = _load_native()
-    s_b, k_b, z_b = s_enc.tobytes(), k_enc.tobytes(), z.tobytes()
-    if native is not None and hasattr(native, "ed25519_rlc_scalars"):
-        raw = native.ed25519_rlc_scalars(s_b, k_b, z_b, M)
+    if (
+        n
+        and isinstance(entries, EntryBlock)
+        and native is not None
+        and hasattr(native, "ed25519_rlc_prep")
+    ):
+        buf, offs = entries.msgs_contiguous()
+        k_raw, raw, sok_raw = native.ed25519_rlc_prep(
+            entries.pub.tobytes(),
+            entries.sig.tobytes(),
+            buf,
+            np.ascontiguousarray(offs).tobytes(),
+            z.tobytes(),
+            M,
+            live,
+        )
+        s_ok = np.frombuffer(sok_raw, dtype=np.uint8).astype(bool)
     else:
-        raw = _rlc_scalars_py(s_b, k_b, z_b, M)
+        s_ok = _s_below_l(s_enc, n, live)
+        k_enc = np.zeros((live, 32), dtype=np.uint8)
+        if n:
+            ks = _challenges_any(r_enc[:n], pub[:n], entries)
+            k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
+        s_b, k_b, z_b = s_enc.tobytes(), k_enc.tobytes(), z.tobytes()
+        if native is not None and hasattr(native, "ed25519_rlc_scalars"):
+            raw = native.ed25519_rlc_scalars(s_b, k_b, z_b, M)
+        else:
+            raw = _rlc_scalars_py(s_b, k_b, z_b, M)
     S = np.frombuffer(raw[: 32 * g_live], dtype=np.uint8).reshape(g_live, 32)
     U = np.frombuffer(raw[32 * g_live :], dtype=np.uint8).reshape(g_live, M, 32)
 
@@ -471,18 +549,23 @@ def verify_rlc_compact(a_t, r_t, scal_t, sok_t, block: int = 0,
 
 
 def expand_lanes(lane_valid: np.ndarray, entries) -> np.ndarray:
-    """Lane verdicts -> per-signature verdicts. Valid lanes accept all M
-    slots; rejected lanes re-verify their live signatures individually on
-    the host for blame (types/validation.go:242-248 asymmetry — rejects
-    are the rare path, and M host verifies cost ~0.5 ms)."""
+    """Lane verdicts -> per-signature verdicts (entries: EntryBlock or
+    tuple list). Valid lanes accept all M slots; rejected lanes re-verify
+    their live signatures individually on the host for blame
+    (types/validation.go:242-248 asymmetry — rejects are the rare path,
+    and M host verifies cost ~0.5 ms). The blame path is the ONLY place a
+    per-signature tuple is materialized from an EntryBlock — M lanes at a
+    time, never the whole batch."""
     from ..crypto import ed25519 as _ed25519
+    from .entry_block import EntryBlock
 
     n = len(entries)
     per_sig = np.repeat(lane_valid, M)[:n].copy()
     if not lane_valid.all():
+        is_block = isinstance(entries, EntryBlock)
         for lane in np.nonzero(~lane_valid)[0]:
             for i in range(lane * M, min((lane + 1) * M, n)):
-                pk, msg, sig = entries[i]
+                pk, msg, sig = entries.entry(i) if is_block else entries[i]
                 per_sig[i] = _ed25519.verify_zip215_fast(pk, msg, sig)
     return per_sig
 

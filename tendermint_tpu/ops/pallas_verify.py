@@ -368,6 +368,11 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool,
         return spec
 
     def out(rows):
+        # positional-only when vma is unset: older jax releases (this
+        # container's CPU image among them) predate the vma kwarg, and an
+        # explicit vma=None still TypeErrors there
+        if vma is None:
+            return jax.ShapeDtypeStruct((rows, n), jnp.int32)
         return jax.ShapeDtypeStruct((rows, n), jnp.int32, vma=vma)
 
     spec = mkspec(block)
@@ -421,18 +426,19 @@ def verify_compact(a_t, r_t, s_t, k_t, s_ok_t, block: int = 0, interpret: bool =
 
 
 def prepare_compact(entries, bucket: int):
-    """(pub32, msg, sig64) triples -> compact batch-minor kernel args.
-    Host work: one SHA-512 per sig for k (native batch helper when built,
-    else hashlib), s<L check, two transposes. Padding lanes verify
-    trivially (A=R=identity, s=k=0)."""
-    from .backend import _challenges, _pack_rows, _s_below_l
+    """EntryBlock or (pub32, msg, sig64) triples -> compact batch-minor
+    kernel args. Host work: one SHA-512 per sig for k (native batch helper
+    when built — a single GIL-released call over the block's contiguous
+    msgs buffer — else hashlib), s<L check, two transposes. Padding lanes
+    verify trivially (A=R=identity, s=k=0)."""
+    from .backend import _challenges_any, _pack_rows, _s_below_l
 
     n = len(entries)
     pub, r_enc, s_enc = _pack_rows(entries, bucket)  # (bucket, 32) uint8 each
     s_ok = _s_below_l(s_enc, n, bucket)
     k_enc = np.zeros((bucket, 32), dtype=np.uint8)
     if n:
-        ks = _challenges(r_enc[:n], pub[:n], [m for _, m, _ in entries])
+        ks = _challenges_any(r_enc[:n], pub[:n], entries)
         k_enc[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
     return (
         np.ascontiguousarray(pub.T),

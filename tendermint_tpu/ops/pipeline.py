@@ -31,6 +31,7 @@ from ..observability import trace as _trace
 from ..types.validation import ErrNotEnoughVotingPowerSigned
 from . import backend as _backend
 from . import ed25519_verify as _kernel
+from .entry_block import EntryBlock, as_block
 
 _span = _trace.span
 
@@ -38,7 +39,7 @@ _span = _trace.span
 class _Job:
     __slots__ = ("entries", "future")
 
-    def __init__(self, entries):
+    def __init__(self, entries: EntryBlock):
         self.entries = entries
         self.future: Future = Future()
 
@@ -47,27 +48,36 @@ class AsyncBatchVerifier:
     """Double-buffered pipeline over the device engine.
 
     submit(entries) returns a Future resolving to the (n,) bool validity
-    array. One worker thread owns all device dispatches; `depth` in-flight
-    batches bound device memory (2 = classic double buffering).
+    array; entries may be an EntryBlock (handed downstream BY REFERENCE —
+    the zero-copy commit path) or a (pub, msg, sig) tuple list (converted
+    once at this boundary). One worker thread owns all device dispatches;
+    `depth` in-flight batches bound device memory (2 = classic double
+    buffering).
     """
 
     def __init__(self, depth: int = 3):
         self._depth = max(depth, 1)
         self._q: "queue.Queue[_Job]" = queue.Queue()
         self._stopped = threading.Event()
+        # wake signal for the worker: set on submit() and on prep-future
+        # completion so the worker can sleep instead of polling the job
+        # queue at 2 ms while preps are in flight (ADVICE r5)
+        self._wake = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
-    def submit(self, entries: Sequence[Tuple[bytes, bytes, bytes]]) -> Future:
+    def submit(self, entries) -> Future:
         if self._stopped.is_set():
             raise RuntimeError("verifier is closed")
-        job = _Job(list(entries))
+        job = _Job(as_block(entries))
         self._q.put(job)
+        self._wake.set()
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
 
     def close(self) -> None:
         self._stopped.set()
+        self._wake.set()
         self._thread.join(timeout=5)
 
     # -- worker ----------------------------------------------------------
@@ -100,7 +110,7 @@ class AsyncBatchVerifier:
                     len(entries), bucket, prep_s=time.perf_counter() - t0
                 )
                 f = pallas_rlc._jitted_rlc_verify(g, block, interpret)
-                return f, args, list(entries), bucket
+                return f, args, entries, bucket
             bucket = _backend._pallas_bucket(len(entries))
             t0 = time.perf_counter()
             with _span("pipeline.prep", n=len(entries), bucket=bucket):
@@ -112,8 +122,9 @@ class AsyncBatchVerifier:
                 bucket, min(pallas_verify.BLOCK, bucket), interpret
             )
             return f, args, None, bucket
-        device_hash = not _backend.HOST_HASH and all(
-            len(m) <= _backend.DEVICE_HASH_MAX_MSG for _, m, _ in entries
+        device_hash = (
+            not _backend.HOST_HASH
+            and _backend._max_msg_len(entries) <= _backend.DEVICE_HASH_MAX_MSG
         )
         bucket = _backend._bucket_for(len(entries))
         # prep timing histograms are recorded inside prepare_batch*;
@@ -180,6 +191,7 @@ class AsyncBatchVerifier:
         pending: deque = deque()  # (spans, device_value, rlc_entries)
         hold: Optional[_Job] = None
         max_b = _backend.max_coalesce()
+        wake = self._wake
         try:
             while not (
                 self._stopped.is_set() and self._q.empty()
@@ -191,11 +203,35 @@ class AsyncBatchVerifier:
                 hold = None
                 if job is None:
                     try:
-                        job = self._q.get(
-                            timeout=0.002 if (pending or preps) else 0.2
-                        )
+                        job = self._q.get_nowait()
                     except queue.Empty:
                         job = None
+                    # actionable without a new job: a finished head prep
+                    # (dispatch), pending beyond depth (forced resolve),
+                    # or pending with no preps (the drain-to-idle resolve
+                    # branch below, which blocks on the device)
+                    actionable = (
+                        (preps and preps[0][1].done())
+                        or len(pending) > self._depth
+                        or (pending and not preps)
+                    )
+                    if job is None and not actionable:
+                        # Nothing actionable: sleep until a submission or
+                        # the head prep's done-callback sets the wake
+                        # event (no 2 ms busy-poll while preps are in
+                        # flight — ADVICE r5). Recheck after clear() so a
+                        # set() racing the clear is never lost.
+                        wake.clear()
+                        if (
+                            self._q.empty()
+                            and not (preps and preps[0][1].done())
+                            and not self._stopped.is_set()
+                        ):
+                            wake.wait(0.2)
+                        try:
+                            job = self._q.get_nowait()
+                        except queue.Empty:
+                            job = None
                 if job is not None:
                     jobs.append(job)
                     total = len(job.entries)
@@ -251,14 +287,17 @@ class AsyncBatchVerifier:
                             except Exception as e:  # noqa: BLE001
                                 j.future.set_exception(e)
                     else:
-                        entries = []
                         spans = []
+                        off = 0
                         for j in jobs:
-                            spans.append((j, len(entries), len(j.entries)))
-                            entries.extend(j.entries)
-                        preps.append(
-                            (spans, prep_pool.submit(self._prepare, entries))
-                        )
+                            spans.append((j, off, len(j.entries)))
+                            off += len(j.entries)
+                        # columnar coalescing: one concatenate per column
+                        # instead of a per-signature list-extend
+                        entries = EntryBlock.concat([j.entries for j in jobs])
+                        fut = prep_pool.submit(self._prepare, entries)
+                        fut.add_done_callback(lambda _f: wake.set())
+                        preps.append((spans, fut))
                 # dispatch every finished prep in FIFO order; if the device
                 # would otherwise go idle (nothing pending), wait for the
                 # head prep instead of spinning
@@ -322,11 +361,16 @@ def shared_verifier() -> AsyncBatchVerifier:
 
 def commit_entries(
     chain_id: str, vals, commit, voting_power_needed: int
-) -> Tuple[list, int]:
-    """Build (pub, sign_bytes, sig) entries for a commit's for-block
-    signatures (index lookup, early-stop past 2/3 like validation.go:152
-    with countAllSignatures=false). Returns (entries, tallied_power).
-    Raises on structural problems (bad counts, short power)."""
+) -> Tuple[EntryBlock, int]:
+    """Build the columnar EntryBlock for a commit's for-block signatures
+    (index lookup, early-stop past 2/3 like validation.go:152 with
+    countAllSignatures=false). Returns (block, tallied_power). Raises on
+    structural problems (bad counts, short power).
+
+    The sign bytes come back as ONE contiguous buffer + offset table
+    (Commit.vote_sign_bytes_block) and ride by reference all the way to
+    the kernel prep — no per-signature PyBytes or tuples. Callers that
+    need tuples can block.to_entries()."""
     idxs = []
     tallied = 0
     for idx, cs in enumerate(commit.signatures):
@@ -338,12 +382,21 @@ def commit_entries(
             break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
-    sign_bytes = commit.vote_sign_bytes_many(chain_id, idxs)
-    entries = [
-        (vals.validators[i].pub_key.bytes(), sb, commit.signatures[i].signature)
-        for i, sb in zip(idxs, sign_bytes, strict=True)
-    ]
-    return entries, tallied
+    sigs = commit.signatures
+    if any(len(sigs[i].signature) != 64 for i in idxs):
+        raise ValueError("invalid signature length")
+    buf, offsets = commit.vote_sign_bytes_block(chain_id, idxs)
+    n = len(idxs)
+    pub_b = b"".join(vals.validators[i].pub_key.bytes() for i in idxs)
+    if len(pub_b) != 32 * n:
+        # a wrong-size key (e.g. secp256k1 in an ed25519 set) must surface
+        # as the error the per-entry path raised, not a reshape failure
+        raise TypeError("pubkey is not ed25519")
+    pub = np.frombuffer(pub_b, dtype=np.uint8).reshape(n, 32)
+    sig = np.frombuffer(
+        b"".join(sigs[i].signature for i in idxs), dtype=np.uint8
+    ).reshape(n, 64)
+    return EntryBlock(pub, sig, buf, offsets), tallied
 
 
 def verify_commits_pipelined(
@@ -377,18 +430,19 @@ def verify_commits_pipelined(
     max_b = _backend.BUCKETS[-1]
     futures: List[Future] = []
     job_spans: List[list] = [[] for _ in jobs]  # (future_idx, off, n)
-    cur: list = []
+    cur: list = []  # EntryBlocks (or zero-copy slices of them)
+    cur_n = 0
     cur_spans: list = []  # (job_idx, off_in_batch, n)
 
     def _flush() -> None:
-        nonlocal cur, cur_spans
+        nonlocal cur, cur_n, cur_spans
         if not cur:
             return
         fi = len(futures)
-        futures.append(v.submit(cur))
+        futures.append(v.submit(EntryBlock.concat(cur)))
         for job_i, off, n in cur_spans:
             job_spans[job_i].append((fi, off, n))
-        cur, cur_spans = [], []
+        cur, cur_n, cur_spans = [], 0, []
 
     for i, (vals, block_id, height, commit) in enumerate(jobs):
         try:
@@ -400,11 +454,14 @@ def verify_commits_pipelined(
             continue
         pos = 0
         while pos < len(entries):
-            take = min(len(entries) - pos, max_b - len(cur))
-            cur_spans.append((i, len(cur), take))
-            cur.extend(entries[pos : pos + take])
+            take = min(len(entries) - pos, max_b - cur_n)
+            cur_spans.append((i, cur_n, take))
+            # a job straddling two device batches rides as a zero-copy
+            # slice of its block — no per-signature re-packing
+            cur.append(entries[pos : pos + take])
+            cur_n += take
             pos += take
-            if len(cur) >= max_b:
+            if cur_n >= max_b:
                 _flush()
     _flush()
 

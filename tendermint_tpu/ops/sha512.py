@@ -234,6 +234,10 @@ def pad_messages(msgs, max_len: int):
         base = blocks * 128 - 8
         for j in range(8):
             buf[rng, base + j] = (bitlen >> (8 * (7 - j))) & 0xFF
+    return _buf_to_words(buf, bsz, nblock) + (counts,)
+
+
+def _buf_to_words(buf: np.ndarray, bsz: int, nblock: int):
     words = buf.reshape(bsz, nblock, 16, 8)
     hi = (
         (words[..., 0].astype(np.uint32) << 24)
@@ -247,7 +251,45 @@ def pad_messages(msgs, max_len: int):
         | (words[..., 6].astype(np.uint32) << 8)
         | words[..., 7].astype(np.uint32)
     )
-    return hi, lo, counts
+    return hi, lo
+
+
+def pad_ram_block(block, bucket: int, max_len: int):
+    """Columnar device-hash prep: an EntryBlock's R||A||M messages padded
+    straight into SHA blocks — (bucket, NBLOCK, 16) uint32 hi/lo + (bucket,)
+    block counts, with NO per-message bytes objects (the tuple-list path
+    builds sig[:32]+pk+msg per signature; here R and A land as two column
+    assigns and the msgs buffer scatters once). Padding lanes carry the
+    identity pattern (b"\\x01" + 31 zeros, twice)."""
+    nblock = (max_len + 17 + 127) // 128
+    n = len(block)
+    lens = np.full(bucket, 64, dtype=np.int64)
+    buf = np.zeros((bucket, nblock * 128), dtype=np.uint8)
+    if n:
+        mbuf, offs = block.msgs_contiguous()
+        offs = np.asarray(offs)
+        mlens = np.diff(offs)
+        lens[:n] = 64 + mlens
+        if lens.max() > max_len:
+            raise ValueError(f"message too long: {int(lens.max())} > {max_len}")
+        buf[:n, :32] = block.sig[:, :32]
+        buf[:n, 32:64] = block.pub
+        total = int(mlens.sum())
+        if total:
+            flat = np.frombuffer(mbuf, dtype=np.uint8, count=total)
+            rows = np.repeat(np.arange(n), mlens)
+            cols = 64 + (np.arange(total) - np.repeat(offs[:-1], mlens))
+            buf[rows, cols] = flat
+    buf[n:, 0] = 1
+    buf[n:, 32] = 1
+    blocks = (lens + 17 + 127) // 128
+    rng = np.arange(bucket)
+    buf[rng, lens] = 0x80
+    bitlen = lens * 8
+    base = blocks * 128 - 8
+    for j in range(8):
+        buf[rng, base + j] = (bitlen >> (8 * (7 - j))) & 0xFF
+    return _buf_to_words(buf, bucket, nblock) + (blocks.astype(np.int32),)
 
 
 def digest_to_bytes(digest) -> np.ndarray:

@@ -409,6 +409,56 @@ class Commit:
                     return native.vote_sign_bytes_batch(prefix, suffix, times)
         return [self.vote_sign_bytes(chain_id, i) for i in idxs]
 
+    def vote_sign_bytes_block(self, chain_id: str, idxs) -> tuple:
+        """Buffer-writing variant of vote_sign_bytes_many: every requested
+        lane's sign bytes composed into ONE contiguous buffer + an
+        (len(idxs)+1,) int64 offset table — the columnar EntryBlock msgs
+        form (ops/entry_block.py). The native composer fills the buffer in
+        a single GIL-released call; the pure-Python fallback is
+        byte-identical (wire/canonical.compose_vote_sign_bytes_block)."""
+        import numpy as np
+
+        idxs = list(idxs)
+        n = len(idxs)
+        if n == 0:
+            return b"", np.zeros(1, dtype=np.int64)
+        flag = self.signatures[idxs[0]].block_id_flag
+        if all(self.signatures[i].block_id_flag == flag for i in idxs):
+            # materialize the (chain_id, flag) template via the
+            # single-lane path once
+            self.vote_sign_bytes(chain_id, idxs[0])
+            prefix, suffix = self._sb_tpl[(chain_id, flag)]
+            from ..native import load as _load_native
+
+            native = _load_native()
+            if native is not None and hasattr(
+                native, "vote_sign_bytes_batch_buf"
+            ):
+                import struct as _struct
+
+                times = b"".join(
+                    _struct.pack(
+                        "<qq",
+                        self.signatures[i].timestamp.seconds,
+                        self.signatures[i].timestamp.nanos,
+                    )
+                    for i in idxs
+                )
+                buf, offs = native.vote_sign_bytes_batch_buf(
+                    prefix, suffix, times
+                )
+                return buf, np.frombuffer(offs, dtype=np.int64)
+            return _canon.compose_vote_sign_bytes_block(
+                (prefix, suffix),
+                [self.signatures[i].timestamp for i in idxs],
+            )
+        # mixed BlockIDFlags (never a single commit's for-block set, but
+        # the API allows it): per-index compose, one join
+        chunks = [self.vote_sign_bytes(chain_id, i) for i in idxs]
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(c) for c in chunks], out=offsets[1:])
+        return b"".join(chunks), offsets
+
     def encode(self) -> bytes:
         w = ProtoWriter()
         w.write_varint(1, self.height)

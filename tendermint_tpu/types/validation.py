@@ -239,32 +239,64 @@ def _verify_commit_batch(
                 break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
-    # one batch sign-bytes composition for all selected lanes (native
-    # composer; the per-lane Python encode was the dominant host cost on
-    # large commits)
-    with _span("verify_commit.sign_bytes", n=len(selected)):
-        sign_bytes = commit.vote_sign_bytes_many(
-            chain_id, [i for i, _ in selected]
-        )
     batch_sig_idxs = [idx for idx, _ in selected]
-    add_many = getattr(bv, "add_entries", None)
-    if add_many is not None:
-        # bulk accumulate in ONE pass: lengths were checked during
-        # selection and the key type during verifier creation, so the
-        # entry build can go straight to wire bytes (every extra
-        # 10k-element pass here is GIL-held and serializes concurrent
-        # commit verifies)
+    add_block = getattr(bv, "add_block", None)
+    if add_block is not None:
+        # Columnar zero-copy path: the sign bytes land in ONE contiguous
+        # buffer + offset table (no per-lane PyBytes), pub/sig join once
+        # into (n, 32)/(n, 64) arrays, and the EntryBlock rides by
+        # reference through the pipeline to the kernel prep. The per-key
+        # TYPE check rides along (`keys`) — a mixed-key validator set
+        # must fail exactly as per-entry add() did.
+        import numpy as _np
+
+        from ..ops.entry_block import EntryBlock
+
+        with _span("verify_commit.sign_bytes", n=len(selected)):
+            buf, offsets = commit.vote_sign_bytes_block(
+                chain_id, batch_sig_idxs
+            )
         sigs_list = commit.signatures
-        add_many(
-            [
-                (val.pub_key, sb, sigs_list[idx].signature)
-                for (idx, val), sb in zip(selected, sign_bytes, strict=True)
-            ],
-            lengths_checked=True,
-        )
+        n_sel = len(selected)
+        keys = [val.pub_key for _, val in selected]
+        pub_b = b"".join(k.bytes() for k in keys)
+        if len(pub_b) != 32 * n_sel:
+            # a wrong-size key (e.g. secp256k1 in an ed25519 set) must
+            # surface as the same error per-entry add() raised, not as a
+            # reshape failure
+            raise TypeError("pubkey is not ed25519")
+        pub = _np.frombuffer(pub_b, dtype=_np.uint8).reshape(n_sel, 32)
+        sig = _np.frombuffer(
+            b"".join(sigs_list[idx].signature for idx, _ in selected),
+            dtype=_np.uint8,
+        ).reshape(n_sel, 64)
+        add_block(EntryBlock(pub, sig, buf, offsets), keys=keys)
     else:
-        for (idx, val), sb in zip(selected, sign_bytes, strict=True):
-            bv.add(val.pub_key, sb, commit.signatures[idx].signature)
+        # one batch sign-bytes composition for all selected lanes (native
+        # composer; the per-lane Python encode was the dominant host cost
+        # on large commits)
+        with _span("verify_commit.sign_bytes", n=len(selected)):
+            sign_bytes = commit.vote_sign_bytes_many(
+                chain_id, [i for i, _ in selected]
+            )
+        add_many = getattr(bv, "add_entries", None)
+        if add_many is not None:
+            # bulk accumulate in ONE pass: lengths were checked during
+            # selection and the key type during verifier creation, so the
+            # entry build can go straight to wire bytes (every extra
+            # 10k-element pass here is GIL-held and serializes concurrent
+            # commit verifies)
+            sigs_list = commit.signatures
+            add_many(
+                [
+                    (val.pub_key, sb, sigs_list[idx].signature)
+                    for (idx, val), sb in zip(selected, sign_bytes, strict=True)
+                ],
+                lengths_checked=True,
+            )
+        else:
+            for (idx, val), sb in zip(selected, sign_bytes, strict=True):
+                bv.add(val.pub_key, sb, commit.signatures[idx].signature)
     with _span("verify_commit.verify", n=len(selected)):
         ok, valid_sigs = bv.verify()
     if ok:

@@ -132,6 +132,106 @@ def compose_vote_sign_bytes(tpl: tuple, timestamp: Timestamp) -> bytes:
     return marshal_delimited(prefix + w.bytes() + suffix)
 
 
+_U64 = (1 << 64) - 1
+
+
+def _compose_one(prefix: bytes, suffix: bytes, ts: "Timestamp") -> bytes:
+    """One record of the block composer's layout (scalar reference)."""
+    from .proto import encode_uvarint
+
+    tb = b""
+    if ts.seconds:
+        tb = b"\x08" + encode_uvarint(ts.seconds & _U64)
+    if ts.nanos:
+        tb += b"\x10" + encode_uvarint(ts.nanos & _U64)
+    body = prefix + b"\x2a" + encode_uvarint(len(tb)) + tb + suffix
+    return encode_uvarint(len(body)) + body
+
+
+def _uvarint_len(v):
+    """(n,) uint64 -> per-value uvarint byte length (numpy)."""
+    import numpy as np
+
+    length = np.ones(v.shape, dtype=np.int64)
+    for k in range(1, 10):
+        length += v >= np.uint64(1 << (7 * k))
+    return length
+
+
+def compose_vote_sign_bytes_block(tpl: tuple, timestamps) -> tuple:
+    """Batch compose_vote_sign_bytes into ONE contiguous buffer: returns
+    (buf, offsets) where buf[offsets[i]:offsets[i+1]] is the i-th vote's
+    sign bytes — the EntryBlock msgs form (ops/entry_block.py), so the
+    verify path never materializes per-signature PyBytes.
+
+    Byte-identical to the per-call composer (differentially tested).
+    Records vary only in the two timestamp varints, so rows group by
+    their (seconds-length, nanos-length) layout — a handful of groups per
+    commit — and each group composes as one broadcast + vectorized varint
+    fill instead of n ProtoWriter walks (~7x at 10k signatures)."""
+    import numpy as np
+
+    prefix, suffix = tpl
+    n = len(timestamps)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    if n == 0:
+        return b"", offsets
+    if n < 64:
+        chunks = [_compose_one(prefix, suffix, ts) for ts in timestamps]
+        np.cumsum([len(c) for c in chunks], out=offsets[1:])
+        return b"".join(chunks), offsets
+
+    secs = np.fromiter(
+        (ts.seconds for ts in timestamps), dtype=np.int64, count=n
+    ).view(np.uint64)
+    nanos = np.fromiter(
+        (ts.nanos for ts in timestamps), dtype=np.int64, count=n
+    ).view(np.uint64)
+    # per-row field layout: 0 length = field omitted (proto3 zero-skip)
+    s_len = np.where(secs != 0, _uvarint_len(secs), 0)
+    n_len = np.where(nanos != 0, _uvarint_len(nanos), 0)
+    tn = (s_len != 0) * (1 + s_len) + (n_len != 0) * (1 + n_len)
+    p_len, x_len = len(prefix), len(suffix)
+    body_len = p_len + 2 + tn + x_len  # 0x2a + 1-byte uvarint(tn) + fields
+    hdr_len = _uvarint_len(body_len.view(np.uint64))
+    rec_len = hdr_len + body_len
+    np.cumsum(rec_len, out=offsets[1:])
+    out = np.zeros(int(offsets[-1]), dtype=np.uint8)
+    pre_arr = np.frombuffer(prefix, dtype=np.uint8)
+    suf_arr = np.frombuffer(suffix, dtype=np.uint8)
+
+    def _fill_varint(dst, col, v, width):
+        for j in range(width):
+            b = (v >> np.uint64(7 * j)) & np.uint64(0x7F)
+            if j < width - 1:
+                b = b | np.uint64(0x80)
+            dst[:, col + j] = b
+        return col + width
+
+    key = (s_len * 1024 + n_len * 16 + hdr_len).astype(np.int64)
+    for k in np.unique(key):
+        rows = np.nonzero(key == k)[0]
+        i0 = rows[0]
+        sl, nl, hl = int(s_len[i0]), int(n_len[i0]), int(hdr_len[i0])
+        rl, bl, t0 = int(rec_len[i0]), int(body_len[i0]), int(tn[i0])
+        arr = np.empty((len(rows), rl), dtype=np.uint8)
+        col = _fill_varint(arr, 0, np.uint64(bl), hl)
+        arr[:, col : col + p_len] = pre_arr
+        col += p_len
+        arr[:, col] = 0x2A
+        arr[:, col + 1] = t0
+        col += 2
+        if sl:
+            arr[:, col] = 0x08
+            col = _fill_varint(arr, col + 1, secs[rows], sl)
+        if nl:
+            arr[:, col] = 0x10
+            col = _fill_varint(arr, col + 1, nanos[rows], nl)
+        arr[:, col:] = suf_arr
+        out[offsets[rows][:, None] + np.arange(rl)] = arr
+    return out.tobytes(), offsets
+
+
 def canonical_proposal_sign_bytes(
     chain_id: str,
     height: int,
