@@ -501,6 +501,19 @@ def worker() -> None:
         except Exception as e:  # noqa: BLE001
             print(f"# mixed-curve bench failed: {e}", file=sys.stderr)
 
+    # Optional closed-loop consensus probe (TM_TPU_BENCH_SIMNET=1): a
+    # 4-node simnet cluster — real state machine + reactor + WAL over the
+    # virtual network — measured in committed heights per wall second.
+    # This exercises the whole host consensus path (sign, gossip, verify,
+    # commit), not just the kernel, so it moves when consensus-side work
+    # regresses even if the device rate holds.
+    simnet_rate = 0.0
+    if os.environ.get("TM_TPU_BENCH_SIMNET"):
+        try:
+            simnet_rate = _bench_simnet()
+        except Exception as e:  # noqa: BLE001
+            print(f"# simnet bench failed: {e}", file=sys.stderr)
+
     out = {
         "metric": f"verify_commit_{n_sigs}",
         "value": round(1.0 / dev_s, 1),
@@ -525,6 +538,7 @@ def worker() -> None:
         "sustained_vs_baseline": round(sus_rate * host_s, 3),
         "mixed_curve_sigs_per_s": round(mixed_rate, 1),
         "pipelined_headers_per_s": round(hdr_rate, 1),
+        "simnet_commits_per_s": round(simnet_rate, 2),
         "span_summary": span_summary,
     }
     print(json.dumps(out))
@@ -683,6 +697,21 @@ def _bench_mixed_curve() -> float:
     res = verify_mixed(entries)
     dt = time.perf_counter() - t0
     return len(entries) / dt
+
+
+def _bench_simnet(height: int = 15) -> float:
+    """simnet throughput probe: 4 real consensus nodes, fixed seed,
+    default links, run to `height`; committed heights per wall second."""
+    from tendermint_tpu.simnet import Cluster
+
+    cluster = Cluster(n_nodes=4, seed=1)
+    try:
+        rep = cluster.run_to_height(height, max_virtual_s=600.0)
+    finally:
+        cluster.stop()  # closes WALs and removes the temp dir even on error
+    if not rep.ok or rep.wall_s <= 0:
+        return 0.0
+    return rep.height / rep.wall_s
 
 
 def _bench_pipelined_headers(on_accel: bool) -> float:

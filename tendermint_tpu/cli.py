@@ -272,20 +272,29 @@ def cmd_replay(args) -> int:
             print(f"unknown command {cmd!r}")
 
 
-def cmd_debug(args) -> int:
-    """debug dump (cmd/tendermint/commands/debug): capture a node's
-    status, consensus state, and net info from its RPC into a directory."""
+_DEBUG_CAPTURE_METHODS = (
+    "status",
+    "net_info",
+    "dump_consensus_state",
+    "consensus_state",
+    "thread_dump",  # goroutine-dump equivalent (rpc.core.thread_dump)
+    "dump_trace",  # flush the observability span ring buffer
+)
+
+
+def _debug_capture(rpc_laddr: str, home: str, out: str) -> list:
+    """Shared capture for `debug dump` and `debug kill`: node state over
+    RPC + the on-disk config."""
     import json as _json
     import urllib.request
 
-    out = args.output_directory
     os.makedirs(out, exist_ok=True)
-    base = args.rpc_laddr
+    base = rpc_laddr
     for prefix in ("tcp://",):
         if base.startswith(prefix):
             base = "http://" + base[len(prefix):]
     captured = []
-    for method in ("status", "net_info", "dump_consensus_state", "consensus_state"):
+    for method in _DEBUG_CAPTURE_METHODS:
         try:
             with urllib.request.urlopen(f"{base}/{method}", timeout=5) as r:
                 data = _json.loads(r.read())
@@ -294,14 +303,37 @@ def cmd_debug(args) -> int:
             captured.append(method)
         except (OSError, ValueError) as e:  # incl. malformed JSON bodies
             print(f"warning: {method} failed: {e}", file=sys.stderr)
-    # include the node config if reachable on disk
-    cfg_path = os.path.join(args.home, "config", "config.toml")
+    cfg_path = os.path.join(home, "config", "config.toml")
     if os.path.exists(cfg_path):
         import shutil
 
         shutil.copy(cfg_path, os.path.join(out, "config.toml"))
         captured.append("config.toml")
-    print(f"captured {captured} into {out}")
+    return captured
+
+
+def cmd_debug(args) -> int:
+    """debug dump|kill (cmd/tendermint/commands/debug): capture a node's
+    status, consensus state, net info, thread dump and span trace from
+    its RPC into a directory; `kill` then SIGKILLs the node process
+    (debug/kill.go: capture-then-kill, so the dump reflects the state the
+    process died in)."""
+    import signal
+
+    mode = getattr(args, "mode", "dump") or "dump"
+    captured = _debug_capture(args.rpc_laddr, args.home, args.output_directory)
+    print(f"captured {captured} into {args.output_directory}")
+    if mode == "kill":
+        if not args.pid:
+            print("debug kill: --pid is required", file=sys.stderr)
+            return 1
+        try:
+            os.kill(args.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError) as e:
+            print(f"debug kill: SIGKILL {args.pid} failed: {e}", file=sys.stderr)
+            return 1
+        print(f"killed pid {args.pid}")
+        return 0
     return 0 if captured else 1
 
 
@@ -645,8 +677,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("replay")
     sp.add_argument("--console", action="store_true")
     sp = sub.add_parser("debug")
+    sp.add_argument("mode", nargs="?", default="dump", choices=["dump", "kill"])
     sp.add_argument("--rpc-laddr", default="http://127.0.0.1:26657")
     sp.add_argument("--output-directory", default="./debug-dump")
+    sp.add_argument("--pid", type=int, default=0, help="process to SIGKILL (kill mode)")
     sub.add_parser("key-migrate")
     sp = sub.add_parser("reindex-event")
     sp.add_argument("--start-height", type=int, default=0)

@@ -65,10 +65,13 @@ class PeerState:
     """Mutable per-peer view, updated from NewRoundStep/HasVote/
     VoteSetBits/NewValidBlock messages and from our own sends."""
 
-    def __init__(self, peer_id: str):
+    def __init__(self, peer_id: str, rng=None):
         self.peer_id = peer_id
         self.prs = PeerRoundState()
         self._mtx = threading.RLock()
+        # gossip-pick randomness source; injectable so a deterministic
+        # driver (simnet) can seed it — default keeps the global PRNG
+        self._rng = rng if rng is not None else random
 
     # -- applying messages from the peer --------------------------------
 
@@ -303,6 +306,15 @@ class PeerState:
         n_vals = len(votes.votes)
         height, round_, type_ = votes.height, votes.round, votes.signed_msg_type
         with self._mtx:
+            if votes.is_commit():
+                # the set is a commit (vote_set.go IsCommit: PRECOMMITs
+                # with a +2/3 block): a peer stuck in a LATER round of
+                # this height can still take these round-`round_`
+                # precommits — track them in the catchup bits
+                # (peer_state.go PickVoteToSend → ensureCatchUpCommit-
+                # Round). Without this, a laggard whose round advanced
+                # past the commit round never gets served and wedges.
+                self.ensure_catchup_commit_round(height, round_, n_vals)
             self._ensure_vote_bits_locked(height, round_, type_, n_vals)
             peer_bits = self._get_vote_bits_locked(height, round_, type_)
             if peer_bits is None:
@@ -311,7 +323,7 @@ class PeerState:
             idx_list = missing.get_true_indices()
             if not idx_list:
                 return None
-            idx = random.choice(idx_list)
+            idx = self._rng.choice(idx_list)
             return votes.get_by_index(idx)
 
     def init_proposal_block_parts(self, psh: PartSetHeader) -> None:
@@ -351,7 +363,7 @@ class PeerState:
             idx_list = missing.get_true_indices()
             if not idx_list:
                 return None
-            idx = random.choice(idx_list)
+            idx = self._rng.choice(idx_list)
             return commit_to_vote(commit, idx)
 
     def set_has_catchup_commit_vote(self, height: int, round_: int, index: int) -> None:

@@ -88,9 +88,12 @@ class ConsensusReactor:
     GOSSIP_INTERVAL = 0.05
     QUERY_MAJ23_INTERVAL = 2.0
 
-    def __init__(self, cs: ConsensusState, router: Router, block_store=None):
+    def __init__(self, cs: ConsensusState, router: Router, block_store=None, rng=None):
         self._cs = cs
         self._router = router
+        # randomness for per-peer gossip picks (PeerState); injectable so
+        # simnet's seeded PRNG makes whole-cluster runs replayable
+        self._rng = rng
         self._block_store = (
             block_store if block_store is not None else getattr(cs, "_block_store", None)
         )
@@ -104,17 +107,18 @@ class ConsensusReactor:
         self._peers_mtx = threading.Lock()
         self._last_nrs = None  # last broadcast (height, round, step, lcr)
         self._last_nvb = None  # last broadcast NewValidBlock key
+        self._handlers = {
+            DATA_CHANNEL: self._handle_data,
+            VOTE_CHANNEL: self._handle_vote,
+            STATE_CHANNEL: self._handle_state,
+            VOTE_SET_BITS_CHANNEL: self._handle_vsb,
+        }
         cs.broadcast_hooks.append(self._broadcast_own)
         cs.vote_added_hooks.append(self._broadcast_has_vote)
 
     def start(self) -> None:
-        for ch, handler in (
-            (self._data_ch, self._handle_data),
-            (self._vote_ch, self._handle_vote),
-            (self._state_ch, self._handle_state),
-            (self._vsb_ch, self._handle_vsb),
-        ):
-            t = threading.Thread(target=self._process, args=(ch, handler), daemon=True)
+        for ch in (self._data_ch, self._vote_ch, self._state_ch, self._vsb_ch):
+            t = threading.Thread(target=self._process, args=(ch,), daemon=True)
             t.start()
             self._threads.append(t)
         for target in (self._peer_update_routine, self._gossip_routine):
@@ -134,19 +138,28 @@ class ConsensusReactor:
                 upd = updates.get(timeout=0.5)
             except _q.Empty:
                 continue
-            send_to = None
-            with self._peers_mtx:
-                if upd.status == "up":
-                    if upd.node_id not in self._peers:
-                        self._peers[upd.node_id] = PeerState(upd.node_id)
-                    send_to = upd.node_id
-                elif upd.status == "down":
-                    self._peers.pop(upd.node_id, None)
-            if send_to is not None:
-                # network send OUTSIDE the peers lock — a full send queue
-                # blocks up to the mconn timeout and every inbound handler
-                # takes this lock per message
-                self._send_new_round_step(send_to)
+            if upd.status == "up":
+                self.add_peer(upd.node_id)
+            elif upd.status == "down":
+                self.remove_peer(upd.node_id)
+
+    def add_peer(self, peer_id: str) -> None:
+        """Register a peer for gossip and advertise our round state
+        (reactor.go AddPeer). Also the simnet seam: a deterministic driver
+        calls this directly instead of running _peer_update_routine."""
+        with self._peers_mtx:
+            if peer_id not in self._peers:
+                self._peers[peer_id] = PeerState(peer_id, rng=self._rng)
+        # network send OUTSIDE the peers lock — a full send queue
+        # blocks up to the mconn timeout and every inbound handler
+        # takes this lock per message
+        self._send_new_round_step(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        """Forget a peer's round state (reactor.go RemovePeer); a
+        reconnect starts from a fresh PeerState."""
+        with self._peers_mtx:
+            self._peers.pop(peer_id, None)
 
     def _peer_list(self):
         with self._peers_mtx:
@@ -156,7 +169,7 @@ class ConsensusReactor:
         with self._peers_mtx:
             ps = self._peers.get(peer_id)
             if ps is None:
-                ps = self._peers[peer_id] = PeerState(peer_id)
+                ps = self._peers[peer_id] = PeerState(peer_id, rng=self._rng)
             return ps
 
     # -- NewRoundStep / HasVote broadcasting ------------------------------
@@ -171,7 +184,10 @@ class ConsensusReactor:
         w.write_varint(1, h)
         w.write_varint(2, r)
         w.write_varint(3, s)
-        w.write_varint(4, max(int(_t.time() - start_time), 0))
+        # seconds-since-start on the STATE MACHINE's clock — under a
+        # virtual clock (simnet) wall time would leak nondeterministic
+        # bytes into the wire message
+        w.write_varint(4, max(int(self._cs._now() - start_time), 0))
         w.write_varint(5, lcr)
         return _wrap(1, w.bytes())
 
@@ -221,21 +237,35 @@ class ConsensusReactor:
 
     # -- gossip loop (the per-peer goroutines, folded) --------------------
 
+    def gossip_once(self, query_maj23: bool = False) -> None:
+        """One gossip sweep over all peers — one iteration of the
+        reference's per-peer goroutines. The threaded path loops this; a
+        deterministic driver (simnet) calls it on its own schedule."""
+        if query_maj23:
+            # periodic refresh of the one-shot advertisements: on a lossy
+            # link a dropped NewRoundStep/NewValidBlock would otherwise
+            # never be re-sent (the last-key guards suppress it) and a
+            # laggard's catchup wedges forever — found by simnet's drop
+            # fault; the reference leans on TCP for this
+            self._last_nrs = None
+            self._last_nvb = None
+        self._maybe_broadcast_new_round_step()
+        self._maybe_broadcast_new_valid_block()
+        for ps in self._peer_list():
+            self._gossip_data(ps)
+            self._gossip_votes(ps)
+            if query_maj23:
+                self._query_maj23(ps)
+
     def _gossip_routine(self) -> None:
         last_maj23 = 0.0
         while not self._stopped.is_set():
             _t.sleep(self.GOSSIP_INTERVAL)
             try:
-                self._maybe_broadcast_new_round_step()
-                self._maybe_broadcast_new_valid_block()
                 query_maj23 = _t.time() - last_maj23 >= self.QUERY_MAJ23_INTERVAL
                 if query_maj23:
                     last_maj23 = _t.time()
-                for ps in self._peer_list():
-                    self._gossip_data(ps)
-                    self._gossip_votes(ps)
-                    if query_maj23:
-                        self._query_maj23(ps)
+                self.gossip_once(query_maj23)
             except Exception:  # noqa: BLE001 — gossip must never die
                 continue
 
@@ -424,16 +454,26 @@ class ConsensusReactor:
 
     # -- inbound --------------------------------------------------------
 
-    def _process(self, ch, handler) -> None:
+    def handle_envelope(self, env) -> bool:
+        """Dispatch one inbound envelope to its channel handler; bad peer
+        messages are swallowed (the router would ban). Shared by the
+        threaded _process loops and the simnet's synchronous delivery."""
+        handler = self._handlers.get(env.channel_id)
+        if handler is None:
+            return False
+        try:
+            handler(env)
+        except (ValueError, KeyError):
+            return False  # bad peer message: ignore (router would ban)
+        return True
+
+    def _process(self, ch) -> None:
         while not self._stopped.is_set():
             try:
                 env = ch.receive(timeout=0.5)
             except _q.Empty:
                 continue
-            try:
-                handler(env)
-            except (ValueError, KeyError):
-                continue  # bad peer message: ignore (router would ban)
+            self.handle_envelope(env)
 
     def _handle_data(self, env) -> None:
         """reactor.go:1087 handleDataMessage."""

@@ -56,8 +56,7 @@ from .types import (
 from .wal import WAL, WALMessage
 
 
-def _now_ts() -> Timestamp:
-    t = _time.time()
+def _ts_from_float(t: float) -> Timestamp:
     sec = int(t)
     return Timestamp(seconds=sec, nanos=int((t - sec) * 1e9))
 
@@ -98,9 +97,15 @@ class ConsensusState(BaseService):
         wal: Optional[WAL] = None,
         priv_validator=None,
         metrics=None,  # libs.metrics.ConsensusMetrics (None = no-op)
+        clock=None,  # injectable time source (simnet); None = wall clock
     ):
         super().__init__("ConsensusState")
         self._cfg = config
+        # All reads of "now" inside the state machine (round start times,
+        # commit times, vote timestamps) go through self._now so a virtual
+        # clock can drive the whole machine deterministically.
+        self._clock = clock
+        self._now: Callable[[], float] = clock.time if clock is not None else _time.time
         self._block_exec = block_exec
         self._block_store = block_store
         self._mempool = mempool
@@ -118,10 +123,20 @@ class ConsensusState(BaseService):
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=1000)
         self._internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
-        self._ticker = TimeoutTicker(self._tock)
+        # Wakes the receive routine when either queue gains a message —
+        # a blocking wait instead of a poll (same pattern as the ops
+        # pipeline worker). on_enqueue is the external-driver (simnet)
+        # hook: called after every enqueue so a scheduler can pump
+        # process_pending() instead of running the thread.
+        self._msg_ready = threading.Event()
+        self.on_enqueue: Optional[Callable[[], None]] = None
+        # Committed-height watchers block here rather than sleep-polling
+        # (kills ~50 wakeups/s/node that wait_for_height used to cost).
+        self._commit_cond = threading.Condition()
+        self._ticker = TimeoutTicker(self._tock, clock=clock)
         self._thread: Optional[threading.Thread] = None
         self._done_first_block = threading.Event()
-        self._height_events: List[Callable] = []  # test hooks per committed height
+        self._height_events: List[Callable] = []  # hooks per committed height
 
         # byzantine-test overrides (common_test.go decideProposal/doPrevote)
         self.decide_proposal_override: Optional[Callable] = None
@@ -142,37 +157,70 @@ class ConsensusState(BaseService):
     # lifecycle
 
     def on_start(self) -> None:
-        self._reconstruct_last_commit()
-        if self._wal is not None:
-            self._wal.start()
-            self._replay_wal()
+        self._start_common()
         self._thread = threading.Thread(target=self._receive_routine, daemon=True)
         self._thread.start()
         # start the height's round 0 after commit-timeout from start_time
         self._schedule_round_0()
 
+    def start_stepped(self) -> None:
+        """on_start without the receive thread: WAL replay + round-0
+        scheduling only. For an external event-driven driver (the simnet
+        scheduler) that pumps process_pending() off the on_enqueue hook —
+        the whole state machine then runs single-threaded and
+        deterministically."""
+        self._start_common()
+        self._schedule_round_0()
+
+    def _start_common(self) -> None:
+        self._reconstruct_last_commit()
+        if self._wal is not None:
+            self._wal.start()
+            self._replay_wal()
+
     def on_stop(self) -> None:
         self._ticker.stop()
         self._queue.put(("quit", None))
+        self._msg_ready.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._wal is not None:
+            self._wal.stop()
+
+    def stop_stepped(self) -> None:
+        """Tear down a start_stepped() node (ticker + WAL; no thread)."""
+        self._quit.set()
+        self._ticker.stop()
         if self._wal is not None:
             self._wal.stop()
 
     # ------------------------------------------------------------------
     # external inputs
 
+    def _wake(self) -> None:
+        self._msg_ready.set()
+        hook = self.on_enqueue
+        if hook is not None:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a driver bug must not break enqueue
+                pass
+
     def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
         self._queue.put((ProposalMessage(proposal), peer_id))
+        self._wake()
 
     def add_block_part(self, height: int, round_: int, part: Part, peer_id: str = "") -> None:
         self._queue.put((BlockPartMessage(height, round_, part), peer_id))
+        self._wake()
 
     def add_vote_msg(self, vote: Vote, peer_id: str = "") -> None:
         self._queue.put((VoteMessage(vote), peer_id))
+        self._wake()
 
     def _send_internal(self, msg) -> None:
         self._internal_queue.put((msg, ""))
+        self._wake()
         for hook in self.broadcast_hooks:
             try:
                 hook(msg)
@@ -180,14 +228,17 @@ class ConsensusState(BaseService):
                 pass
 
     def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        """Block until the committed chain reaches `height` — on a
+        condition signalled per commit, not a sleep-poll."""
         deadline = _time.time() + timeout
-        while _time.time() < deadline:
-            if self._state.last_block_height >= height:
-                return
-            _time.sleep(0.02)
-        raise TimeoutError(
-            f"height {height} not reached; at {self._state.last_block_height}"
-        )
+        with self._commit_cond:
+            while self._state.last_block_height < height:
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"height {height} not reached; at {self._state.last_block_height}"
+                    )
+                self._commit_cond.wait(remaining)
 
     @property
     def committed_state(self) -> State:
@@ -196,30 +247,66 @@ class ConsensusState(BaseService):
     # ------------------------------------------------------------------
     # the receive routine (state.go:757-850)
 
+    def _pop_msg(self):
+        """Next queued (msg, peer_id), internal queue first (own
+        proposal/votes take priority, state.go:772), or None."""
+        try:
+            return self._internal_queue.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _dispatch(self, msg, peer_id: str) -> None:
+        """WAL-log then handle one message — shared by the receive thread
+        and the stepped (simnet) driver."""
+        if isinstance(msg, TimeoutInfo):
+            self._wal_write(WALMessage(timeout=(
+                int(msg.duration * 1000), msg.height, msg.round, msg.step)))
+            self._handle_timeout(msg)
+        else:
+            self._wal_write_msg(msg, peer_id)
+            try:
+                self._handle_msg(msg, peer_id)
+            except Exception:  # noqa: BLE001 — a bad peer message must not kill consensus
+                import traceback
+
+                traceback.print_exc()
+
+    def process_pending(self, max_msgs: Optional[int] = None) -> int:
+        """Drain queued messages synchronously; returns how many were
+        processed. The stepped-mode pump: an external scheduler calls this
+        off the on_enqueue hook instead of running _receive_routine."""
+        n = 0
+        while max_msgs is None or n < max_msgs:
+            if self._quit.is_set():
+                break
+            item = self._pop_msg()
+            if item is None:
+                break
+            msg, peer_id = item
+            if msg == "quit":
+                break
+            self._dispatch(msg, peer_id)
+            n += 1
+        return n
+
     def _receive_routine(self) -> None:
         while not self._quit.is_set():
-            # internal queue drains first (own proposal/votes)
-            try:
-                msg, peer_id = self._internal_queue.get_nowait()
-            except queue.Empty:
-                try:
-                    msg, peer_id = self._queue.get(timeout=0.2)
-                except queue.Empty:
+            item = self._pop_msg()
+            if item is None:
+                # blocking wait, woken by _wake() on any enqueue; the
+                # timeout only bounds the _quit re-check
+                if not self._msg_ready.wait(timeout=0.2):
                     continue
+                self._msg_ready.clear()
+                continue
+            msg, peer_id = item
             if msg == "quit":
                 return
-            if isinstance(msg, TimeoutInfo):
-                self._wal_write(WALMessage(timeout=(
-                    int(msg.duration * 1000), msg.height, msg.round, msg.step)))
-                self._handle_timeout(msg)
-            else:
-                self._wal_write_msg(msg, peer_id)
-                try:
-                    self._handle_msg(msg, peer_id)
-                except Exception as e:  # noqa: BLE001 — a bad peer message must not kill consensus
-                    import traceback
-
-                    traceback.print_exc()
+            self._dispatch(msg, peer_id)
 
     def _wal_write(self, rec: WALMessage) -> None:
         if self._wal is not None:
@@ -264,6 +351,7 @@ class ConsensusState(BaseService):
     def _tock(self, ti: TimeoutInfo) -> None:
         """Ticker callback → queue (state.go timeoutRoutine → tockChan)."""
         self._queue.put((ti, ""))
+        self._wake()
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """state.go:923-1005."""
@@ -325,7 +413,7 @@ class ConsensusState(BaseService):
         if rs.commit_time:
             rs.start_time = rs.commit_time + self._cfg.commit_timeout()
         else:
-            rs.start_time = _time.time() + self._cfg.commit_timeout()
+            rs.start_time = self._now() + self._cfg.commit_timeout()
         rs.validators = validators
         rs.proposal = None
         rs.proposal_block = None
@@ -344,7 +432,7 @@ class ConsensusState(BaseService):
         self._state = state
 
     def _schedule_round_0(self) -> None:
-        sleep = max(self.rs.start_time - _time.time(), 0.0)
+        sleep = max(self.rs.start_time - self._now(), 0.0)
         self._ticker.schedule_timeout(
             TimeoutInfo(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
         )
@@ -444,7 +532,7 @@ class ConsensusState(BaseService):
             round=round_,
             pol_round=rs.valid_round,
             block_id=block_id,
-            timestamp=_now_ts(),
+            timestamp=_ts_from_float(self._now()),
         )
         try:
             proposal = self._priv_validator.sign_proposal(self._state.chain_id, proposal)
@@ -600,7 +688,7 @@ class ConsensusState(BaseService):
         rs.round = rs.round  # unchanged by commit
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
-        rs.commit_time = _time.time()
+        rs.commit_time = self._now()
         self._new_step_event()
         precommits = rs.votes.precommits(commit_round)
         block_id, ok = precommits.two_thirds_majority()
@@ -661,6 +749,13 @@ class ConsensusState(BaseService):
         # NewHeight: updateToState + schedule round 0
         self._update_to_state(new_state)
         self._done_first_block.set()
+        with self._commit_cond:
+            self._commit_cond.notify_all()
+        for hook in self._height_events:
+            try:
+                hook(height)
+            except Exception:  # noqa: BLE001 — observer hooks must not break commit
+                pass
         self._schedule_round_0()
 
     def _record_metrics(self, block, block_parts) -> None:
@@ -891,7 +986,7 @@ class ConsensusState(BaseService):
 
     def _vote_time(self) -> Timestamp:
         """state.go voteTime: max(now, lastBlockTime + 1ns-ish)."""
-        now = _now_ts()
+        now = _ts_from_float(self._now())
         lbt = self._state.last_block_time
         min_time = Timestamp(seconds=lbt.seconds, nanos=lbt.nanos + 1)
         if min_time.nanos >= 10**9:

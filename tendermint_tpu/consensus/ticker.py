@@ -4,6 +4,12 @@ Reference parity: internal/consensus/ticker.go — one active timeout at a
 time, scheduled timeouts for earlier (height, round, step) are ignored,
 newer ones replace the pending timer (timeoutRoutine:80-130). Fired
 timeouts are delivered through a callback into the receive loop's queue.
+
+The timer source is injectable: by default timeouts ride a
+threading.Timer (wall clock); a simulation clock (simnet.clock.SimClock)
+can be passed instead, in which case timeouts fire at *virtual* time from
+the simulator's single-threaded event loop — the seam that makes a whole
+cluster deterministically replayable.
 """
 
 from __future__ import annotations
@@ -25,12 +31,18 @@ class TimeoutInfo:
 
 
 class TimeoutTicker:
-    """ticker.go:17-60."""
+    """ticker.go:17-60.
 
-    def __init__(self, on_timeout: Callable[[TimeoutInfo], None]):
+    `clock`, when given, must provide `call_later(delay, fn)` returning a
+    handle with `.cancel()` (duck-compatible with threading.Timer). All
+    wall-clock knowledge of the consensus timer lives behind it.
+    """
+
+    def __init__(self, on_timeout: Callable[[TimeoutInfo], None], clock=None):
         self._on_timeout = on_timeout
+        self._clock = clock
         self._mtx = threading.Lock()
-        self._timer: Optional[threading.Timer] = None
+        self._timer = None  # threading.Timer or clock timer handle
         self._pending: Optional[TimeoutInfo] = None
         self._stopped = False
 
@@ -44,9 +56,14 @@ class TimeoutTicker:
             if self._timer is not None:
                 self._timer.cancel()
             self._pending = ti
-            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
-            self._timer.daemon = True
-            self._timer.start()
+            if self._clock is not None:
+                self._timer = self._clock.call_later(
+                    ti.duration, lambda ti=ti: self._fire(ti)
+                )
+            else:
+                self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
+                self._timer.daemon = True
+                self._timer.start()
 
     def _fire(self, ti: TimeoutInfo) -> None:
         with self._mtx:

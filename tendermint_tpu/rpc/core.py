@@ -193,6 +193,27 @@ class Environment:
     def health(self) -> dict:
         return {}
 
+    def thread_dump(self) -> dict:
+        """The goroutine-dump equivalent (the reference's debug command
+        captures pprof goroutine profiles): every live thread's stack,
+        for `debug kill` captures and hang diagnosis — a stuck verify
+        path shows up here without attaching a debugger."""
+        import sys as _sys
+        import threading as _threading
+        import traceback as _traceback
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        threads = []
+        for ident, frame in sorted(_sys._current_frames().items()):
+            threads.append(
+                {
+                    "id": ident,
+                    "name": names.get(ident, "?"),
+                    "stack": _traceback.format_stack(frame),
+                }
+            )
+        return {"n_threads": len(threads), "threads": threads}
+
     def dump_trace(self, summary: bool = False) -> dict:
         """Live span-trace introspection (num_unconfirmed_txs-style
         read-only endpoint): the tracer ring buffer as Chrome-trace JSON
@@ -609,5 +630,7 @@ ROUTES = [
     "dump_trace",
 ]
 
-# routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured
-UNSAFE_ROUTES = ["unsafe_flush_mempool"]
+# routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured.
+# thread_dump exposes every thread's stack (paths, code layout): operator
+# tooling only, like the reference's separately-gated pprof listener.
+UNSAFE_ROUTES = ["unsafe_flush_mempool", "thread_dump"]

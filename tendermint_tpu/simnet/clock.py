@@ -1,0 +1,136 @@
+"""Virtual clock + deterministic discrete-event scheduler.
+
+The heart of simnet: a single-threaded event loop over virtual time. All
+consensus timeouts (consensus.ticker.TimeoutTicker), message deliveries
+(simnet.transport.SimNetwork) and fault triggers are events on one heap,
+ordered by (virtual_time, seq) — seq is the scheduling order, so ties
+break stably and a run is a pure function of (seed, topology, schedule).
+
+One seeded PRNG lives here and is the ONLY source of randomness in a
+simulation (latency jitter, drop/duplicate decisions): same seed ⇒ same
+draws in the same order ⇒ byte-identical runs.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable, Optional
+
+# Virtual epoch: after the test-genesis times used across the repo
+# (1_700_000_000) so block-time monotonicity vs genesis holds at height 1.
+DEFAULT_START = 1_700_000_100.0
+
+
+class VirtualTimer:
+    """Handle returned by call_later/call_at; duck-compatible with
+    threading.Timer for the consensus ticker's cancel path."""
+
+    __slots__ = ("when", "seq", "fn", "cancelled")
+
+    def __init__(self, when: float, seq: int, fn: Callable[[], None]):
+        self.when = when
+        self.seq = seq
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other: "VirtualTimer") -> bool:
+        return (self.when, self.seq) < (other.when, other.seq)
+
+
+class SimClock:
+    """Virtual time + event heap + the simulation's seeded PRNG."""
+
+    def __init__(self, seed: int = 0, start: float = DEFAULT_START):
+        self._t = float(start)
+        self._heap: list = []
+        self._seq = 0
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.events_run = 0
+
+    # -- time source (ConsensusState/NodeClock read side) ----------------
+
+    def time(self) -> float:
+        return self._t
+
+    # -- scheduling ------------------------------------------------------
+
+    def call_later(self, delay: float, fn: Callable[[], None]) -> VirtualTimer:
+        return self.call_at(self._t + max(float(delay), 0.0), fn)
+
+    def call_at(self, when: float, fn: Callable[[], None]) -> VirtualTimer:
+        if when < self._t:
+            when = self._t
+        self._seq += 1
+        t = VirtualTimer(when, self._seq, fn)
+        heapq.heappush(self._heap, t)
+        return t
+
+    def cancel(self, timer: VirtualTimer) -> None:
+        timer.cancel()
+
+    def pending(self) -> int:
+        return sum(1 for t in self._heap if not t.cancelled)
+
+    # -- the loop --------------------------------------------------------
+
+    def run_until(
+        self,
+        predicate: Optional[Callable[[], bool]] = None,
+        deadline: Optional[float] = None,
+        max_events: Optional[int] = None,
+    ) -> bool:
+        """Run events in order until `predicate()` is true (checked after
+        each event), virtual `deadline` passes, the heap drains, or
+        `max_events` fire. Returns predicate status (True also when no
+        predicate was given and the loop ended for another reason)."""
+        n = 0
+        if predicate is not None and predicate():
+            return True
+        while self._heap:
+            if max_events is not None and n >= max_events:
+                return predicate() if predicate is not None else False
+            t = heapq.heappop(self._heap)
+            if t.cancelled:
+                continue
+            if deadline is not None and t.when > deadline:
+                heapq.heappush(self._heap, t)  # leave it for a later run
+                self._t = deadline
+                return predicate() if predicate is not None else True
+            self._t = t.when
+            n += 1
+            self.events_run += 1
+            t.fn()  # may schedule more events / read self.rng
+            if predicate is not None and predicate():
+                return True
+        return predicate() if predicate is not None else True
+
+    def run_for(self, dt: float) -> None:
+        self.run_until(deadline=self._t + dt)
+
+
+class NodeClock:
+    """Per-node view of the shared SimClock with an adjustable skew —
+    clock-skew faults shift what a node *reads* as "now" (vote/proposal
+    timestamps, round start times) without touching timer durations,
+    exactly the failure mode of a drifting wall clock."""
+
+    def __init__(self, base: SimClock, skew: float = 0.0):
+        self._base = base
+        self.skew = skew
+
+    def time(self) -> float:
+        return self._base.time() + self.skew
+
+    def call_later(self, delay: float, fn: Callable[[], None]) -> VirtualTimer:
+        return self._base.call_later(delay, fn)
+
+    def call_at(self, when: float, fn: Callable[[], None]) -> VirtualTimer:
+        return self._base.call_at(when - self.skew, fn)
+
+    def cancel(self, timer: VirtualTimer) -> None:
+        self._base.cancel(timer)

@@ -1,0 +1,163 @@
+"""Declarative fault schedules for simnet runs.
+
+A schedule is a list of Fault records, each with one trigger — a commit
+height (`at_height`: fires when the first correct node commits that
+height) or a virtual time offset (`at_time`: seconds after sim start) —
+and an optional `duration` after which the inverse action runs
+automatically (heal a partition, restart a crashed node).
+
+Kinds:
+  partition    split nodes into isolated groups (`groups` of node indices)
+  heal         drop the active partition
+  crash        kill a node mid-flight: its in-memory state is discarded,
+               its WAL/stores survive (the "disk"), in-flight messages to
+               and from it vanish
+  restart      rebuild a crashed node from its WAL + stores and rejoin
+  clock_skew   shift what one node reads as "now" by `skew` seconds
+  double_sign  make a node's vote source byzantine: it signs and gossips
+               two conflicting prevotes per round (equivocation)
+
+JSON form (tools/simnet_run.py --faults): a list of objects with the
+same field names, e.g.
+  [{"kind": "partition", "at_height": 5, "groups": [[0, 1], [2, 3]],
+    "duration": 2.0},
+   {"kind": "crash", "at_height": 8, "node": 2, "restart_after": 1.0}]
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, replace as _dc_replace
+from typing import List, Optional, Sequence
+
+
+@dataclass
+class Fault:
+    kind: str
+    at_height: Optional[int] = None
+    at_time: Optional[float] = None
+    node: Optional[int] = None
+    groups: Optional[List[List[int]]] = None
+    duration: Optional[float] = None  # partition: heal after
+    restart_after: Optional[float] = None  # crash: restart after
+    skew: float = 0.0
+
+    VALID_KINDS = (
+        "partition",
+        "heal",
+        "crash",
+        "restart",
+        "clock_skew",
+        "double_sign",
+    )
+
+    def validate(self, n_nodes: int) -> None:
+        if self.kind not in self.VALID_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.at_height is None and self.at_time is None and self.kind != "double_sign":
+            raise ValueError(f"{self.kind}: needs at_height or at_time")
+        if self.kind == "partition" and not self.groups:
+            raise ValueError("partition: needs groups")
+        if self.kind in ("crash", "restart", "clock_skew", "double_sign"):
+            if self.node is None or not 0 <= self.node < n_nodes:
+                raise ValueError(f"{self.kind}: needs node in 0..{n_nodes - 1}")
+        if self.groups:
+            for g in self.groups:
+                for i in g:
+                    if not 0 <= i < n_nodes:
+                        raise ValueError(f"partition: node {i} out of range")
+
+
+def parse_faults(raw: Sequence[dict]) -> List[Fault]:
+    out = []
+    for obj in raw:
+        known = {f for f in Fault.__dataclass_fields__}
+        extra = set(obj) - known
+        if extra:
+            raise ValueError(f"unknown fault fields: {sorted(extra)}")
+        out.append(Fault(**obj))
+    return out
+
+
+# -- canned schedules --------------------------------------------------------
+
+
+def partition_heal_schedule(
+    n_nodes: int, at_height: int = 5, duration: float = 3.0
+) -> List[Fault]:
+    """Split the cluster down the middle (minority/majority for odd n) at
+    `at_height`, heal after `duration` virtual seconds. With 4 nodes a
+    2/2 split has no quorum on either side — progress must stall, then
+    resume on heal."""
+    half = n_nodes // 2
+    groups = [list(range(half)), list(range(half, n_nodes))]
+    return [
+        Fault(kind="partition", at_height=at_height, groups=groups, duration=duration)
+    ]
+
+
+def crash_restart_schedule(
+    node: int, at_height: int = 8, restart_after: float = 1.0
+) -> List[Fault]:
+    return [
+        Fault(kind="crash", at_height=at_height, node=node, restart_after=restart_after)
+    ]
+
+
+def smoke_schedule(n_nodes: int) -> List[Fault]:
+    """The tier-1 smoke run: partition-and-heal, then one crash +
+    WAL-restart — the acceptance scenario."""
+    return partition_heal_schedule(n_nodes, at_height=3, duration=2.0) + (
+        crash_restart_schedule(n_nodes - 1, at_height=6, restart_after=1.0)
+    )
+
+
+# -- byzantine vote source ---------------------------------------------------
+
+
+def make_double_sign_prevote(priv_key, chain_id: str):
+    """A do_prevote_override that equivocates: signs the honest prevote
+    AND a conflicting prevote for a fabricated block, gossiping both.
+    Bypasses the FilePV last-sign-state on purpose — that guard is
+    exactly what a byzantine validator ignores. Correct peers keep one of
+    the two (first to arrive) and flag the other as conflicting
+    (ErrVoteConflictingVotes → duplicate-vote evidence when an evidence
+    pool is wired)."""
+    from ..consensus.state import VoteMessage
+    from ..types import BlockID
+    from ..types.block import PartSetHeader
+    from ..types.vote import PREVOTE_TYPE, Vote
+
+    addr = priv_key.pub_key().address()
+
+    def override(cs, height: int, round_: int) -> None:
+        rs = cs.rs
+        idx, val = rs.validators.get_by_address(addr)
+        if val is None:
+            return
+        if rs.proposal_block is not None and rs.proposal_block_parts is not None:
+            honest_bid = BlockID(
+                hash=rs.proposal_block.hash(),
+                part_set_header=rs.proposal_block_parts.header(),
+            )
+        else:
+            honest_bid = BlockID()  # nil prevote
+        fake = hashlib.sha256(b"equivocate|%d|%d" % (height, round_)).digest()
+        evil_bid = BlockID(
+            hash=fake, part_set_header=PartSetHeader(total=1, hash=fake)
+        )
+        ts = cs._vote_time()
+        for bid in (honest_bid, evil_bid):
+            v = Vote(
+                type=PREVOTE_TYPE,
+                height=height,
+                round=round_,
+                block_id=bid,
+                timestamp=ts,
+                validator_address=addr,
+                validator_index=idx,
+            )
+            v = _dc_replace(v, signature=priv_key.sign(v.sign_bytes(chain_id)))
+            cs._send_internal(VoteMessage(v))
+
+    return override

@@ -1,0 +1,565 @@
+"""Cluster builder + run driver + invariant checkers.
+
+Drives N REAL consensus nodes — consensus.state.ConsensusState +
+consensus.reactor.ConsensusReactor + consensus.wal.WAL + the crypto.batch
+verify path — single-threaded over a virtual network and a virtual clock.
+Nothing is mocked below the transport: proposals, block parts, votes and
+commits flow through the same code a production node runs; only threads,
+sockets and the wall clock are replaced by the SimClock event loop.
+
+Determinism contract: a run is a pure function of
+(seed, n_nodes, link config, fault schedule, consensus config, txs).
+`fingerprint()` digests the committed chain; `SimNetwork.schedule_digest`
+digests the delivery order. Same seed ⇒ both identical; different seed ⇒
+the schedule digest differs (and usually the fingerprint too, through
+vote timestamps).
+
+Crash model: a crashed node loses everything in memory; its WAL file,
+block/state/app stores (the "disk") and its privval last-sign-state
+survive. Restart rebuilds the node from those — the real WAL-replay
+recovery path — and the invariant sweep then requires its chain to
+reconverge with the cluster.
+
+Invariants (Tendermint safety, checked live at every commit):
+  agreement       every node that commits height h commits the same block
+  quorum          every stored commit carries >2/3 of voting power
+  monotonicity    a node's committed height never goes backwards
+  convergence     after the run, every node's chain is a prefix of the
+                  agreed canonical chain (covers WAL-replay recovery)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import time as _wall
+from dataclasses import dataclass, field as _field
+from typing import Dict, List, Optional
+
+from .clock import NodeClock, SimClock
+from .faults import Fault, make_double_sign_prevote
+from .transport import LinkConfig, SimNetwork, SimRouter
+
+CHAIN_ID = "simnet-chain"
+GENESIS_SECONDS = 1_700_000_000
+
+
+def _default_config():
+    from ..config import ConsensusConfig
+
+    return ConsensusConfig(
+        timeout_propose_ms=400,
+        timeout_propose_delta_ms=100,
+        timeout_prevote_ms=200,
+        timeout_prevote_delta_ms=100,
+        timeout_precommit_ms=200,
+        timeout_precommit_delta_ms=100,
+        timeout_commit_ms=100,
+        skip_timeout_commit=False,
+    )
+
+
+@dataclass
+class SimReport:
+    ok: bool
+    reason: str
+    height: int
+    heights: List[int]
+    fingerprint: str
+    schedule_digest: str
+    violations: List[str]
+    seed: int
+    virtual_s: float
+    wall_s: float
+    events_run: int
+    net: dict
+    faults_applied: List[str] = _field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class SimNode:
+    """One simulated validator: persistent 'disk' + rebuildable runtime."""
+
+    def __init__(self, cluster: "Cluster", idx: int):
+        from ..crypto import ed25519
+        from ..db import MemDB
+        from ..privval import FilePV
+
+        self.cluster = cluster
+        self.idx = idx
+        self.node_id = f"sim{idx}"
+        self.sk = ed25519.gen_priv_key(bytes([idx + 1]) * 32)
+        # The "disk": survives crashes. The FilePV instance doubles as the
+        # persisted last-sign-state file (double-sign protection must hold
+        # across a crash/restart, privval file.go).
+        self.pv = FilePV(self.sk)
+        self.app_db = MemDB()
+        self.state_db = MemDB()
+        self.block_db = MemDB()
+        self.wal_path = os.path.join(cluster.base_dir, f"node{idx}", "cs.wal")
+        os.makedirs(os.path.dirname(self.wal_path), exist_ok=True)
+        self.node_clock = NodeClock(cluster.clock)
+
+        self.crashed = False
+        self.byzantine = False
+        self.cs = None
+        self.reactor = None
+        self.router: Optional[SimRouter] = None
+        self.bstore = None
+        self._pump_pending = False
+        self._gossip_timer = None
+        self._last_maj23 = float("-inf")
+        self._last_committed = 0
+        self.restarts = 0
+
+    # -- build/teardown --------------------------------------------------
+
+    def build(self, genesis: bool) -> None:
+        """Construct the runtime (ConsensusState + reactor) from the
+        persistent stores; `genesis=False` is the restart path."""
+        from ..abci import KVStoreApplication, LocalClient
+        from ..consensus import ConsensusState, WAL
+        from ..consensus.reactor import ConsensusReactor
+        from ..eventbus import EventBus
+        from ..mempool import TxMempool
+        from ..state import make_genesis_state
+        from ..state.execution import BlockExecutor
+        from ..state.store import StateStore
+        from ..store import BlockStore
+
+        c = self.cluster
+        app = KVStoreApplication(db=self.app_db)
+        sstore = StateStore(self.state_db)
+        if genesis:
+            state = make_genesis_state(c.genesis_doc)
+            sstore.save(state)
+        else:
+            state = sstore.load()
+            if state is None:  # crashed before the first state save
+                state = make_genesis_state(c.genesis_doc)
+        self.bstore = BlockStore(self.block_db)
+        mp = TxMempool(LocalClient(app))
+        if genesis:
+            for tx in c.txs_for(self.idx):
+                mp.check_tx(tx)
+        bus = EventBus()
+        ex = BlockExecutor(
+            sstore, LocalClient(app), mempool=mp, block_store=self.bstore,
+            event_bus=bus,
+        )
+        self.cs = ConsensusState(
+            c.config,
+            state,
+            ex,
+            self.bstore,
+            mempool=mp,
+            event_bus=bus,
+            wal=WAL(self.wal_path),
+            priv_validator=self.pv,
+            clock=self.node_clock,
+        )
+        self.cs.on_enqueue = self._on_enqueue
+        self.cs._height_events.append(self._on_commit)
+        if self.byzantine:
+            self.cs.do_prevote_override = make_double_sign_prevote(
+                self.sk, c.chain_id
+            )
+        self.router = SimRouter(c.network, self.node_id)
+        self.reactor = ConsensusReactor(
+            self.cs, self.router, block_store=self.bstore, rng=c.clock.rng
+        )
+        c.network.set_receiver(self.node_id, self.reactor.handle_envelope)
+
+    def start(self) -> None:
+        self.crashed = False
+        self._pump_pending = False
+        for peer in self.cluster.nodes:
+            if peer is self or peer.crashed:
+                continue
+            self.reactor.add_peer(peer.node_id)
+            peer.reactor.add_peer(self.node_id)
+        self.cs.start_stepped()
+        self._schedule_gossip()
+
+    def crash(self) -> None:
+        """SIGKILL-equivalent: drop the runtime, keep the disk."""
+        if self.crashed:
+            return
+        self.crashed = True
+        if self._gossip_timer is not None:
+            # a tick scheduled before the crash must not survive into a
+            # fast restart — it would re-arm and double the gossip chain
+            self._gossip_timer.cancel()
+            self._gossip_timer = None
+        self.cluster.network.set_down(self.node_id, True)
+        for peer in self.cluster.nodes:
+            if peer is not self and peer.reactor is not None:
+                peer.reactor.remove_peer(self.node_id)
+        self.cs.stop_stepped()
+        self.cs = None
+        self.reactor = None
+
+    def restart(self) -> None:
+        if not self.crashed:
+            return
+        self.restarts += 1
+        self.cluster.network.set_down(self.node_id, False)
+        self.build(genesis=False)
+        self.start()
+
+    # -- event-loop plumbing ---------------------------------------------
+
+    def _on_enqueue(self) -> None:
+        if self._pump_pending or self.crashed:
+            return
+        self._pump_pending = True
+        self.cluster.clock.call_later(0.0, self._pump)
+
+    def _pump(self) -> None:
+        self._pump_pending = False
+        if self.crashed or self.cs is None:
+            return
+        self.cs.process_pending()
+
+    def _schedule_gossip(self) -> None:
+        # the reactor's OWN cadence (ConsensusReactor.GOSSIP_INTERVAL) so
+        # the sim always validates the production timing regime; small
+        # per-node phase offset so sweeps interleave rather than all
+        # landing on identical timestamps
+        self._gossip_timer = self.cluster.clock.call_later(
+            self.reactor.GOSSIP_INTERVAL + self.idx * 0.003, self._gossip_tick
+        )
+
+    def _gossip_tick(self) -> None:
+        if self.crashed or self.reactor is None:
+            return
+        now = self.cluster.clock.time()
+        query = now - self._last_maj23 >= self.reactor.QUERY_MAJ23_INTERVAL
+        if query:
+            self._last_maj23 = now
+        try:
+            self.reactor.gossip_once(query)
+        except Exception:  # noqa: BLE001 — gossip must never kill the sim
+            pass
+        self._gossip_timer = self.cluster.clock.call_later(
+            self.reactor.GOSSIP_INTERVAL, self._gossip_tick
+        )
+
+    def _on_commit(self, height: int) -> None:
+        self.cluster._node_committed(self, height)
+
+    def height(self) -> int:
+        return self.bstore.height() if self.bstore is not None else 0
+
+
+class Cluster:
+    """N-node simulated cluster over one SimClock."""
+
+    def __init__(
+        self,
+        n_nodes: int = 4,
+        seed: int = 0,
+        link: Optional[LinkConfig] = None,
+        faults: Optional[List[Fault]] = None,
+        config=None,
+        txs_per_node: int = 0,
+        base_dir: Optional[str] = None,
+        chain_id: str = CHAIN_ID,
+    ):
+        from ..types import Timestamp
+        from ..types.genesis import GenesisDoc, GenesisValidator
+
+        self.seed = seed
+        self.chain_id = chain_id
+        self.faults = list(faults or [])
+        for f in self.faults:  # validate before any filesystem side effects
+            f.validate(n_nodes)
+        self.clock = SimClock(seed=seed)
+        self.network = SimNetwork(self.clock, default_link=link)
+        self.config = config or _default_config()
+        self.txs_per_node = txs_per_node
+        self._owns_base_dir = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="simnet-")
+        self._fault_fired = [False] * len(self.faults)
+        self.violations: List[str] = []
+        self.faults_applied: List[str] = []
+        self._canonical: Dict[int, bytes] = {}
+        self._started = False
+        self._stopped = False
+        # nodes whose crash fault promises a restart (restart_after or an
+        # explicit restart fault) — run_to_height waits for these, while a
+        # crash-stop node is simply excluded from the liveness target
+        self._pending_restarts: set = set()
+
+        self.nodes = [SimNode(self, i) for i in range(n_nodes)]
+        self.genesis_doc = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time=Timestamp(seconds=GENESIS_SECONDS),
+            validators=[
+                GenesisValidator(address=b"", pub_key=n.sk.pub_key(), power=10)
+                for n in self.nodes
+            ],
+        )
+        # trigger-less double_sign faults are byzantine from genesis and
+        # must be flagged before build(); triggered ones are installed on
+        # the live node when they fire (_apply_fault)
+        for f in self.faults:
+            if f.kind == "double_sign" and f.at_height is None and f.at_time is None:
+                self.nodes[f.node].byzantine = True
+        for n in self.nodes:
+            n.build(genesis=True)
+
+    def txs_for(self, idx: int) -> List[bytes]:
+        return [
+            b"k%d_%d=v%d" % (idx, j, j) for j in range(self.txs_per_node)
+        ]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for n in self.nodes:
+            n.start()
+        for i, f in enumerate(self.faults):
+            if f.at_time is not None:
+                self.clock.call_later(
+                    f.at_time, lambda i=i: self._apply_fault(i)
+                )
+            elif f.at_height is None and f.kind == "double_sign":
+                self._apply_fault(i)  # active from genesis; record it
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for n in self.nodes:
+            if not n.crashed and n.cs is not None:
+                n.cs.stop_stepped()
+        if self._owns_base_dir:
+            import shutil
+
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+    # -- faults ----------------------------------------------------------
+
+    def _node_committed(self, node: SimNode, height: int) -> None:
+        """Per-commit hook: live invariants + height-triggered faults."""
+        # monotonicity
+        if height <= node._last_committed:
+            self.violations.append(
+                f"monotonicity: node {node.idx} committed h{height} after "
+                f"h{node._last_committed}"
+            )
+        node._last_committed = height
+        blk = node.bstore.load_block(height)
+        bh = bytes(blk.hash()) if blk is not None else b"?"
+        # agreement
+        prev = self._canonical.setdefault(height, bh)
+        if prev != bh:
+            self.violations.append(
+                f"agreement: node {node.idx} committed {bh.hex()[:16]} at "
+                f"h{height}, cluster committed {prev.hex()[:16]}"
+            )
+        # quorum (+2/3 voting power on the stored seen commit)
+        seen = node.bstore.load_seen_commit()
+        if seen is not None and seen.height == height:
+            bad = self.commit_quorum_violation(seen, node.idx)
+            if bad is not None:
+                self.violations.append(bad)
+        # height-triggered faults
+        for i, f in enumerate(self.faults):
+            if not self._fault_fired[i] and f.at_height is not None and height >= f.at_height:
+                self._apply_fault(i)
+
+    def _apply_fault(self, i: int) -> None:
+        if self._fault_fired[i]:
+            return
+        self._fault_fired[i] = True
+        f = self.faults[i]
+        t = self.clock.time()
+        if f.kind == "partition":
+            groups = [[self.nodes[j].node_id for j in g] for g in f.groups]
+            self.network.set_partition(groups)
+            # a real partition eventually severs the TCP links: peers see
+            # each other go "down" and forget round state (router would
+            # emit PeerUpdate down) — heal redelivers "up" + fresh NRS
+            self._for_cross_group_pairs(f.groups, lambda a, b: (
+                a.reactor.remove_peer(b.node_id) if a.reactor else None
+            ))
+            self.faults_applied.append(f"t={t:.2f} partition {f.groups}")
+            if f.duration is not None:
+                self.clock.call_later(f.duration, self._heal)
+        elif f.kind == "heal":
+            self._heal()
+        elif f.kind == "crash":
+            node = self.nodes[f.node]
+            node.crash()
+            self.faults_applied.append(f"t={t:.2f} crash node {f.node}")
+            will_restart = f.restart_after is not None or any(
+                g.kind == "restart" and g.node == f.node and not self._fault_fired[j]
+                for j, g in enumerate(self.faults)
+            )
+            if will_restart:
+                self._pending_restarts.add(f.node)
+            if f.restart_after is not None:
+                self.clock.call_later(
+                    f.restart_after, lambda n=node: self._restart(n)
+                )
+        elif f.kind == "restart":
+            self._restart(self.nodes[f.node])
+        elif f.kind == "clock_skew":
+            self.nodes[f.node].node_clock.skew = f.skew
+            self.faults_applied.append(
+                f"t={t:.2f} clock_skew node {f.node} {f.skew:+.3f}s"
+            )
+        elif f.kind == "double_sign":
+            node = self.nodes[f.node]
+            node.byzantine = True  # restarts rebuild with the override
+            if node.cs is not None and node.cs.do_prevote_override is None:
+                node.cs.do_prevote_override = make_double_sign_prevote(
+                    node.sk, self.chain_id
+                )
+            self.faults_applied.append(f"t={t:.2f} double_sign node {f.node}")
+
+    def _for_cross_group_pairs(self, groups, fn) -> None:
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for j in g:
+                group_of[j] = gi
+        for a in self.nodes:
+            for b in self.nodes:
+                if a is b:
+                    continue
+                if group_of.get(a.idx) != group_of.get(b.idx):
+                    fn(a, b)
+
+    def commit_quorum_violation(self, commit, node_idx: int = -1) -> Optional[str]:
+        """None if `commit` carries > 2/3 of the genesis voting power,
+        else the violation record (also the _node_committed live check)."""
+        vals = self.genesis_doc.validators
+        total = sum(v.power for v in vals)
+        power = sum(
+            vals[i].power
+            for i, cs_ in enumerate(commit.signatures)
+            if i < len(vals) and cs_.for_block()
+        )
+        if 3 * power <= 2 * total:
+            return (
+                f"quorum: node {node_idx} stored commit at h{commit.height} "
+                f"with {power}/{total} voting power"
+            )
+        return None
+
+    def _heal(self) -> None:
+        self.network.heal_partition()
+        # "reconnect": every live pair re-exchanges peer-up + NewRoundStep,
+        # exactly what the router's dial/accept path would do
+        for a in self.nodes:
+            for b in self.nodes:
+                if a is b or a.crashed or b.crashed or a.reactor is None:
+                    continue
+                a.reactor.add_peer(b.node_id)
+        self.faults_applied.append(f"t={self.clock.time():.2f} heal")
+
+    def _restart(self, node: SimNode) -> None:
+        node.restart()
+        self._pending_restarts.discard(node.idx)
+        self.faults_applied.append(
+            f"t={self.clock.time():.2f} restart node {node.idx}"
+        )
+
+    # -- observation -----------------------------------------------------
+
+    def heights(self) -> List[int]:
+        return [n.height() for n in self.nodes]
+
+    def min_live_height(self) -> int:
+        live = [n.height() for n in self.nodes if not n.crashed]
+        return min(live) if live else 0
+
+    def fingerprint(self) -> str:
+        """seed → ordered digest of the committed canonical chain. Two
+        same-seed runs must match byte-for-byte (replay exactness)."""
+        h = hashlib.sha256()
+        h.update(b"seed=%d;" % self.seed)
+        for height in sorted(self._canonical):
+            h.update(b"%d:" % height)
+            h.update(self._canonical[height])
+            h.update(b";")
+        return h.hexdigest()
+
+    def check_invariants(self) -> List[str]:
+        """Final sweep: every node's whole chain must be a prefix of the
+        canonical chain (convergence after crash/partition recovery)."""
+        out = list(self.violations)
+        for n in self.nodes:
+            if n.bstore is None:
+                continue
+            for height in range(max(n.bstore.base(), 1), n.height() + 1):
+                blk = n.bstore.load_block(height)
+                if blk is None:
+                    continue
+                bh = bytes(blk.hash())
+                want = self._canonical.get(height)
+                if want is not None and want != bh:
+                    out.append(
+                        f"convergence: node {n.idx} has {bh.hex()[:16]} at "
+                        f"h{height}, canonical {want.hex()[:16]}"
+                    )
+        return out
+
+    # -- the driver ------------------------------------------------------
+
+    def run_to_height(
+        self, target: int, max_virtual_s: float = 600.0
+    ) -> SimReport:
+        """Run the event loop until every live node commits `target` (and
+        every crash-faulted node has restarted), then report."""
+        wall0 = _wall.monotonic()
+        t0 = self.clock.time()
+        self.start()
+
+        def done() -> bool:
+            any_live = False
+            for n in self.nodes:
+                if n.crashed:
+                    if n.idx in self._pending_restarts:
+                        return False  # a promised restart hasn't run yet
+                    continue  # crash-stop: excluded from the target
+                any_live = True
+                if n.height() < target:
+                    return False
+            return any_live
+
+        reached = self.clock.run_until(
+            predicate=done, deadline=t0 + max_virtual_s
+        )
+        violations = self.check_invariants()
+        reason = "ok"
+        if not reached:
+            reason = (
+                f"height {target} not reached within {max_virtual_s}s virtual"
+                f" (heights={self.heights()})"
+            )
+        elif violations:
+            reason = f"{len(violations)} invariant violation(s)"
+        return SimReport(
+            ok=reached and not violations,
+            reason=reason,
+            height=self.min_live_height(),
+            heights=self.heights(),
+            fingerprint=self.fingerprint(),
+            schedule_digest=self.network.schedule_digest(),
+            violations=violations,
+            seed=self.seed,
+            virtual_s=self.clock.time() - t0,
+            wall_s=_wall.monotonic() - wall0,
+            events_run=self.clock.events_run,
+            net=self.network.stats(),
+            faults_applied=list(self.faults_applied),
+        )

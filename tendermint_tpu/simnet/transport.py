@@ -1,0 +1,275 @@
+"""Simulated network — the consensus reactors' p2p seam, virtualized.
+
+SimRouter duck-types the surface of p2p.router.Router that
+consensus.reactor.ConsensusReactor actually uses (open_channel /
+subscribe_peer_updates + Channel.send/broadcast), so reactors run
+UNMODIFIED on top of it. Instead of sockets and per-peer threads, every
+send becomes a delivery event on the shared SimClock, subject to the
+link's fault model:
+
+  latency + jitter        base one-way delay, seeded-PRNG jitter
+  drop / duplicate        per-message probabilities
+  reorder                 extra random delay on a coin flip (overtaking)
+  bandwidth_bps           per-link serialization: a big block part queues
+                          behind earlier bytes (next-free-time cursor)
+  partitions              group masks: cross-group messages vanish
+  down nodes              crashed nodes receive (and send) nothing
+
+Every delivery is folded into a running `schedule digest` so two runs can
+be compared for *event-order* identity, independent of what the chain
+committed (the determinism tests' second axis).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+try:
+    from ..p2p.transport import Envelope
+except ModuleNotFoundError:  # no OpenSSL wheel and no TM_TPU_PUREPY_CRYPTO:
+    # the p2p package deliberately hard-fails (crypto/ed25519 policy), but
+    # simnet's scheduler/transport layer is pure Python — carry a
+    # structurally identical envelope so clock/network simulation (and its
+    # tier-1 tests) stay importable; reactors only ever duck-type it.
+    from dataclasses import dataclass as _dc
+
+    @_dc
+    class Envelope:  # type: ignore[no-redef]
+        from_id: str = ""
+        to_id: str = ""
+        channel_id: int = 0
+        message: bytes = b""
+        broadcast: bool = False
+
+from .clock import SimClock
+
+
+@dataclass
+class LinkConfig:
+    """Per-directed-link fault/latency model. All randomness comes from
+    the simulation's single seeded PRNG."""
+
+    latency_s: float = 0.005
+    jitter_s: float = 0.0
+    drop: float = 0.0  # P(message silently lost)
+    duplicate: float = 0.0  # P(message delivered twice)
+    reorder: float = 0.0  # P(extra delay — lets later sends overtake)
+    reorder_extra_s: float = 0.05
+    bandwidth_bps: Optional[float] = None  # None = infinite
+
+
+class SimChannel:
+    """Reactor-facing handle on one wire channel (p2p.router.Channel
+    surface). receive() exists for API parity but simnet delivers
+    synchronously via the reactor's handle_envelope — in_q stays empty."""
+
+    def __init__(self, router: "SimRouter", desc):
+        self._router = router
+        self.desc = desc
+        self.in_q: "queue.Queue[Envelope]" = queue.Queue()
+
+    def send(self, to_id: str, message: bytes) -> bool:
+        return self._router._route_out(
+            Envelope(to_id=to_id, channel_id=self.desc.id, message=message)
+        )
+
+    def broadcast(self, message: bytes) -> None:
+        self._router._route_out(
+            Envelope(channel_id=self.desc.id, message=message, broadcast=True)
+        )
+
+    def receive(self, timeout: Optional[float] = None):
+        return self.in_q.get(timeout=timeout)
+
+    def try_receive(self) -> Optional[Envelope]:
+        try:
+            return self.in_q.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class SimRouter:
+    """The node-local endpoint: what ConsensusReactor binds to."""
+
+    def __init__(self, network: "SimNetwork", node_id: str):
+        self.node_id = node_id
+        self._network = network
+        self._channels: Dict[int, SimChannel] = {}
+        network._register(node_id, self)
+
+    def open_channel(self, desc) -> SimChannel:
+        if desc.id in self._channels:
+            raise ValueError(f"channel {desc.id} already open")
+        ch = SimChannel(self, desc)
+        self._channels[desc.id] = ch
+        return ch
+
+    def subscribe_peer_updates(self) -> "queue.Queue":
+        # simnet drives peer membership through reactor.add_peer/remove_peer
+        return queue.Queue()
+
+    def connected(self) -> List[str]:
+        return self._network.peers_of(self.node_id)
+
+    def _route_out(self, env: Envelope) -> bool:
+        return self._network.route(self.node_id, env)
+
+
+class SimNetwork:
+    """All links + fault state; schedules deliveries on the SimClock."""
+
+    def __init__(self, clock: SimClock, default_link: Optional[LinkConfig] = None):
+        self._clock = clock
+        self._rng = clock.rng
+        self._default_link = default_link or LinkConfig()
+        self._routers: Dict[str, SimRouter] = {}
+        self._receivers: Dict[str, Callable[[Envelope], None]] = {}
+        self._links: Dict[Tuple[str, str], LinkConfig] = {}
+        self._link_busy_until: Dict[Tuple[str, str], float] = {}
+        self._partition: Optional[Dict[str, int]] = None  # node -> group
+        self._down: set = set()
+        # counters + order digest
+        self.sent = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self._digest = hashlib.sha256()
+
+    # -- wiring ----------------------------------------------------------
+
+    def _register(self, node_id: str, router: SimRouter) -> None:
+        self._routers[node_id] = router
+
+    def set_receiver(self, node_id: str, fn: Callable[[Envelope], None]) -> None:
+        """fn is invoked synchronously at (virtual) delivery time; the
+        harness points it at the node's reactor.handle_envelope."""
+        self._receivers[node_id] = fn
+
+    def set_link(self, from_id: str, to_id: str, cfg: LinkConfig) -> None:
+        self._links[(from_id, to_id)] = cfg
+
+    def link(self, from_id: str, to_id: str) -> LinkConfig:
+        return self._links.get((from_id, to_id), self._default_link)
+
+    def peers_of(self, node_id: str) -> List[str]:
+        return [n for n in self._routers if n != node_id and n not in self._down]
+
+    # -- fault state -------------------------------------------------------
+
+    def set_partition(self, groups: List[List[str]]) -> None:
+        """Nodes in different groups cannot exchange messages; nodes in no
+        group are isolated from everyone."""
+        mask: Dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for n in group:
+                mask[n] = gi
+        self._partition = mask
+
+    def heal_partition(self) -> None:
+        self._partition = None
+
+    def set_down(self, node_id: str, down: bool = True) -> None:
+        if down:
+            self._down.add(node_id)
+        else:
+            self._down.discard(node_id)
+
+    def _blocked(self, a: str, b: str) -> bool:
+        if a in self._down or b in self._down:
+            return True
+        if self._partition is None:
+            return False
+        ga = self._partition.get(a)
+        gb = self._partition.get(b)
+        return ga is None or gb is None or ga != gb
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, from_id: str, env: Envelope) -> bool:
+        if from_id in self._down:
+            return False
+        if env.broadcast:
+            targets = [n for n in self._routers if n != from_id]
+        else:
+            targets = [env.to_id] if env.to_id in self._routers else []
+        any_scheduled = False
+        for to in targets:
+            if self._schedule_one(from_id, to, env):
+                any_scheduled = True
+        return any_scheduled
+
+    def _schedule_one(self, from_id: str, to_id: str, env: Envelope) -> bool:
+        self.sent += 1
+        if self._blocked(from_id, to_id):
+            self.dropped += 1
+            return False
+        cfg = self.link(from_id, to_id)
+        if cfg.drop > 0.0 and self._rng.random() < cfg.drop:
+            self.dropped += 1
+            return False
+        copies = 1
+        if cfg.duplicate > 0.0 and self._rng.random() < cfg.duplicate:
+            copies = 2
+            self.duplicated += 1
+        now = self._clock.time()
+        for _ in range(copies):
+            delay = cfg.latency_s
+            if cfg.jitter_s > 0.0:
+                delay += self._rng.random() * cfg.jitter_s
+            if cfg.reorder > 0.0 and self._rng.random() < cfg.reorder:
+                delay += self._rng.random() * cfg.reorder_extra_s
+            if cfg.bandwidth_bps:
+                key = (from_id, to_id)
+                free = max(self._link_busy_until.get(key, now), now)
+                tx = len(env.message) / cfg.bandwidth_bps
+                self._link_busy_until[key] = free + tx
+                delay += (free - now) + tx
+            delivery = Envelope(
+                from_id=from_id,
+                to_id=to_id,
+                channel_id=env.channel_id,
+                message=env.message,
+            )
+            self._clock.call_later(
+                delay, lambda d=delivery: self._deliver(d)
+            )
+        return True
+
+    def _deliver(self, env: Envelope) -> None:
+        # partitions/crashes also eat messages already in flight
+        if self._blocked(env.from_id, env.to_id):
+            self.dropped += 1
+            return
+        recv = self._receivers.get(env.to_id)
+        if recv is None:
+            self.dropped += 1
+            return
+        self.delivered += 1
+        self._digest.update(
+            b"%d|%s|%s|%d|%d;"
+            % (
+                int(self._clock.time() * 1e9),
+                env.from_id.encode(),
+                env.to_id.encode(),
+                env.channel_id,
+                len(env.message),
+            )
+        )
+        recv(env)
+
+    def schedule_digest(self) -> str:
+        """Digest of the delivery order so far: (time, from, to, channel,
+        size) per delivered message. Two runs with the same seed must
+        match; different seeds must (overwhelmingly) differ."""
+        return self._digest.hexdigest()
+
+    def stats(self) -> dict:
+        return {
+            "sent": self.sent,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "duplicated": self.duplicated,
+        }

@@ -1,0 +1,128 @@
+"""`debug dump` / `debug kill` CLI parity (cmd/tendermint/commands/debug,
+kill.go: capture-then-SIGKILL).
+
+Runs tier-1 WITHOUT the cryptography wheel: the CLI's debug path is pure
+urllib + os.kill, so the node RPC is stood in for by a stdlib HTTP server
+serving canned JSON, and the victim is a throwaway sleeper subprocess.
+The real /thread_dump endpoint is covered in tests/test_node_rpc.py."""
+
+import http.server
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu import cli
+
+CANNED = {
+    "status": {"node_info": {"network": "dbg-chain"}, "sync_info": {"latest_block_height": "7"}},
+    "net_info": {"n_peers": "3"},
+    "dump_consensus_state": {"round_state": {"height": 8}},
+    "consensus_state": {"round_state": {"height/round/step": "8/0/1"}},
+    "thread_dump": {"n_threads": 2, "threads": []},
+    "dump_trace": {"enabled": False, "summary": {}},
+}
+
+
+class _FakeRPC(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        method = self.path.lstrip("/").split("?")[0]
+        body = CANNED.get(method)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def fake_rpc():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _FakeRPC)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_debug_dump_captures_all_methods(fake_rpc, tmp_path):
+    out = str(tmp_path / "dump")
+    rc = cli.main(
+        ["debug", "dump", "--rpc-laddr", fake_rpc, "--output-directory", out]
+    )
+    assert rc == 0
+    for method in CANNED:
+        path = os.path.join(out, f"{method}.json")
+        assert os.path.exists(path), f"missing {method}.json"
+        assert json.load(open(path)) == CANNED[method]
+
+
+def test_debug_default_mode_is_dump(fake_rpc, tmp_path):
+    out = str(tmp_path / "dump2")
+    rc = cli.main(["debug", "--rpc-laddr", fake_rpc, "--output-directory", out])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "status.json"))
+
+
+def test_debug_kill_captures_then_sigkills(fake_rpc, tmp_path):
+    victim = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(120)"])
+    try:
+        out = str(tmp_path / "killdump")
+        rc = cli.main(
+            [
+                "debug", "kill",
+                "--rpc-laddr", fake_rpc,
+                "--output-directory", out,
+                "--pid", str(victim.pid),
+            ]
+        )
+        assert rc == 0
+        # capture happened BEFORE the kill (kill.go ordering)
+        assert os.path.exists(os.path.join(out, "dump_consensus_state.json"))
+        assert os.path.exists(os.path.join(out, "thread_dump.json"))
+        # and the process is gone, by SIGKILL
+        deadline = time.time() + 10
+        while victim.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.returncode == -signal.SIGKILL
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+
+
+def test_debug_kill_requires_pid(fake_rpc, tmp_path):
+    rc = cli.main(
+        [
+            "debug", "kill",
+            "--rpc-laddr", fake_rpc,
+            "--output-directory", str(tmp_path / "nopid"),
+        ]
+    )
+    assert rc == 1
+
+
+def test_debug_kill_bad_pid_fails_cleanly(fake_rpc, tmp_path):
+    # spawn-and-reap so the pid is definitely unused (ESRCH, not a live kill)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    rc = cli.main(
+        [
+            "debug", "kill",
+            "--rpc-laddr", fake_rpc,
+            "--output-directory", str(tmp_path / "badpid"),
+            "--pid", str(p.pid),
+        ]
+    )
+    assert rc == 1

@@ -1,0 +1,97 @@
+"""GIL-budget regression gate (VERDICT item 6).
+
+Measures the host-side (non-device) prep cost of a 10k-signature
+verify_commit on the pure-Python CPU fallback — the columnar EntryBlock
+path PR 2 introduced — and fails if it regresses. Two gates:
+
+  absolute   columnar prep for 10k sigs must stay under
+             GIL_BUDGET_MS_10K = 150 ms (measured ~40 ms on the dev
+             container; ~3.7x headroom for slower CI hardware)
+  relative   columnar must stay <= 80% of the tuple-list baseline cost
+             (measured ~43%; a revert to row-wise prep lands at 100%+)
+
+The measurement runs in a subprocess: it needs TM_TPU_PUREPY_CRYPTO=1
+(containers without the OpenSSL wheel) + TM_TPU_NO_NATIVE=1 (isolate the
+pure-Python path — the gate must hold even where the native module isn't
+built), and neither env var may leak into the main pytest process."""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+GIL_BUDGET_MS_10K = 150.0
+RELATIVE_GATE = 0.8
+N_SIGS = 10_000
+
+_SCRIPT = r"""
+import importlib.util, json, statistics, sys, time
+
+spec = importlib.util.spec_from_file_location(
+    "prep_bench", %(prep_bench)r
+)
+pb = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(pb)
+
+from tendermint_tpu.ops import backend, pipeline
+
+chain_id = "gil-budget"
+vset, commit = pb.build_synthetic_commit(%(n_sigs)d)
+needed = vset.total_voting_power() * 2 // 3
+bucket = backend._bucket_for(%(n_sigs)d)
+
+def median_ms(fn, reps=3):
+    times = []
+    for _ in range(reps):
+        commit._sb_tpl = None  # fresh sign-bytes template per rep
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+columnar_ms = median_ms(
+    lambda: backend.prepare_batch_device_hash(
+        pipeline.commit_entries(chain_id, vset, commit, needed)[0], bucket
+    )
+)
+tuple_ms = median_ms(
+    lambda: backend.prepare_batch_device_hash(
+        pb.commit_entries_tuples(chain_id, vset, commit, needed), bucket
+    )
+)
+print(json.dumps({"columnar_ms": columnar_ms, "tuple_ms": tuple_ms}))
+"""
+
+
+def test_10k_sig_verify_commit_prep_stays_in_budget():
+    env = dict(
+        os.environ,
+        TM_TPU_PUREPY_CRYPTO="1",
+        TM_TPU_NO_NATIVE="1",
+        JAX_PLATFORMS="cpu",
+    )
+    script = _SCRIPT % {
+        "prep_bench": os.path.join(REPO, "tools", "prep_bench.py"),
+        "n_sigs": N_SIGS,
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        env=env,
+        cwd=REPO,
+        timeout=600,
+    )
+    assert r.returncode == 0, (r.stderr or b"").decode(errors="replace")[-3000:]
+    out = json.loads((r.stdout or b"").decode().strip().splitlines()[-1])
+    columnar, tuple_ = out["columnar_ms"], out["tuple_ms"]
+    assert columnar <= GIL_BUDGET_MS_10K, (
+        f"host prep for {N_SIGS} sigs took {columnar:.1f} ms "
+        f"(budget {GIL_BUDGET_MS_10K} ms) — the PR 2 host-prep cuts regressed"
+    )
+    assert columnar <= tuple_ * RELATIVE_GATE, (
+        f"columnar prep ({columnar:.1f} ms) no longer beats the tuple "
+        f"baseline ({tuple_:.1f} ms) by >= {1 - RELATIVE_GATE:.0%}"
+    )

@@ -117,6 +117,25 @@ class TestNodeRPC:
         ucp = rpc.call("consensus_params")
         assert int(ucp["consensus_params"]["block"]["max_bytes"]) > 0
 
+    def test_thread_dump_endpoint(self, two_node_net):
+        """/thread_dump: the goroutine-dump equivalent `debug kill`
+        captures — unsafe-gated (stack traces leak internals), and must
+        include the consensus receive routine's stack when enabled."""
+        nodes = two_node_net
+        nodes[0].wait_for_height(1, timeout=60)
+        rpc = HTTPClient(nodes[0].rpc_server.listen_addr)
+        # gated off by default
+        with pytest.raises(Exception):
+            rpc.call("thread_dump")
+        nodes[0].config.rpc.unsafe = True
+        try:
+            td = rpc.call("thread_dump")
+            assert int(td["n_threads"]) >= 2
+            stacks = "".join(s for t in td["threads"] for s in t["stack"])
+            assert "_receive_routine" in stacks
+        finally:
+            nodes[0].config.rpc.unsafe = False
+
 
 class TestHandshakeReplay:
     def test_app_restart_replays_blocks(self):

@@ -1,0 +1,259 @@
+"""Tier-1 simnet coverage for containers without the `cryptography` wheel.
+
+Two layers:
+  1. Crypto-free unit tests of the simulation substrate (virtual clock,
+     event ordering, link fault model, partitions, fault-schedule
+     parsing) — these run in the MAIN pytest process: simnet's
+     clock/transport layer imports without any signer.
+  2. Subprocess runs of the signer-needing end-to-end suites
+     (tests/test_simnet.py and tools/simnet_run.py --smoke) under
+     TM_TPU_PUREPY_CRYPTO=1. The env flag must NOT be set in the main
+     process — pytest collects all modules in one interpreter and the
+     flag would unlock slow OpenSSL-dependent paths suite-wide (same
+     pattern as tests/test_entry_block_isolated.py).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu.simnet.clock import NodeClock, SimClock
+from tendermint_tpu.simnet.faults import Fault, parse_faults, smoke_schedule
+from tendermint_tpu.simnet.transport import Envelope, LinkConfig, SimNetwork, SimRouter
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+class TestSimClock:
+    def test_events_fire_in_time_order_with_stable_ties(self):
+        clk = SimClock(seed=0, start=0.0)
+        order = []
+        clk.call_later(2.0, lambda: order.append("b"))
+        clk.call_later(1.0, lambda: order.append("a"))
+        clk.call_later(2.0, lambda: order.append("c"))  # same time as b: FIFO
+        clk.call_later(3.0, lambda: order.append("d"))
+        clk.run_until()
+        assert order == ["a", "b", "c", "d"]
+        assert clk.time() == 3.0
+
+    def test_cancel_and_deadline(self):
+        clk = SimClock(seed=0, start=0.0)
+        fired = []
+        t = clk.call_later(1.0, lambda: fired.append(1))
+        clk.call_later(5.0, lambda: fired.append(2))
+        t.cancel()
+        clk.run_until(deadline=2.0)
+        assert fired == []
+        assert clk.time() == 2.0
+        clk.run_until()
+        assert fired == [2]
+
+    def test_callbacks_can_schedule_more_events(self):
+        clk = SimClock(seed=0, start=0.0)
+        seen = []
+
+        def tick(n):
+            seen.append(n)
+            if n < 3:
+                clk.call_later(1.0, lambda: tick(n + 1))
+
+        clk.call_later(1.0, lambda: tick(0))
+        assert clk.run_until(predicate=lambda: len(seen) == 4)
+        assert seen == [0, 1, 2, 3]
+        assert clk.time() == 4.0
+
+    def test_same_seed_same_rng_stream(self):
+        a = [SimClock(seed=5).rng.random() for _ in range(8)]
+        b = [SimClock(seed=5).rng.random() for _ in range(8)]
+        c = [SimClock(seed=6).rng.random() for _ in range(8)]
+        assert a == b
+        assert a != c
+
+    def test_node_clock_skew_shifts_reads_not_delays(self):
+        clk = SimClock(seed=0, start=100.0)
+        nc = NodeClock(clk, skew=2.5)
+        assert nc.time() == 102.5
+        fired = []
+        nc.call_later(1.0, lambda: fired.append(clk.time()))
+        clk.run_until()
+        assert fired == [101.0]  # delay unaffected by skew
+
+
+def _net(seed=0, link=None):
+    clk = SimClock(seed=seed, start=0.0)
+    net = SimNetwork(clk, default_link=link or LinkConfig(latency_s=0.01))
+    inboxes = {}
+    for nid in ("a", "b", "c"):
+        SimRouter(net, nid)
+        inboxes[nid] = []
+        net.set_receiver(nid, lambda env, n=nid: inboxes[n].append(env))
+    return clk, net, inboxes
+
+
+class TestSimNetwork:
+    def test_unicast_and_broadcast_delivery(self):
+        clk, net, inboxes = _net()
+        net.route("a", Envelope(to_id="b", channel_id=7, message=b"x"))
+        net.route("a", Envelope(channel_id=7, message=b"y", broadcast=True))
+        clk.run_until()
+        assert [e.message for e in inboxes["b"]] == [b"x", b"y"]
+        assert [e.message for e in inboxes["c"]] == [b"y"]
+        assert inboxes["a"] == []  # broadcast never loops back
+        assert net.delivered == 3
+
+    def test_partition_blocks_and_heals(self):
+        clk, net, inboxes = _net()
+        net.set_partition([["a", "b"], ["c"]])
+        net.route("a", Envelope(to_id="c", channel_id=1, message=b"1"))
+        net.route("a", Envelope(to_id="b", channel_id=1, message=b"2"))
+        clk.run_until()
+        assert inboxes["c"] == []
+        assert [e.message for e in inboxes["b"]] == [b"2"]
+        net.heal_partition()
+        net.route("a", Envelope(to_id="c", channel_id=1, message=b"3"))
+        clk.run_until()
+        assert [e.message for e in inboxes["c"]] == [b"3"]
+
+    def test_partition_eats_in_flight_messages(self):
+        clk, net, inboxes = _net()
+        net.route("a", Envelope(to_id="c", channel_id=1, message=b"mid-flight"))
+        net.set_partition([["a", "b"], ["c"]])  # applied before delivery time
+        clk.run_until()
+        assert inboxes["c"] == []
+        assert net.dropped >= 1
+
+    def test_down_node_sends_and_receives_nothing(self):
+        clk, net, inboxes = _net()
+        net.set_down("b")
+        net.route("a", Envelope(to_id="b", channel_id=1, message=b"x"))
+        net.route("b", Envelope(to_id="a", channel_id=1, message=b"y"))
+        clk.run_until()
+        assert inboxes["b"] == [] and inboxes["a"] == []
+
+    def test_drop_and_duplicate_probabilities(self):
+        link = LinkConfig(latency_s=0.001, drop=0.5)
+        clk, net, inboxes = _net(seed=1, link=link)
+        for i in range(100):
+            net.route("a", Envelope(to_id="b", channel_id=1, message=b"%d" % i))
+        clk.run_until()
+        assert 20 < len(inboxes["b"]) < 80  # ~50 expected, seeded
+        link2 = LinkConfig(latency_s=0.001, duplicate=1.0)
+        clk2, net2, inboxes2 = _net(seed=2, link=link2)
+        net2.route("a", Envelope(to_id="b", channel_id=1, message=b"x"))
+        clk2.run_until()
+        assert len(inboxes2["b"]) == 2
+
+    def test_bandwidth_cap_serializes_link(self):
+        # 1000 bytes at 10_000 B/s -> 0.1s per message of queueing
+        link = LinkConfig(latency_s=0.0, bandwidth_bps=10_000)
+        clk, net, inboxes = _net(seed=0, link=link)
+        times = []
+        net.set_receiver("b", lambda env: times.append(clk.time()))
+        for _ in range(3):
+            net.route("a", Envelope(to_id="b", channel_id=1, message=b"z" * 1000))
+        clk.run_until()
+        assert len(times) == 3
+        assert times[0] == pytest.approx(0.1, abs=1e-6)
+        assert times[2] == pytest.approx(0.3, abs=1e-6)
+
+    def test_schedule_digest_tracks_order(self):
+        clk, net, _ = _net(seed=3, link=LinkConfig(latency_s=0.01, jitter_s=0.05))
+        for i in range(20):
+            net.route("a", Envelope(to_id="b", channel_id=1, message=b"%d" % i))
+        clk.run_until()
+        d1 = net.schedule_digest()
+        clk2, net2, _ = _net(seed=3, link=LinkConfig(latency_s=0.01, jitter_s=0.05))
+        for i in range(20):
+            net2.route("a", Envelope(to_id="b", channel_id=1, message=b"%d" % i))
+        clk2.run_until()
+        assert net2.schedule_digest() == d1
+        clk3, net3, _ = _net(seed=4, link=LinkConfig(latency_s=0.01, jitter_s=0.05))
+        for i in range(20):
+            net3.route("a", Envelope(to_id="b", channel_id=1, message=b"%d" % i))
+        clk3.run_until()
+        assert net3.schedule_digest() != d1
+
+
+class TestFaultSchedules:
+    def test_parse_roundtrip_and_validation(self):
+        raw = [
+            {"kind": "partition", "at_height": 5, "groups": [[0, 1], [2, 3]],
+             "duration": 2.0},
+            {"kind": "crash", "at_height": 8, "node": 2, "restart_after": 1.0},
+            {"kind": "double_sign", "node": 3},
+        ]
+        faults = parse_faults(raw)
+        assert [f.kind for f in faults] == ["partition", "crash", "double_sign"]
+        for f in faults:
+            f.validate(4)
+        with pytest.raises(ValueError):
+            parse_faults([{"kind": "crash", "at_height": 1, "node": 0, "bogus": 1}])
+        with pytest.raises(ValueError):
+            Fault(kind="partition", at_time=0.0).validate(4)
+
+    def test_smoke_schedule_shape(self):
+        sched = smoke_schedule(4)
+        kinds = [f.kind for f in sched]
+        assert kinds == ["partition", "crash"]
+        assert sched[0].duration is not None
+        assert sched[1].restart_after is not None
+
+
+def _purepy_env():
+    return dict(os.environ, TM_TPU_PUREPY_CRYPTO="1", JAX_PLATFORMS="cpu")
+
+
+def test_simnet_suite_under_purepy_fallback():
+    """Re-run tests/test_simnet.py in a subprocess where the pure-Python
+    signer can be enabled without leaking into this interpreter."""
+    try:
+        import cryptography  # noqa: F401
+
+        pytest.skip("cryptography present; test_simnet runs directly")
+    except ModuleNotFoundError:
+        pass
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            os.path.join(HERE, "test_simnet.py"),
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+        ],
+        capture_output=True,
+        env=_purepy_env(),
+        cwd=REPO,
+        timeout=700,
+    )
+    tail = (r.stdout or b"").decode(errors="replace")[-3000:]
+    assert r.returncode == 0, f"isolated test_simnet run failed:\n{tail}"
+
+
+def test_smoke_cli_partition_heal_crash_restart():
+    """The acceptance gate: `simnet_run.py --smoke` — 4 nodes, partition
+    + heal + crash/WAL-restart at a fixed seed, height >= 20, two runs
+    with identical fingerprints — on CPU, without the OpenSSL wheel,
+    in well under 60s."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "simnet_run.py"), "--smoke"],
+        capture_output=True,
+        env=_purepy_env(),
+        cwd=REPO,
+        timeout=60,
+    )
+    out = (r.stdout or b"").decode(errors="replace")
+    assert r.returncode == 0, f"smoke run failed:\n{out[-3000:]}"
+    verdict = json.loads(out)
+    assert verdict["ok"] is True
+    assert verdict["replay_exact"] is True
+    assert verdict["height"] >= 20
+    assert verdict["violations"] == []
+    assert "partition" in verdict["faults"] and "crash" in verdict["faults"]
+
+
+# keep the importable surface honest: these names must exist without any
+# crypto wheel for the unit layer above to be tier-1-safe
+assert importlib.util.find_spec("tendermint_tpu.simnet.clock") is not None

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""simnet_run — drive a deterministic in-process consensus cluster.
+
+Runs N real consensus nodes over the simnet virtual network with a fault
+schedule, checks the Tendermint safety invariants live, and emits a JSON
+verdict (and optionally a Chrome-trace span file from the observability
+tracer). Same --seed ⇒ byte-identical run; a failing seed IS the repro.
+
+Examples:
+    # 4 nodes to height 20, defaults
+    python tools/simnet_run.py --height 20
+
+    # the tier-1 smoke: partition-and-heal + crash/WAL-restart, run twice,
+    # assert replay-exact fingerprints
+    python tools/simnet_run.py --smoke
+
+    # a custom schedule + lossy links, with a trace
+    python tools/simnet_run.py --seed 9 --faults sched.json \\
+        --drop 0.05 --jitter-ms 20 --trace /tmp/simnet-trace.json
+
+Fault schedule JSON: see tendermint_tpu/simnet/faults.py docstring.
+Runs on CPU without the `cryptography` wheel (pure-Python ed25519
+fallback), without TCP, and without a TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+try:  # containers without the OpenSSL wheel run the pure-Python signer
+    import cryptography  # noqa: F401
+except ModuleNotFoundError:
+    os.environ.setdefault("TM_TPU_PUREPY_CRYPTO", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE_SEED = 42
+SMOKE_HEIGHT = 20  # the acceptance bar: partition+heal+crash/restart to h>=20
+
+
+def build_cluster(args, faults):
+    from tendermint_tpu.simnet import Cluster, LinkConfig
+
+    link = LinkConfig(
+        latency_s=args.latency_ms / 1000.0,
+        jitter_s=args.jitter_ms / 1000.0,
+        drop=args.drop,
+        duplicate=args.duplicate,
+        reorder=args.reorder,
+        bandwidth_bps=args.bandwidth_bps or None,
+    )
+    return Cluster(
+        n_nodes=args.nodes,
+        seed=args.seed,
+        link=link,
+        faults=faults,
+        txs_per_node=args.txs,
+    )
+
+
+def load_faults(args):
+    from tendermint_tpu.simnet import (
+        crash_restart_schedule,
+        parse_faults,
+        partition_heal_schedule,
+        smoke_schedule,
+    )
+
+    if args.faults:
+        with open(args.faults) as fh:
+            return parse_faults(json.load(fh))
+    preset = args.preset
+    if preset == "partition_heal":
+        return partition_heal_schedule(args.nodes)
+    if preset == "crash_restart":
+        return crash_restart_schedule(args.nodes - 1)
+    if preset == "smoke":
+        return smoke_schedule(args.nodes)
+    return []
+
+
+def run_once(args, faults) -> dict:
+    from tendermint_tpu.observability import trace as _trace
+
+    cluster = build_cluster(args, faults)
+    try:
+        with _trace.span("simnet.run", seed=args.seed, nodes=args.nodes):
+            rep = cluster.run_to_height(args.height, max_virtual_s=args.max_virtual_s)
+    finally:
+        cluster.stop()  # closes WALs and removes the temp dir even on error
+    out = rep.to_dict()
+    out["commits_per_s"] = (
+        round(rep.height / rep.wall_s, 2) if rep.wall_s > 0 else None
+    )
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--height", type=int, default=20)
+    ap.add_argument("--max-virtual-s", type=float, default=600.0)
+    ap.add_argument("--faults", default="", help="JSON fault schedule file")
+    ap.add_argument(
+        "--preset",
+        choices=["none", "partition_heal", "crash_restart", "smoke"],
+        default="none",
+    )
+    ap.add_argument("--txs", type=int, default=0, help="seed N txs per node")
+    ap.add_argument("--latency-ms", type=float, default=5.0)
+    ap.add_argument("--jitter-ms", type=float, default=0.0)
+    ap.add_argument("--drop", type=float, default=0.0)
+    ap.add_argument("--duplicate", type=float, default=0.0)
+    ap.add_argument("--reorder", type=float, default=0.0)
+    ap.add_argument("--bandwidth-bps", type=float, default=0.0)
+    ap.add_argument("--trace", default="", help="write Chrome-trace spans here")
+    ap.add_argument(
+        "--repeat",
+        type=int,
+        default=1,
+        help="run N times with the same seed and require identical fingerprints",
+    )
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help=f"tier-1 smoke: 4 nodes, smoke schedule, seed {SMOKE_SEED}, "
+        f"height {SMOKE_HEIGHT}, two replay-exact runs",
+    )
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.nodes = 4
+        args.seed = SMOKE_SEED
+        args.height = max(args.height if args.height != 20 else 0, SMOKE_HEIGHT)
+        args.preset = "smoke"
+        args.repeat = max(args.repeat, 2)
+
+    from tendermint_tpu.observability import trace as _trace
+
+    if args.trace:
+        _trace.configure(enabled=True)
+
+    t0 = time.monotonic()
+    faults = load_faults(args)
+    runs = [run_once(args, load_faults(args)) for _ in range(max(args.repeat, 1))]
+    verdict = dict(runs[0])
+    verdict["runs"] = len(runs)
+    verdict["wall_total_s"] = round(time.monotonic() - t0, 3)
+    verdict["replay_exact"] = all(
+        r["fingerprint"] == runs[0]["fingerprint"]
+        and r["schedule_digest"] == runs[0]["schedule_digest"]
+        for r in runs
+    )
+    if len(runs) > 1 and not verdict["replay_exact"]:
+        verdict["ok"] = False
+        verdict["reason"] = "same-seed runs diverged (replay exactness broken)"
+    verdict["faults"] = [f.kind for f in faults]
+
+    if args.trace:
+        path = _trace.TRACER.dump(args.trace)
+        verdict["trace_path"] = path
+
+    print(json.dumps(verdict, indent=2, default=str))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
