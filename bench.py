@@ -335,6 +335,15 @@ def worker() -> None:
         "pad_waste_ratio": round(
             (pad_bucket - n_sigs) / pad_bucket if pad_bucket else 0.0, 4
         ),
+        # dispatch-owner split (PR 4): prepared-to-launched wait vs the
+        # actual relay occupancy of the single dispatch thread — queue
+        # growth shows up here, not as caller convoy on the relay
+        "queue_wait_ms_p50": round(
+            _span_stats.get("pipeline.queue_wait", {}).get("p50_ms", 0.0), 3
+        ),
+        "dispatch_relay_ms_p50": round(
+            _span_stats.get("pipeline.dispatch", {}).get("p50_ms", 0.0), 3
+        ),
     }
 
     def measure_rtt() -> float:

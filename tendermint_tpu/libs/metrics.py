@@ -346,6 +346,14 @@ class OpsMetrics:
             "Jobs fused into one device batch by the coalescing worker.",
             buckets=[1, 2, 4, 8, 16, 32, 64],
         )
+        self.dispatch_queue_depth = registry.gauge(
+            "ops", "dispatch_queue_depth",
+            "Prepared batches waiting for the dispatch-owner thread.",
+        )
+        self.dispatch_busy_ratio = registry.gauge(
+            "ops", "dispatch_busy_ratio",
+            "Dispatch-owner thread occupancy (launch time / wall time).",
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -400,6 +408,8 @@ def ops_stats() -> dict:
         "device_seconds_avg": (dev_sum / dev_n) if dev_n else 0.0,
         "pipeline_queue_depth": int(m.pipeline_queue_depth.value()),
         "pipeline_inflight": int(m.pipeline_inflight.value()),
+        "dispatch_queue_depth": int(m.dispatch_queue_depth.value()),
+        "dispatch_busy_ratio": float(m.dispatch_busy_ratio.value()),
     }
 
 

@@ -10,12 +10,37 @@ crypto/ed25519/ed25519.go:192-227) with TPU-first designs:
 - sharded:        multi-chip sharding of verification over a jax Mesh
 
 Importing this package installs the device batch-verifier factory into
-crypto.batch (the reference's CreateBatchVerifier seam).
+crypto.batch (the reference's CreateBatchVerifier seam). The factory is
+LAZY: `backend` (and with it jax) only loads on the first
+create_batch_verifier call, so the numpy-only columnar modules
+(entry_block, commit_prep) are importable from the wire/types layer —
+commits decode straight into CommitBlock columns — without dragging the
+device stack into every decode.
 """
 
 from __future__ import annotations
 
-from .backend import Ed25519DeviceBatchVerifier, verify_batch, warmup  # noqa: F401
 from ..crypto import batch as _batch
 
-_batch.use_device_engine(Ed25519DeviceBatchVerifier)
+
+def _device_verifier_factory():
+    from .backend import Ed25519DeviceBatchVerifier
+
+    return Ed25519DeviceBatchVerifier()
+
+
+_batch.use_device_engine(_device_verifier_factory)
+
+_LAZY = ("Ed25519DeviceBatchVerifier", "verify_batch", "warmup")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from . import backend
+
+        return getattr(backend, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

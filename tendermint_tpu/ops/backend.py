@@ -77,7 +77,10 @@ DEVICE_THRESHOLD = int(os.environ.get("TM_TPU_DEVICE_THRESHOLD", "64"))
 # Messages up to this size hash on-device (R||A||M padded buffers);
 # longer messages fall back to host hashlib for the challenge scalar.
 # 192 covers canonical vote sign-bytes (~120B + 50-char chain ids).
-DEVICE_HASH_MAX_MSG = int(os.environ.get("TM_TPU_DEVICE_HASH_MAX_MSG", "192"))
+# Defined in commit_prep (jax-free) so the types layer can size the fused
+# prep's RAM columns without importing the device stack.
+from .commit_prep import DEVICE_HASH_MAX_MSG  # noqa: E402
+
 HOST_HASH = bool(int(os.environ.get("TM_TPU_HOST_HASH", "0")))
 
 _L_BYTES = L.to_bytes(32, "little")
@@ -121,14 +124,13 @@ def _pack_le_limbs(enc: np.ndarray) -> np.ndarray:
 
 def _bits_253(le32: np.ndarray) -> np.ndarray:
     """(B, 32) uint8 little-endian scalars (< 2^253) -> (253, B) int32 bits,
-    transposed for the ladder's row indexing."""
-    from ..native import load as _load_native
+    transposed for the ladder's row indexing.
 
-    native = _load_native()
+    Always the vectorized numpy path: the native pack_bits_le writes the
+    transposed output column-wise (one lane's 253 bits stride the whole
+    row axis) and measures 20 ms vs 1.8 ms here at a 10240 bucket — the
+    rare case where C loses to numpy on access pattern alone."""
     n = le32.shape[0]
-    if native is not None:
-        raw = native.pack_bits_le(np.ascontiguousarray(le32).tobytes(), n, 253)
-        return np.frombuffer(raw, dtype=np.int32).reshape(253, n).copy()
     # extract bits along the TRANSPOSED byte axis so the result lands
     # directly in ladder row order — no (B, 253) -> (253, B) strided
     # transpose copy (which dominated the old fallback at 10k lanes)
@@ -341,9 +343,19 @@ def prepare_batch_device_hash(entries, bucket: int) -> tuple:
         s_ok = _s_below_l(s_enc, n, bucket)
         with _span("ops.sha_pad"):
             if isinstance(entries, EntryBlock):
-                hi, lo, counts = _sha.pad_ram_block(
-                    entries, bucket, 64 + DEVICE_HASH_MAX_MSG
-                )
+                ram = None
+                if entries.ram_hi is not None:
+                    # fused commit prep already laid the R||A||M SHA
+                    # blocks per row — pad rows, skip the byte scatter
+                    ram = _sha.pad_ram_rows(
+                        entries, bucket, 64 + DEVICE_HASH_MAX_MSG
+                    )
+                if ram is not None:
+                    hi, lo, counts = ram
+                else:
+                    hi, lo, counts = _sha.pad_ram_block(
+                        entries, bucket, 64 + DEVICE_HASH_MAX_MSG
+                    )
             else:
                 msgs = [sig[:32] + pk + msg for pk, msg, sig in entries]
                 msgs += [b"\x01" + bytes(31) + b"\x01" + bytes(31)] * (

@@ -36,12 +36,26 @@ _EMPTY_OFFSETS = np.zeros(1, dtype=np.int64)
 
 
 class EntryBlock:
-    """Columnar (pub, msg, sig) batch; see module docstring."""
+    """Columnar (pub, msg, sig) batch; see module docstring.
 
-    __slots__ = ("pub", "sig", "msgs", "offsets")
+    Optional `ram_*` columns carry each row's R||A||M message already
+    padded into SHA-512 blocks and packed into the device-hash kernel's
+    big-endian 32-bit word layout (ops/sha512.pad_ram_block output, but
+    per ROW instead of per padded bucket): ram_hi/ram_lo (n, W) uint32
+    with W = nblock*16, ram_counts (n,) int32 blocks-used. The fused
+    commit prep (ops/commit_prep.py) fills them while composing the sign
+    bytes — the bytes are in cache anyway — so prepare_batch_device_hash
+    skips its big scatter and just pads rows. They ride through concat
+    and slicing like every other column; blocks without them (tuple-list
+    conversions, mixed sources) simply fall back to the generic pad."""
+
+    __slots__ = ("pub", "sig", "msgs", "offsets",
+                 "ram_hi", "ram_lo", "ram_counts")
 
     def __init__(self, pub: np.ndarray, sig: np.ndarray,
-                 msgs: Union[bytes, memoryview], offsets: np.ndarray):
+                 msgs: Union[bytes, memoryview], offsets: np.ndarray,
+                 ram_hi: "np.ndarray" = None, ram_lo: "np.ndarray" = None,
+                 ram_counts: "np.ndarray" = None):
         n = pub.shape[0]
         if pub.shape != (n, 32) or sig.shape != (n, 64):
             raise ValueError("pub must be (n, 32) and sig (n, 64) uint8")
@@ -56,6 +70,16 @@ class EntryBlock:
         self.sig = sig
         self.msgs = msgs
         self.offsets = offsets
+        if ram_hi is not None:
+            if (
+                ram_lo is None or ram_counts is None
+                or ram_hi.shape != ram_lo.shape or ram_hi.shape[0] != n
+                or ram_counts.shape != (n,)
+            ):
+                raise ValueError("ram columns must be (n, W) hi/lo + (n,) counts")
+        self.ram_hi = ram_hi
+        self.ram_lo = ram_lo
+        self.ram_counts = ram_counts
 
     # -- construction -------------------------------------------------------
 
@@ -153,11 +177,15 @@ class EntryBlock:
         o = self.offsets
         base = int(o[start])
         mv = memoryview(self.msgs)[base : int(o[stop])]
+        ram = self.ram_hi is not None
         return EntryBlock(
             self.pub[start:stop],
             self.sig[start:stop],
             mv,
             o[start : stop + 1] - base,
+            ram_hi=self.ram_hi[start:stop] if ram else None,
+            ram_lo=self.ram_lo[start:stop] if ram else None,
+            ram_counts=self.ram_counts[start:stop] if ram else None,
         )
 
     # -- combination --------------------------------------------------------
@@ -165,7 +193,9 @@ class EntryBlock:
     @staticmethod
     def concat(blocks: Sequence["EntryBlock"]) -> "EntryBlock":
         """One np.concatenate per column + one msgs join — the coalescing
-        pipeline's replacement for per-signature list.extend."""
+        pipeline's replacement for per-signature list.extend. A single
+        non-empty block passes through BY IDENTITY (no copies at all —
+        the common one-commit dispatch)."""
         blocks = [b for b in blocks if len(b)]
         if not blocks:
             return EntryBlock.empty()
@@ -182,7 +212,65 @@ class EntryBlock:
             offsets[pos + 1 : pos + len(b) + 1] = o[1:] + base
             pos += len(b)
             base += int(o[-1])
-        return EntryBlock(pub, sig, msgs, offsets)
+        ram_hi = ram_lo = ram_counts = None
+        if all(b.ram_hi is not None for b in blocks) and len(
+            {b.ram_hi.shape[1] for b in blocks}
+        ) == 1:
+            ram_hi = np.concatenate([b.ram_hi for b in blocks])
+            ram_lo = np.concatenate([b.ram_lo for b in blocks])
+            ram_counts = np.concatenate([b.ram_counts for b in blocks])
+        return EntryBlock(pub, sig, msgs, offsets,
+                          ram_hi=ram_hi, ram_lo=ram_lo,
+                          ram_counts=ram_counts)
+
+
+class CommitBlock:
+    """Columnar commit-signature representation — populated ONCE at wire
+    decode (types/block.py Commit.decode) so the verify hot path never
+    walks per-signature CommitSig objects. The CommitSig objects the
+    `commit.signatures` API exposes are LAZY VIEWS over these columns
+    (types/block.py CommitSigs), not the source of truth:
+
+        flags      (n,)    uint8   BlockIDFlag per signature
+        val_idx    (n,)    int32   validator index (signature order)
+        sig        (n, 64) uint8   signatures; absent lanes all-zero
+        ts_seconds (n,)    int64   vote timestamp seconds
+        ts_nanos   (n,)    int32   vote timestamp nanos
+        addr       (n, 20) uint8   validator addresses; absent lanes zero
+
+    Construction invariant (enforced by the builders in types/block.py):
+    every lane matches the canonical CommitSig shape — absent lanes have
+    no address/signature and the Go zero timestamp, non-absent lanes
+    carry a 20-byte address and exactly 64 signature bytes, and flags are
+    one of {ABSENT, COMMIT, NIL}. A commit violating that decodes to
+    plain CommitSig objects instead (no CommitBlock), so the object path
+    keeps raising exactly the errors it always raised."""
+
+    __slots__ = ("flags", "val_idx", "sig", "ts_seconds", "ts_nanos", "addr")
+
+    def __init__(self, flags: np.ndarray, val_idx: np.ndarray,
+                 sig: np.ndarray, ts_seconds: np.ndarray,
+                 ts_nanos: np.ndarray, addr: np.ndarray):
+        n = flags.shape[0]
+        if (
+            sig.shape != (n, 64) or addr.shape != (n, 20)
+            or val_idx.shape != (n,) or ts_seconds.shape != (n,)
+            or ts_nanos.shape != (n,)
+        ):
+            raise ValueError("CommitBlock column shapes disagree")
+        self.flags = flags
+        self.val_idx = val_idx
+        self.sig = sig
+        self.ts_seconds = ts_seconds
+        self.ts_nanos = ts_nanos
+        self.addr = addr
+
+    @property
+    def n(self) -> int:
+        return self.flags.shape[0]
+
+    def __len__(self) -> int:
+        return self.flags.shape[0]
 
 
 EntriesLike = Union[EntryBlock, Sequence[Entry]]

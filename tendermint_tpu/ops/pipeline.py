@@ -21,7 +21,6 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence, Tuple
 
@@ -45,40 +44,103 @@ class _Job:
 
 
 class AsyncBatchVerifier:
-    """Double-buffered pipeline over the device engine.
+    """Coalescing pipeline over the device engine with a SINGLE
+    dispatch-owner thread.
 
     submit(entries) returns a Future resolving to the (n,) bool validity
     array; entries may be an EntryBlock (handed downstream BY REFERENCE —
     the zero-copy commit path) or a (pub, msg, sig) tuple list (converted
-    once at this boundary). One worker thread owns all device dispatches;
-    `depth` in-flight batches bound device memory (2 = classic double
-    buffering).
-    """
+    once at this boundary).
+
+    Thread layout (PERF_r05 §2: the relay is one serial command channel —
+    transfers neither overlap execution nor tolerate concurrency, so
+    exactly ONE thread may touch it, and it must never block on anything
+    but the relay itself):
+
+      coalescer   drains submit()s, fuses jobs into device batches,
+                  farms host prep out to a small pool
+      dispatcher  the ONLY thread that launches kernels / issues device
+                  transfers; pulls prepared args FIFO off a queue, so
+                  callers and prep threads never convoy on the relay
+      resolver    blocks on device results (np.asarray) and completes
+                  futures — device waits never delay the next launch
+
+    `depth` bounds launched-but-unresolved batches (device memory;
+    2 = classic double buffering) via a semaphore between dispatcher and
+    resolver."""
 
     def __init__(self, depth: int = 3):
         self._depth = max(depth, 1)
         self._q: "queue.Queue[_Job]" = queue.Queue()
+        # (spans, prep_future, t_enqueue, ready_box) | None sentinel
+        self._dispatch_q: "queue.Queue" = queue.Queue()
+        self._resolve_q: "queue.Queue" = queue.Queue()
         self._stopped = threading.Event()
-        # wake signal for the worker: set on submit() and on prep-future
-        # completion so the worker can sleep instead of polling the job
-        # queue at 2 ms while preps are in flight (ADVICE r5)
-        self._wake = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._sem = threading.Semaphore(self._depth)
+        self._mtx = threading.Lock()
+        self._inflight = 0
+        # thread idents that ever launched a kernel — asserted single-
+        # element by tests/test_commit_block.py::TestDispatchOwnerThread
+        # (the relay-ownership invariant)
+        self.dispatch_thread_idents: set = set()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="verify-coalesce"
+        )
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatcher, daemon=True, name="verify-dispatch"
+        )
+        self._resolve_thread = threading.Thread(
+            target=self._resolver, daemon=True, name="verify-resolve"
+        )
         self._thread.start()
+        self._dispatch_thread.start()
+        self._resolve_thread.start()
 
     def submit(self, entries) -> Future:
         if self._stopped.is_set():
             raise RuntimeError("verifier is closed")
-        job = _Job(as_block(entries))
+        block = as_block(entries)
+        max_b = _backend.max_coalesce()
+        if len(block) > max_b:
+            return self._submit_chunked(block, max_b)
+        job = _Job(block)
         self._q.put(job)
-        self._wake.set()
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
 
+    def _submit_chunked(self, block: EntryBlock, max_b: int) -> Future:
+        """An oversized job rides as zero-copy slices through the normal
+        queue (the dispatcher stays the only device-touching thread; the
+        old path ran a chunked synchronous fallback on the worker) and
+        re-aggregates into one future."""
+        futs: List[Future] = []
+        i = 0
+        while i < len(block):
+            futs.append(self.submit(block[i : i + max_b]))
+            i += max_b
+        agg: Future = Future()
+        done_lock = threading.Lock()
+
+        def _combine(_f) -> None:
+            with done_lock:
+                if agg.done() or not all(f.done() for f in futs):
+                    return
+                try:
+                    parts = [np.asarray(f.result()) for f in futs]
+                except Exception as e:  # noqa: BLE001
+                    agg.set_exception(e)
+                    return
+                agg.set_result(np.concatenate(parts))
+
+        for f in futs:
+            f.add_done_callback(_combine)
+        return agg
+
     def close(self) -> None:
         self._stopped.set()
-        self._wake.set()
         self._thread.join(timeout=5)
+        self._dispatch_thread.join(timeout=5)
+        self._resolve_thread.join(timeout=5)
 
     # -- worker ----------------------------------------------------------
 
@@ -139,6 +201,13 @@ class AsyncBatchVerifier:
         _backend._note_device_batch(len(entries), bucket)
         return kern, args, None, bucket
 
+    @classmethod
+    def _prepare_timed(cls, entries):
+        """_prepare plus its own completion timestamp — returned IN the
+        future's value so the dispatcher's queue-wait measurement cannot
+        race the done-callback machinery."""
+        return cls._prepare(entries), time.perf_counter()
+
     def _dispatch(self, entries):
         """Synchronous prep + async device dispatch (kept for callers and
         tests that bypass the worker's prep pool)."""
@@ -168,175 +237,194 @@ class AsyncBatchVerifier:
             for job, _, _ in spans:
                 job.future.set_exception(e)
             return
+        # verdict delivery is pure numpy slicing: one view per job out of
+        # the batch verdict array — no per-entry Python anywhere between
+        # the device result and the caller's future
         for job, off, n in spans:
             job.future.set_result(arr[off : off + n])
 
     def _worker(self) -> None:
-        """Coalescing pipeline: many small commits (e.g. 128-signature
-        headers during header sync) fuse into ONE device batch up to the
-        max bucket — per-dispatch latency on the relay-attached TPU is
-        tens of ms, so per-commit dispatches would cap throughput at
+        """Coalescer: many small commits (e.g. 128-signature headers
+        during header sync) fuse into ONE device batch up to the max
+        bucket — per-dispatch latency on the relay-attached TPU is tens
+        of ms, so per-commit dispatches would cap throughput at
         ~1/latency regardless of batch size.
 
         Host prep runs on a small thread pool so batch N+1's packing/
-        hashing overlaps batch N's prep AND the device kernel: with the
-        RLC kernel at ~23 ms/batch and prep at ~35 ms, a single
-        prep-then-dispatch thread was prep-bound at ~39 ms/batch
-        (measured 257k sigs/s); overlapped prep restores the kernel-bound
-        rate. Device dispatch itself stays on this one worker thread."""
+        hashing overlaps batch N's prep AND the device kernel; prepared
+        batches are handed to the dispatch-owner thread in FIFO order via
+        the dispatch queue. This thread never touches the device."""
         from concurrent.futures import ThreadPoolExecutor
 
         prep_pool = ThreadPoolExecutor(3, thread_name_prefix="verify-prep")
-        preps: deque = deque()  # (spans, prep_future)
-        pending: deque = deque()  # (spans, device_value, rlc_entries)
         hold: Optional[_Job] = None
         max_b = _backend.max_coalesce()
-        wake = self._wake
+        m = _backend._ops_m()
         try:
-            while not (
-                self._stopped.is_set() and self._q.empty()
-                and not preps and not pending and hold is None
-            ):
-                jobs = []
-                total = 0
+            while True:
                 job = hold
                 hold = None
                 if job is None:
                     try:
-                        job = self._q.get_nowait()
+                        job = self._q.get(timeout=0.05)
                     except queue.Empty:
-                        job = None
-                    # actionable without a new job: a finished head prep
-                    # (dispatch), pending beyond depth (forced resolve),
-                    # or pending with no preps (the drain-to-idle resolve
-                    # branch below, which blocks on the device)
-                    actionable = (
-                        (preps and preps[0][1].done())
-                        or len(pending) > self._depth
-                        or (pending and not preps)
-                    )
-                    if job is None and not actionable:
-                        # Nothing actionable: sleep until a submission or
-                        # the head prep's done-callback sets the wake
-                        # event (no 2 ms busy-poll while preps are in
-                        # flight — ADVICE r5). Recheck after clear() so a
-                        # set() racing the clear is never lost.
-                        wake.clear()
-                        if (
-                            self._q.empty()
-                            and not (preps and preps[0][1].done())
-                            and not self._stopped.is_set()
-                        ):
-                            wake.wait(0.2)
-                        try:
-                            job = self._q.get_nowait()
-                        except queue.Empty:
-                            job = None
-                if job is not None:
-                    jobs.append(job)
-                    total = len(job.entries)
-                    # coalescing window: while the device pipeline is busy
-                    # a short linger costs nothing (the dispatch would
-                    # queue anyway) and fuses straggler jobs into bigger
-                    # batches — the relay pays a flat ~14 ms per transfer,
-                    # so fewer, larger batches are strictly faster
-                    deadline = (
-                        time.monotonic() + 0.008 if (pending or preps) else 0.0
-                    )
-                    while total < max_b:
-                        try:
-                            nxt = self._q.get_nowait()
-                        except queue.Empty:
-                            wait = deadline - time.monotonic()
-                            if wait <= 0:
-                                break
-                            try:
-                                nxt = self._q.get(timeout=wait)
-                            except queue.Empty:
-                                break
-                        if total + len(nxt.entries) > max_b:
-                            hold = nxt
+                        if self._stopped.is_set() and self._q.empty():
                             break
-                        jobs.append(nxt)
-                        total += len(nxt.entries)
-                    # bucket-fit: kernel buckets are quantized, so a total
-                    # just past a bucket pays that bucket's FULL padding in
-                    # device time and host prep — peel trailing jobs back
-                    # while doing so lands the batch in a smaller bucket
-                    # with less waste
-                    while len(jobs) > 1 and hold is None:
-                        b = _backend.quantized_bucket(total)
-                        if b - total <= max(b // 8, 1024):
-                            break
-                        shorter = _backend.quantized_bucket(
-                            total - len(jobs[-1].entries)
-                        )
-                        if shorter >= b:
-                            break
-                        hold = jobs.pop()
-                        total -= len(hold.entries)
-                if jobs:
-                    _backend._ops_m().pipeline_coalesced_jobs.observe(len(jobs))
-                    if total > max_b:
-                        # single oversized job: chunked synchronous fallback
-                        for j in jobs:
-                            try:
-                                j.future.set_result(
-                                    _backend.verify_batch(j.entries)
-                                )
-                            except Exception as e:  # noqa: BLE001
-                                j.future.set_exception(e)
-                    else:
-                        spans = []
-                        off = 0
-                        for j in jobs:
-                            spans.append((j, off, len(j.entries)))
-                            off += len(j.entries)
-                        # columnar coalescing: one concatenate per column
-                        # instead of a per-signature list-extend
-                        entries = EntryBlock.concat([j.entries for j in jobs])
-                        fut = prep_pool.submit(self._prepare, entries)
-                        fut.add_done_callback(lambda _f: wake.set())
-                        preps.append((spans, fut))
-                # dispatch every finished prep in FIFO order; if the device
-                # would otherwise go idle (nothing pending), wait for the
-                # head prep instead of spinning
-                while preps and (
-                    preps[0][1].done() or (not pending and not jobs)
-                ):
-                    spans, fut = preps.popleft()
+                        continue
+                jobs = [job]
+                total = len(job.entries)
+                # coalescing window: while the device pipeline is busy a
+                # short linger costs nothing (the dispatch would queue
+                # anyway) and fuses straggler jobs into bigger batches —
+                # the relay pays a flat ~14 ms per transfer, so fewer,
+                # larger batches are strictly faster
+                busy = self._inflight > 0 or self._dispatch_q.qsize() > 0
+                deadline = time.monotonic() + 0.008 if busy else 0.0
+                while total < max_b:
                     try:
-                        f, args, rlc_entries, bucket = fut.result()
-                        with _span("pipeline.dispatch", bucket=bucket):
-                            dev = f(*args)
-                        # start the device->host copy NOW: a blocking fetch
-                        # through the relay costs a full ~65ms RTT, but an
-                        # async copy rides behind the compute, so the later
-                        # np.asarray in _resolve returns in microseconds
-                        # (measured: sustained 152k -> 286k sigs/s)
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        wait = deadline - time.monotonic()
+                        if wait <= 0:
+                            break
                         try:
-                            dev.copy_to_host_async()
-                        except AttributeError:
-                            pass
-                        pending.append(
-                            (spans, dev, rlc_entries, time.perf_counter(),
-                             bucket)
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        for j, _, _ in spans:
-                            j.future.set_exception(e)
-                while len(pending) > self._depth:
-                    self._resolve(*pending.popleft())
-                if not jobs and not preps and pending:
-                    self._resolve(*pending.popleft())
-                # refresh the backlog gauges every iteration — including
-                # the drain-to-idle one, so they read 0 when idle instead
-                # of going stale at the last busy value
-                m = _backend._ops_m()
-                m.pipeline_inflight.set(len(pending))
+                            nxt = self._q.get(timeout=wait)
+                        except queue.Empty:
+                            break
+                    if total + len(nxt.entries) > max_b:
+                        hold = nxt
+                        break
+                    jobs.append(nxt)
+                    total += len(nxt.entries)
+                # bucket-fit: kernel buckets are quantized, so a total
+                # just past a bucket pays that bucket's FULL padding in
+                # device time and host prep — peel trailing jobs back
+                # while doing so lands the batch in a smaller bucket
+                # with less waste
+                while len(jobs) > 1 and hold is None:
+                    b = _backend.quantized_bucket(total)
+                    if b - total <= max(b // 8, 1024):
+                        break
+                    shorter = _backend.quantized_bucket(
+                        total - len(jobs[-1].entries)
+                    )
+                    if shorter >= b:
+                        break
+                    hold = jobs.pop()
+                    total -= len(hold.entries)
+                m.pipeline_coalesced_jobs.observe(len(jobs))
+                spans = []
+                off = 0
+                for j in jobs:
+                    spans.append((j, off, len(j.entries)))
+                    off += len(j.entries)
+                # columnar coalescing: one concatenate per column instead
+                # of a per-signature list-extend; a single-job dispatch
+                # passes its EntryBlock through BY IDENTITY (zero copies)
+                entries = (
+                    jobs[0].entries
+                    if len(jobs) == 1
+                    else EntryBlock.concat([j.entries for j in jobs])
+                )
+                fut = prep_pool.submit(self._prepare_timed, entries)
+                self._dispatch_q.put((spans, fut, time.perf_counter()))
+                m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 m.pipeline_queue_depth.set(self._q.qsize())
         finally:
+            self._dispatch_q.put(None)
             prep_pool.shutdown(wait=False)
+
+    def _dispatcher(self) -> None:
+        """The dispatch-owner: the ONLY thread that launches kernels (and
+        with them the host->device transfers). Prepared batches arrive
+        FIFO; the `pipeline.queue_wait` span records prepared-to-launched
+        time (including depth backpressure) so span_summary separates
+        queue-wait from relay time (`pipeline.dispatch`)."""
+        m = _backend._ops_m()
+        # occupancy is WINDOWED (reset every ~2s): a cumulative-since-
+        # start average would read near zero forever after a long idle
+        # stretch, hiding relay saturation from /status
+        win_start = time.perf_counter()
+        win_busy = 0.0
+        while True:
+            try:
+                item = self._dispatch_q.get(timeout=2.0)
+            except queue.Empty:
+                # idle tick: decay the occupancy window so the gauge
+                # reads ~0 when no traffic flows instead of sticking at
+                # the last busy value
+                now = time.perf_counter()
+                elapsed = now - win_start
+                if elapsed >= 2.0:
+                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+                    win_start, win_busy = now, 0.0
+                continue
+            if item is None:
+                self._resolve_q.put(None)
+                break
+            spans, fut, t_enq = item
+            m.dispatch_queue_depth.set(self._dispatch_q.qsize())
+            try:
+                (f, args, rlc_entries, bucket), t_ready = fut.result()
+            except Exception as e:  # noqa: BLE001
+                for j, _, _ in spans:
+                    j.future.set_exception(e)
+                continue
+            self._sem.acquire()  # depth: launched-but-unresolved bound
+            t0 = time.perf_counter()
+            if _trace.TRACER.enabled:
+                _trace.TRACER.record(
+                    "pipeline.queue_wait", max(t_enq, t_ready), t0,
+                    {"bucket": bucket},
+                )
+            self.dispatch_thread_idents.add(threading.get_ident())
+            try:
+                with _span("pipeline.dispatch", bucket=bucket):
+                    dev = f(*args)
+                # start the device->host copy NOW: a blocking fetch
+                # through the relay costs a full ~65ms RTT, but an async
+                # copy rides behind the compute, so the later np.asarray
+                # in _resolve returns in microseconds (measured:
+                # sustained 152k -> 286k sigs/s)
+                try:
+                    dev.copy_to_host_async()
+                except AttributeError:
+                    pass
+            except Exception as e:  # noqa: BLE001
+                self._sem.release()
+                for j, _, _ in spans:
+                    j.future.set_exception(e)
+                continue
+            with self._mtx:
+                self._inflight += 1
+                m.pipeline_inflight.set(self._inflight)
+            now = time.perf_counter()
+            win_busy += now - t0
+            elapsed = now - win_start
+            if elapsed >= 2.0:
+                m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+                win_start, win_busy = now, 0.0
+            elif elapsed > 0:
+                m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+            self._resolve_q.put(
+                (spans, dev, rlc_entries, now, bucket)
+            )
+
+    def _resolver(self) -> None:
+        """Completes futures: blocks on device materialization so neither
+        the coalescer nor the dispatch-owner ever waits on a result."""
+        m = _backend._ops_m()
+        while True:
+            item = self._resolve_q.get()
+            if item is None:
+                break
+            try:
+                self._resolve(*item)
+            finally:
+                with self._mtx:
+                    self._inflight -= 1
+                    m.pipeline_inflight.set(self._inflight)
+                self._sem.release()
 
 
 _shared: Optional[AsyncBatchVerifier] = None
@@ -370,7 +458,39 @@ def commit_entries(
     The sign bytes come back as ONE contiguous buffer + offset table
     (Commit.vote_sign_bytes_block) and ride by reference all the way to
     the kernel prep — no per-signature PyBytes or tuples. Callers that
-    need tuples can block.to_entries()."""
+    need tuples can block.to_entries().
+
+    Columnar commits (CommitBlock from wire decode, or built+cached on
+    first use) with all-ed25519 validator columns take the FUSED path:
+    selection, tally, sign-bytes, gather, and the device-hash RAM blocks
+    in one call (native GIL-released when built)."""
+    from . import commit_prep as _cp
+
+    with _span("pipeline.commit_prep_fused", n=len(commit.signatures)):
+        fused = _cp.prep_commit_from(
+            commit,
+            vals,
+            chain_id,
+            voting_power_needed,
+            _cp.MODE_SELECT_COMMIT_ONLY | _cp.MODE_EARLY_STOP,
+        )
+    if fused is not None:
+        sel, tallied, blk = fused
+        if blk is None:
+            raise ErrNotEnoughVotingPowerSigned(
+                got=tallied, needed=voting_power_needed
+            )
+        return blk, tallied
+    return commit_entries_legacy(chain_id, vals, commit, voting_power_needed)
+
+
+def commit_entries_legacy(
+    chain_id: str, vals, commit, voting_power_needed: int
+) -> Tuple[EntryBlock, int]:
+    """The PR-2 columnar path, object-walking selection + per-stage
+    composition: the fallback for non-columnar commits/valsets, and the
+    pinned baseline the fused path is gated against (tools/prep_bench.py
+    --fused, tests/test_gil_budget.py)."""
     idxs = []
     tallied = 0
     for idx, cs in enumerate(commit.signatures):

@@ -165,6 +165,41 @@ def validate_hash(h: bytes) -> None:
         raise ValueError(f"expected size to be {tmhash.SIZE} bytes, got {len(h)} bytes")
 
 
+def _fused_commit_prep(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+):
+    """Columnar fast path: CommitBlock + validator columns through ONE
+    fused prep call (ops/commit_prep.py — native GIL-released when
+    built). Returns (sel_idx, tallied, EntryBlock-or-None) or None when
+    this commit/valset/predicate combination is not columnar-
+    representable (the object path below then reproduces the exact
+    legacy behavior and errors)."""
+    from ..ops import commit_prep as _cp
+
+    if ignore_sig is _ignore_not_for_block:
+        mode = _cp.MODE_SELECT_COMMIT_ONLY
+    elif ignore_sig is _ignore_absent:
+        mode = 0
+    else:
+        return None
+    if count_sig is _count_for_block:
+        mode |= _cp.MODE_COUNT_FOR_BLOCK
+    elif count_sig is not _count_all:
+        return None
+    if not count_all_signatures:
+        mode |= _cp.MODE_EARLY_STOP
+    with _span("verify_commit.prep_fused", n=len(commit.signatures)):
+        return _cp.prep_commit_from(
+            commit, vals, chain_id, voting_power_needed, mode
+        )
+
+
 def _verify_commit_batch(
     chain_id: str,
     vals: ValidatorSet,
@@ -184,6 +219,45 @@ def _verify_commit_batch(
         raise RuntimeError(
             "unsupported signature algorithm or insufficient signatures for batch verification"
         )
+    add_block = getattr(bv, "add_block", None)
+    if look_up_by_index and add_block is not None:
+        fused = _fused_commit_prep(
+            chain_id,
+            vals,
+            commit,
+            voting_power_needed,
+            ignore_sig,
+            count_sig,
+            count_all_signatures,
+        )
+        if fused is not None:
+            import numpy as _np
+
+            sel_idx, tallied, eblk = fused
+            if eblk is None:
+                raise ErrNotEnoughVotingPowerSigned(
+                    got=tallied, needed=voting_power_needed
+                )
+            # key TYPE safety is proven by ed25519_columns (all-ed25519
+            # or the fused path is not taken); signature lengths are
+            # structural in the CommitBlock's (n, 64) column
+            add_block(eblk)
+            with _span("verify_commit.verify", n=len(eblk)):
+                ok, valid_sigs = bv.verify()
+            if ok:
+                return
+            # vectorized blame: first invalid lane via argmin over the
+            # bool verdict array (no per-entry Python scan)
+            valid_arr = _np.asarray(valid_sigs, dtype=bool)
+            if not valid_arr.all() and valid_arr.size:
+                idx = int(sel_idx[int(_np.argmin(valid_arr))])
+                sig = commit.signatures[idx]
+                raise ValueError(
+                    f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+                )
+            raise RuntimeError(
+                "BUG: batch verification failed with no invalid signatures"
+            )
     if count_all_signatures and look_up_by_index and ignore_sig is _ignore_absent:
         # verify_commit's exact predicate set on a 10k-validator commit is
         # the benchmark hot path: flag-attribute listcomps cut the
@@ -301,13 +375,15 @@ def _verify_commit_batch(
         ok, valid_sigs = bv.verify()
     if ok:
         return
-    for i, sig_ok in enumerate(valid_sigs):
-        if not sig_ok:
-            idx = batch_sig_idxs[i]
-            sig = commit.signatures[idx]
-            raise ValueError(
-                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
-            )
+    import numpy as _np
+
+    valid_arr = _np.asarray(valid_sigs, dtype=bool)
+    if not valid_arr.all() and valid_arr.size:
+        idx = batch_sig_idxs[int(_np.argmin(valid_arr))]
+        sig = commit.signatures[idx]
+        raise ValueError(
+            f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+        )
     raise RuntimeError("BUG: batch verification failed with no invalid signatures")
 
 

@@ -21,6 +21,9 @@ INT64_MIN = -(1 << 63)
 MAX_TOTAL_VOTING_POWER = INT64_MAX // 8  # validator_set.go:25
 PRIORITY_WINDOW_SIZE_FACTOR = 2  # validator_set.go:30
 
+# ed25519_columns cache sentinel: "computed, not columnar-representable"
+_NO_ED_COLS = object()
+
 
 def _clip64(v: int) -> int:
     return max(INT64_MIN, min(INT64_MAX, v))
@@ -133,6 +136,7 @@ class ValidatorSet:
         self.proposer: Optional[Validator] = proposer
         self._total_voting_power: int = 0
         self._hash: Optional[bytes] = None
+        self._ed_cols: Optional[tuple] = None
 
     # ---- construction -------------------------------------------------
 
@@ -249,6 +253,42 @@ class ValidatorSet:
             )
         return self._hash
 
+    def ed25519_columns(self) -> Optional[tuple]:
+        """(pub (n, 32) uint8, power (n,) int64) columns over the set, or
+        None unless EVERY validator key is ed25519 — the commit verify
+        fast path (types/validation.py fused branch) gathers selected
+        lanes from these instead of walking Validator objects per
+        signature. Cached; invalidated with the hash cache on membership/
+        power changes (everything flows through _update_with_change_set).
+        A None result also serves as the per-key TYPE check: a mixed-key
+        set falls back to the object path, which raises exactly as
+        per-entry add() did."""
+        if self._ed_cols is not None:
+            cols = self._ed_cols
+            return cols if cols is not _NO_ED_COLS else None
+        import numpy as np
+
+        from ..crypto import ed25519 as _ed25519
+
+        vals = self.validators
+        n = len(vals)
+        cols = None
+        if n and all(
+            isinstance(v.pub_key, _ed25519.PubKey) for v in vals
+        ):
+            pub_b = b"".join(v.pub_key.bytes() for v in vals)
+            if len(pub_b) == 32 * n:
+                cols = (
+                    np.frombuffer(pub_b, dtype=np.uint8).reshape(n, 32),
+                    np.fromiter(
+                        (v.voting_power for v in vals),
+                        dtype=np.int64,
+                        count=n,
+                    ),
+                )
+        self._ed_cols = cols if cols is not None else _NO_ED_COLS
+        return cols
+
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():
             raise ValueError("validator set is nil or empty")
@@ -330,6 +370,7 @@ class ValidatorSet:
 
     def _update_with_change_set(self, changes: List[Validator], allow_deletes: bool) -> None:
         self._hash = None  # membership/power may change below
+        self._ed_cols = None
         if not changes:
             return
         updates, deletes = _process_changes(changes)

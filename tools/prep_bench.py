@@ -36,6 +36,8 @@ os.environ.setdefault("TM_TPU_PUREPY_CRYPTO", "1")
 if "--native" not in sys.argv:
     os.environ["TM_TPU_NO_NATIVE"] = "1"
 
+FUSED_SPEEDUP_GATE = 1.3  # --fused: decode->kernel-args vs the PR-2 path
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
@@ -104,6 +106,79 @@ def commit_entries_tuples(chain_id, vals, commit, voting_power_needed):
     ]
 
 
+def run_fused(args) -> int:
+    """--fused: the round-6 columnar-from-decode gate. Measures the full
+    decode-to-kernel-args path — wire-decoded commit (CommitBlock
+    columns) -> fused prep (ops/commit_prep.py) -> device-hash kernel
+    args — against the PR-2 columnar path (commit_entries_legacy object
+    walk + generic pad), enforces bit-identical kernel args, and gates
+    the speedup at >= FUSED_SPEEDUP_GATE on CPU."""
+    import statistics as stats
+
+    from tendermint_tpu.native import load as _load_native
+    from tendermint_tpu.ops import backend, pipeline
+    from tendermint_tpu.types.block import Commit
+
+    chain_id = "prep-bench"
+    vset, commit = build_synthetic_commit(args.sigs)
+    needed = vset.total_voting_power() * 2 // 3
+    bucket = backend._bucket_for(args.sigs)
+    native = _load_native()
+    dec = Commit.decode(commit.encode())
+    if dec.commit_block() is None:
+        print("  FAIL: decode did not produce a CommitBlock", file=sys.stderr)
+        return 2
+    print(
+        f"prep_bench --fused: n={args.sigs} bucket={bucket} reps={args.reps} "
+        f"native={'yes' if native is not None else 'no'} "
+        f"backend={os.environ.get('JAX_PLATFORMS', '?')}"
+    )
+
+    def fused():
+        dec._sb_tpl = None
+        blk, _ = pipeline.commit_entries(chain_id, vset, dec, needed)
+        return backend.prepare_batch_device_hash(blk, bucket)
+
+    def pr2():
+        commit._sb_tpl = None
+        blk, _ = pipeline.commit_entries_legacy(
+            chain_id, vset, commit, needed
+        )
+        return backend.prepare_batch_device_hash(blk, bucket)
+
+    # interleave reps so machine noise hits both paths equally
+    fused()
+    pr2()
+    t_f, t_p = [], []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        fused()
+        t_f.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        pr2()
+        t_p.append(time.perf_counter() - t0)
+    f_ms = stats.median(t_f) * 1e3
+    p_ms = stats.median(t_p) * 1e3
+    speedup = p_ms / f_ms if f_ms else float("inf")
+    a_f = fused()
+    a_p = pr2()
+    parity = all(np.array_equal(x, y) for x, y in zip(a_f, a_p))
+    print(f"  PR-2 columnar (decode->args): {p_ms:9.2f} ms")
+    print(f"  fused columnar-from-decode  : {f_ms:9.2f} ms")
+    print(f"  speedup                     : {speedup:9.2f}x")
+    print(f"  arg parity                  : {'OK' if parity else 'MISMATCH'}")
+    if not parity:
+        return 2
+    if speedup < FUSED_SPEEDUP_GATE:
+        print(
+            f"  FAIL: expected >= {FUSED_SPEEDUP_GATE}x decode->kernel-args "
+            "speedup",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -114,7 +189,15 @@ def main() -> int:
         help="keep the native module (default: TM_TPU_NO_NATIVE=1 to bench "
         "the pure-Python fallback, the acceptance configuration)",
     )
+    ap.add_argument(
+        "--fused",
+        action="store_true",
+        help="round-6 gate: fused columnar-from-decode path vs the PR-2 "
+        "columnar path (arg parity enforced, speedup gated)",
+    )
     args = ap.parse_args()
+    if args.fused:
+        return run_fused(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline
