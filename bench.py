@@ -451,6 +451,27 @@ def worker() -> None:
 
             traceback.print_exc()
             print(f"# verify_commit stream bench failed: {e}", file=sys.stderr)
+    if attempts:
+        # stream-variance accounting (PERF_r06 §4 follow-through): the
+        # min/mean/max spread of per-attempt queue-wait and relay
+        # occupancy across the stream attempts — a tight spread with
+        # queue_wait >> dispatch confirms the single dispatch-owner is
+        # pacing the relay; a wide spread refutes it
+        def _spread(key):
+            vals = [a.get(key, 0.0) for a in attempts]
+            return {
+                "min": round(min(vals), 3),
+                "mean": round(sum(vals) / len(vals), 3),
+                "max": round(max(vals), 3),
+            }
+
+        span_summary["stream_rate_spread_sigs_per_s"] = _spread("rate")
+        span_summary["stream_queue_wait_ms_p50"] = _spread(
+            "queue_wait_ms_p50"
+        )
+        span_summary["stream_dispatch_relay_ms_p50"] = _spread(
+            "dispatch_relay_ms_p50"
+        )
     dev_s = 1.0 / sus_rate if sus_rate else single_s
 
     try:
@@ -620,9 +641,15 @@ def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
     device batches pipeline through the shared AsyncBatchVerifier) and
     return (best_rate, attempts). Relay-health gating: retry when the RTT
     exceeds RTT_HEALTHY_MS or the attempt disagrees with the best by >15%
-    — one bad-luck relay window must not record a 2x-low number."""
+    — one bad-luck relay window must not record a 2x-low number.
+
+    Each attempt runs span-traced (cleared per pass) and carries its OWN
+    queue_wait_ms_p50 / dispatch_relay_ms_p50 — the per-attempt numbers
+    PERF_r06 §4 deferred, so the dispatch-owner fix is confirmed (or
+    refuted) by the attempt-to-attempt spread, not a single aggregate."""
     from concurrent.futures import ThreadPoolExecutor
 
+    from tendermint_tpu.observability import trace as _tr
     from tendermint_tpu.types import validation as _val
 
     RTT_HEALTHY_MS = float(os.environ.get("TM_TPU_BENCH_RTT_HEALTHY_MS", "90"))
@@ -636,17 +663,26 @@ def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
             commit._sb_tpl = None
             commit._hash = None
 
-    def one_pass() -> float:
+    def one_pass(traced: bool = False) -> tuple:
         clear_caches()
-        with ThreadPoolExecutor(len(jobs)) as ex:
-            t0 = time.perf_counter()
-            futs = [
-                ex.submit(_val.verify_commit, cid, vs, bid, h, cm)
-                for cid, vs, bid, h, cm in jobs
-            ]
-            for f in futs:
-                f.result()  # raises on any verification failure
-            return len(jobs) * n_sigs / (time.perf_counter() - t0)
+        if traced:
+            _tr.TRACER.clear()
+            _tr.configure(enabled=True)
+        try:
+            with ThreadPoolExecutor(len(jobs)) as ex:
+                t0 = time.perf_counter()
+                futs = [
+                    ex.submit(_val.verify_commit, cid, vs, bid, h, cm)
+                    for cid, vs, bid, h, cm in jobs
+                ]
+                for f in futs:
+                    f.result()  # raises on any verification failure
+                rate = len(jobs) * n_sigs / (time.perf_counter() - t0)
+        finally:
+            spans = _tr.TRACER.summary() if traced else {}
+            if traced:
+                _tr.configure(enabled=False)
+        return rate, spans
 
     one_pass()  # warm: compiles shapes, fills ValidatorSet-level caches
     attempts = []
@@ -656,8 +692,17 @@ def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
         gc.collect()  # each pass churns ~100 MB of entry tuples/arrays;
         # collect OUTSIDE the timed window, not during it
         rtt = measure_rtt()
-        rate = one_pass()
-        attempts.append({"rate": round(rate, 1), "rtt_ms": round(rtt, 1)})
+        rate, spans = one_pass(traced=True)
+        attempts.append({
+            "rate": round(rate, 1),
+            "rtt_ms": round(rtt, 1),
+            "queue_wait_ms_p50": round(
+                spans.get("pipeline.queue_wait", {}).get("p50_ms", 0.0), 3
+            ),
+            "dispatch_relay_ms_p50": round(
+                spans.get("pipeline.dispatch", {}).get("p50_ms", 0.0), 3
+            ),
+        })
         print(f"# verify_commit stream attempt {attempt}: {rate:.0f} sigs/s "
               f"(rtt {rtt:.0f}ms)", file=sys.stderr)
         # best-of over >= MIN_ATTEMPTS passes: batch splits and GIL

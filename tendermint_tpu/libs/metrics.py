@@ -354,6 +354,27 @@ class OpsMetrics:
             "ops", "dispatch_busy_ratio",
             "Dispatch-owner thread occupancy (launch time / wall time).",
         )
+        # valset epoch cache (ops/epoch_cache.py): hits = warm epochs
+        # (committee already device-resident), misses = cold epochs
+        # (table registered, first commit rides the uncached path),
+        # evictions = LRU pops past TM_TPU_EPOCH_CACHE depth
+        self.epoch_cache_hits = registry.counter(
+            "ops", "epoch_cache_hits_total",
+            "Commit preps that found their validator set device-resident.",
+        )
+        self.epoch_cache_misses = registry.counter(
+            "ops", "epoch_cache_misses_total",
+            "Commit preps that registered a new validator-set epoch.",
+        )
+        self.epoch_cache_evictions = registry.counter(
+            "ops", "epoch_cache_evictions_total",
+            "Validator-set epochs evicted from the device cache (LRU).",
+        )
+        self.h2d_bytes_per_commit = registry.gauge(
+            "ops", "h2d_bytes_per_commit",
+            "Host bytes shipped to the device by the last dispatched "
+            "batch, averaged over its coalesced commits.",
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -410,6 +431,10 @@ def ops_stats() -> dict:
         "pipeline_inflight": int(m.pipeline_inflight.value()),
         "dispatch_queue_depth": int(m.dispatch_queue_depth.value()),
         "dispatch_busy_ratio": float(m.dispatch_busy_ratio.value()),
+        "epoch_cache_hits": int(m.epoch_cache_hits.total()),
+        "epoch_cache_misses": int(m.epoch_cache_misses.total()),
+        "epoch_cache_evictions": int(m.epoch_cache_evictions.total()),
+        "h2d_bytes_per_commit": float(m.h2d_bytes_per_commit.value()),
     }
 
 

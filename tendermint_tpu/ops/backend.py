@@ -329,6 +329,112 @@ def prepare_batch(entries, bucket: int) -> tuple:
     return args
 
 
+def h2d_arg_bytes(args) -> int:
+    """Host bytes a kernel-argument tuple ships to the device: numpy
+    arrays transfer per call; jax Arrays (the epoch tables) are already
+    device-resident and cost nothing per batch."""
+    return sum(
+        a.nbytes for a in args if isinstance(a, np.ndarray)
+    )
+
+
+def _pack_sig_rows(entries, bucket: int, ep):
+    """Shared per-signature row prep for the epoch-cached paths: raw
+    r/s rows (padding lanes identity/zero — the exact pattern _pack_rows
+    gives the uncached kernels), host s<L flags, and the gather indices
+    (padding lanes -> the table's identity row ep.vp - 1)."""
+    n = len(entries)
+    r_rows = np.zeros((bucket, 32), dtype=np.uint8)
+    s_rows = np.zeros((bucket, 32), dtype=np.uint8)
+    idx = np.full((bucket,), ep.vp - 1, dtype=np.int32)
+    if n:
+        r_rows[:n] = entries.sig[:, :32]
+        s_rows[:n] = entries.sig[:, 32:]
+        idx[:n] = entries.val_idx
+    r_rows[n:, 0] = 1
+    s_ok = _s_below_l(s_rows, n, bucket)
+    return idx, r_rows, s_rows, s_ok
+
+
+def cached_sig_args(entries: EntryBlock, bucket: int, ep) -> tuple:
+    """The shared warm-epoch per-signature argument set: (idx, r_rows,
+    s_rows, k_rows, s_ok (bucket,) bool) — gather indices, raw rows, and
+    host SHA-512 challenges. Consumed by prepare_batch_cached (XLA) and
+    pallas_verify.prepare_compact_cached; any padding or challenge-prep
+    change lands in ONE place."""
+    n = len(entries)
+    idx, r_rows, s_rows, s_ok = _pack_sig_rows(entries, bucket, ep)
+    k_rows = np.zeros((bucket, 32), dtype=np.uint8)
+    if n:
+        with _span("ops.challenges"):
+            ks = _challenges_block(r_rows[:n], entries.pub, entries)
+        k_rows[:n] = np.frombuffer(ks, dtype=np.uint8).reshape(n, 32)
+    return idx, r_rows, s_rows, k_rows, s_ok
+
+
+def prepare_batch_cached(entries: EntryBlock, bucket: int, ep) -> tuple:
+    """Warm-epoch prep for jitted_verify_cached: NO pubkey-derived arrays
+    and NO host limb/bit packing — the batch ships raw 32-byte rows
+    (r/s/k) plus val_idx gather indices, and the device prologue unpacks
+    (ed25519_verify.unpack_limbs_rows / bits253_rows). ~101 B/sig vs
+    ~2.2 kB/sig for prepare_batch's unpacked arrays."""
+    t0 = time.perf_counter()
+    with _span("ops.host_prep", n=len(entries), bucket=bucket, cached=1):
+        args = cached_sig_args(entries, bucket, ep)
+    _ops_m().host_prep_seconds.observe(
+        time.perf_counter() - t0, bucket=str(bucket)
+    )
+    return args
+
+
+def prepare_batch_cached_device_hash(
+    entries: EntryBlock, bucket: int, ep
+) -> tuple:
+    """Warm-epoch device-hash prep: per-signature R||A||M SHA blocks (the
+    hash input — message data, shipped either way) + raw r/s rows +
+    val_idx. Drops prepare_batch_device_hash's pubkey limb pack and the
+    s-bit transpose entirely."""
+    from . import sha512 as _sha
+
+    n = len(entries)
+    t0 = time.perf_counter()
+    with _span("ops.host_prep", n=n, bucket=bucket, hash="device", cached=1):
+        idx, r_rows, s_rows, s_ok = _pack_sig_rows(entries, bucket, ep)
+        with _span("ops.sha_pad"):
+            ram = None
+            if entries.ram_hi is not None:
+                ram = _sha.pad_ram_rows(
+                    entries, bucket, 64 + DEVICE_HASH_MAX_MSG
+                )
+            if ram is None:
+                ram = _sha.pad_ram_block(
+                    entries, bucket, 64 + DEVICE_HASH_MAX_MSG
+                )
+            hi, lo, counts = ram
+    args = (idx, r_rows, s_rows, hi, lo, counts, s_ok)
+    _ops_m().host_prep_seconds.observe(
+        time.perf_counter() - t0, bucket=str(bucket)
+    )
+    return args
+
+
+def cached_kernel(ep, device_hash: bool):
+    """Kernel closure for a warm epoch: resolves the entry's device
+    tables at CALL time — the caller is the pipeline's single
+    dispatch-owner thread, so the one-time table upload happens on the
+    only thread allowed to touch the relay."""
+    if device_hash:
+        base = ed25519_verify.jitted_verify_cached_device_hash()
+    else:
+        base = ed25519_verify.jitted_verify_cached()
+
+    def call(*args):
+        tbl_limbs, tbl_sign = ep.xla_tables()
+        return base(tbl_limbs, tbl_sign, *args)
+
+    return call
+
+
 def prepare_batch_device_hash(entries, bucket: int) -> tuple:
     """Device-hash argument prep: no host SHA-512 — messages ship as padded
     R||A||M SHA blocks. EntryBlock input pads columnar (pad_ram_block);
@@ -504,12 +610,23 @@ def verify_batch(entries) -> np.ndarray:
         return np.concatenate(out) if out else np.zeros((0,), dtype=bool)
 
     device_hash = not HOST_HASH and _max_msg_len(entries) <= DEVICE_HASH_MAX_MSG
+    from . import epoch_cache as _epoch
+
+    ep = _epoch.lookup(entries)
     out: List[np.ndarray] = []
     i = 0
     while i < len(entries):
         chunk = entries[i : i + BUCKETS[-1]]
         bucket = _bucket_for(len(chunk))
-        if device_hash:
+        if ep is not None:
+            # warm epoch: committee gathers from the device-resident
+            # table, per-sig rows ship raw and unpack on device
+            kern = cached_kernel(ep, device_hash)
+            if device_hash:
+                args = prepare_batch_cached_device_hash(chunk, bucket, ep)
+            else:
+                args = prepare_batch_cached(chunk, bucket, ep)
+        elif device_hash:
             kern = ed25519_verify.jitted_verify_device_hash()
             args = prepare_batch_device_hash(chunk, bucket)
         else:

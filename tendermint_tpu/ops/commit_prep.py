@@ -251,7 +251,7 @@ def prep_commit_from(
         return None
     tpl_c = commit.sign_bytes_template(chain_id, FLAG_COMMIT)
     tpl_n = commit.sign_bytes_template(chain_id, FLAG_NIL)
-    return prep_commit(
+    sel, tallied, block = prep_commit(
         cblock,
         cols[0],
         cols[1],
@@ -262,6 +262,17 @@ def prep_commit_from(
         mode,
         ram_max_len,
     )
+    if block is not None:
+        # epoch-cache metadata: sel IS the valset row of each lane, and
+        # the key is only attached for WARM epochs (ops/epoch_cache.py) —
+        # downstream preps then ship gather indices instead of
+        # pubkey-derived arrays. A disabled cache returns None and the
+        # block is exactly what PR 4 produced.
+        from . import epoch_cache as _epoch
+
+        block.val_idx = sel.astype(np.int32)
+        block.epoch_key = _epoch.note_valset(vals)
+    return sel, tallied, block
 
 
 def prep_commit(

@@ -228,6 +228,82 @@ def verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok):
     return ok_a & ok_r & s_ok & is_ident
 
 
+# -- on-device unpack + epoch-cached variants --------------------------------
+#
+# The cached kernels take the COMMITTEE as a persistent device table
+# (uploaded once per epoch by ops/epoch_cache.py) plus per-signature gather
+# indices, and the per-signature scalars/encodings as RAW 32-byte rows —
+# limb and bit unpacking are trivial device work, while on the host they
+# were the bulk of prepare_batch's wall time (PERF_r06 §3). Steady-state
+# batches therefore ship ~101 B/sig instead of ~2.2 kB/sig on this path.
+
+
+def unpack_limbs_rows(enc):
+    """(B, 32) int32 LE bytes -> ((B, 20) int32 low-255-bit limbs, (B,)
+    int32 sign). The device twin of backend._pack_le_limbs — same 13-bit
+    windows, row-major; static per-limb byte arithmetic, no gathers."""
+    sign = enc[:, 31] >> 7
+    b31 = enc[:, 31] & 0x7F
+
+    def byte(i):
+        return b31 if i == 31 else enc[:, i]
+
+    rows = []
+    for i in range(fe.NLIMBS):
+        lo_bit = fe.RADIX * i
+        byte0 = lo_bit >> 3
+        shift = lo_bit & 7
+        v = byte(byte0)
+        if byte0 + 1 < 32:
+            v = v + (byte(byte0 + 1) << 8)
+        if byte0 + 2 < 32 and shift + fe.RADIX > 16:
+            v = v + (byte(byte0 + 2) << 16)
+        rows.append((v >> shift) & fe.MASK)
+    return jnp.stack(rows, axis=-1), sign
+
+
+def bits253_rows(enc):
+    """(B, 32) int32 LE scalar bytes (< 2^253) -> (253, B) int32 bits,
+    LSB-first, transposed for the ladder — the device twin of
+    backend._bits_253."""
+    bits = (enc[:, :, None] >> jnp.arange(8, dtype=enc.dtype)) & 1
+    return bits.reshape(enc.shape[0], 256).T[:253]
+
+
+def verify_kernel_cached(
+    a_tbl_limbs, a_tbl_sign, val_idx, r_enc, s_enc, k_enc, s_ok
+):
+    """verify_kernel with the committee gathered from a device-resident
+    epoch table and per-sig limb/bit unpack on device.
+
+    a_tbl_limbs (V, 20) int32 / a_tbl_sign (V,) int32: the epoch's pubkey
+    rows (row V-1 = identity, the padding lane). val_idx (B,) int32 gather
+    indices; r_enc/s_enc/k_enc (B, 32) uint8 raw rows."""
+    a_y = a_tbl_limbs[val_idx]
+    a_sign = a_tbl_sign[val_idx]
+    r_y, r_sign = unpack_limbs_rows(r_enc.astype(jnp.int32))
+    s_bits_t = bits253_rows(s_enc.astype(jnp.int32))
+    k_bits_t = bits253_rows(k_enc.astype(jnp.int32))
+    return verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok)
+
+
+def verify_kernel_cached_device_hash(
+    a_tbl_limbs, a_tbl_sign, val_idx, r_enc, s_enc,
+    blocks_hi, blocks_lo, n_blocks, s_ok
+):
+    """verify_kernel_device_hash on the epoch-cached committee: k hashes
+    on-chip from the shipped R||A||M blocks (per-signature message data),
+    A limbs gather from the device table, r/s unpack on device."""
+    digest = _sha.sha512_blocks(blocks_hi, blocks_lo, n_blocks)
+    k_limbs = sc.mod_l_from_bits(sc.digest_to_le_bits(digest))
+    k_bits_t = sc.limbs_to_bits(k_limbs, SCALAR_BITS)
+    a_y = a_tbl_limbs[val_idx]
+    a_sign = a_tbl_sign[val_idx]
+    r_y, r_sign = unpack_limbs_rows(r_enc.astype(jnp.int32))
+    s_bits_t = bits253_rows(s_enc.astype(jnp.int32))
+    return verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok)
+
+
 def verify_kernel_device_hash(
     a_y, a_sign, r_y, r_sign, s_bits_t, blocks_hi, blocks_lo, n_blocks, s_ok
 ):
@@ -249,3 +325,13 @@ def jitted_verify(donate: bool = False):
 @functools.lru_cache(maxsize=None)
 def jitted_verify_device_hash():
     return jax.jit(verify_kernel_device_hash)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_verify_cached():
+    return jax.jit(verify_kernel_cached)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_verify_cached_device_hash():
+    return jax.jit(verify_kernel_cached_device_hash)

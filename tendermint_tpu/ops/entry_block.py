@@ -50,12 +50,14 @@ class EntryBlock:
     conversions, mixed sources) simply fall back to the generic pad."""
 
     __slots__ = ("pub", "sig", "msgs", "offsets",
-                 "ram_hi", "ram_lo", "ram_counts")
+                 "ram_hi", "ram_lo", "ram_counts",
+                 "val_idx", "epoch_key")
 
     def __init__(self, pub: np.ndarray, sig: np.ndarray,
                  msgs: Union[bytes, memoryview], offsets: np.ndarray,
                  ram_hi: "np.ndarray" = None, ram_lo: "np.ndarray" = None,
-                 ram_counts: "np.ndarray" = None):
+                 ram_counts: "np.ndarray" = None,
+                 val_idx: "np.ndarray" = None, epoch_key: bytes = None):
         n = pub.shape[0]
         if pub.shape != (n, 32) or sig.shape != (n, 64):
             raise ValueError("pub must be (n, 32) and sig (n, 64) uint8")
@@ -80,6 +82,15 @@ class EntryBlock:
         self.ram_hi = ram_hi
         self.ram_lo = ram_lo
         self.ram_counts = ram_counts
+        # Epoch-cache metadata (ops/epoch_cache.py): val_idx (n,) int32 —
+        # each lane's row in its validator set's cached device pub table;
+        # epoch_key — the ValidatorSet.hash() the table is keyed by. When
+        # set, warm-epoch preps ship val_idx instead of pubkey-derived
+        # arrays and the kernels gather A on device.
+        if val_idx is not None and val_idx.shape != (n,):
+            raise ValueError("val_idx must be (n,)")
+        self.val_idx = val_idx
+        self.epoch_key = epoch_key
 
     # -- construction -------------------------------------------------------
 
@@ -186,6 +197,10 @@ class EntryBlock:
             ram_hi=self.ram_hi[start:stop] if ram else None,
             ram_lo=self.ram_lo[start:stop] if ram else None,
             ram_counts=self.ram_counts[start:stop] if ram else None,
+            val_idx=(
+                self.val_idx[start:stop] if self.val_idx is not None else None
+            ),
+            epoch_key=self.epoch_key,
         )
 
     # -- combination --------------------------------------------------------
@@ -219,9 +234,22 @@ class EntryBlock:
             ram_hi = np.concatenate([b.ram_hi for b in blocks])
             ram_lo = np.concatenate([b.ram_lo for b in blocks])
             ram_counts = np.concatenate([b.ram_counts for b in blocks])
+        # epoch metadata survives only a SAME-epoch merge: gather indices
+        # are rows of one valset's device table, so a mixed-key concat
+        # (the coalescer's mixed-valset fallback) drops to the uncached
+        # prep instead of gathering from the wrong table
+        val_idx = epoch_key = None
+        if (
+            blocks[0].epoch_key is not None
+            and all(b.epoch_key == blocks[0].epoch_key for b in blocks)
+            and all(b.val_idx is not None for b in blocks)
+        ):
+            epoch_key = blocks[0].epoch_key
+            val_idx = np.concatenate([b.val_idx for b in blocks])
         return EntryBlock(pub, sig, msgs, offsets,
                           ram_hi=ram_hi, ram_lo=ram_lo,
-                          ram_counts=ram_counts)
+                          ram_counts=ram_counts,
+                          val_idx=val_idx, epoch_key=epoch_key)
 
 
 class CommitBlock:

@@ -136,6 +136,41 @@ def _k1_rlc_kernel(a_ref, r_ref, scal_ref, coords_ref, ok_ref, dig_ref):
             coords_ref[_point_rows(p, c)] = pts[c][:, p * G : (p + 1) * G]
 
 
+def _k1_rlc_kernel_cached(ac_ref, aok_ref, r_ref, scal_ref, coords_ref,
+                          ok_ref, dig_ref):
+    """_k1_rlc_kernel for a WARM epoch: the M committee points per lane
+    arrive pre-decompressed (gathered on device from the epoch cache's
+    persistent coords table), so this variant decompresses M points (the
+    R's) instead of 2M — K1 was ~half committee work by construction.
+
+    ac: (M*4*32, B) int32 — slot-major A coords, point p coord c at rows
+    (p*4 + c)*32; aok: (M, B) int32 per-slot decompression flags."""
+    for q in range(N_SCAL):
+        enc = scal_ref[q * 32 : (q + 1) * 32].astype(jnp.int32)
+        dig_ref[q * 128 : (q + 1) * 128] = pv._unpack_digits2_grouped(enc)
+
+    for p in range(M):
+        ok_ref[p : p + 1] = aok_ref[p : p + 1]
+        for c in range(4):
+            coords_ref[_point_rows(p, c)] = ac_ref[
+                (p * 4 + c) * 32 : (p * 4 + c) * 32 + NL
+            ]
+
+    ys = []
+    signs = []
+    for j in range(M):
+        y, s = pv._unpack_limbs(r_ref[j * 32 : (j + 1) * 32].astype(jnp.int32))
+        ys.append(y)
+        signs.append(s)
+    G = ys[0].shape[-1]
+    ok_all, pts = pv.decompress(pv._cat(ys), pv._cat(signs))
+    for j in range(M):
+        p = M + j
+        ok_ref[p : p + 1] = ok_all[:, j * G : (j + 1) * G].astype(jnp.int32)
+        for c in range(4):
+            coords_ref[_point_rows(p, c)] = pts[c][:, j * G : (j + 1) * G]
+
+
 # -- K2: M joint Straus tables ----------------------------------------------
 
 
@@ -378,6 +413,99 @@ def _jitted_rlc_verify(g: int, block: int, interpret: bool,
     return jax.jit(pipeline)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_rlc_verify_cached(g: int, block: int, vp: int, interpret: bool,
+                              vma: frozenset | None = None):
+    """The epoch-cached RLC pipeline: gathers the committee's
+    decompressed coords from the persistent (4*32, vp) device table,
+    rearranges them (and the raw row-major per-sig inputs) into the
+    slot-major kernel layout ON DEVICE, and runs K1-cached/K2/K3. The
+    host ships only val_idx + raw rows — prepare_rlc's slot-major
+    transposes (the bulk of its 31 ms at 10k sigs) become device work."""
+    if g % block:
+        raise ValueError(
+            f"lane count {g} not a multiple of block {block} (size buckets "
+            "via plan_bucket — a truncated grid silently skips lanes)"
+        )
+    k2_block = min(block, 128)
+
+    def mkspec(b):
+        def spec(rows):
+            return pl.BlockSpec((rows, b), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+        return spec
+
+    def out(rows):
+        if vma is None:
+            return jax.ShapeDtypeStruct((rows, g), jnp.int32)
+        return jax.ShapeDtypeStruct((rows, g), jnp.int32, vma=vma)
+
+    spec = mkspec(block)
+    spec2 = mkspec(k2_block)
+    coords_rows = 2 * M * 4 * 32
+    acoords_rows = M * 4 * 32
+    tbl_rows = M * 16 * 4 * 32
+    dig_rows = N_SCAL * 128
+
+    k1 = pl.pallas_call(
+        _k1_rlc_kernel_cached,
+        grid=(g // block,),
+        in_specs=[spec(acoords_rows), spec(M), spec(M * 32),
+                  spec(N_SCAL * 32)],
+        out_specs=[spec(coords_rows), spec(2 * M), spec(dig_rows)],
+        out_shape=[out(coords_rows), out(2 * M), out(dig_rows)],
+        interpret=interpret,
+    )
+    k2 = pl.pallas_call(
+        _k2_rlc_kernel,
+        grid=(g // k2_block,),
+        in_specs=[spec2(coords_rows)],
+        out_specs=spec2(tbl_rows),
+        out_shape=out(tbl_rows),
+        interpret=interpret,
+    )
+    k3 = pl.pallas_call(
+        _k3_rlc_kernel,
+        grid=(g // block,),
+        in_specs=[spec(tbl_rows), spec(dig_rows), spec(coords_rows),
+                  spec(2 * M), spec(M)],
+        out_specs=spec(1),
+        out_shape=out(1),
+        interpret=interpret,
+    )
+
+    def pipeline(coords_tbl, ok_tbl, idx, r_rows, scal_rows, sok_rows):
+        # idx is signature-major (i = lane*M + slot); the reshapes below
+        # land every array in the kernels' slot-major layout
+        ac = (
+            coords_tbl[:, idx]
+            .reshape(4 * 32, g, M)
+            .transpose(2, 0, 1)
+            .reshape(acoords_rows, g)
+        )
+        aok = ok_tbl[:, idx].reshape(g, M).T
+        r_t = r_rows.reshape(g, M, 32).transpose(1, 2, 0).reshape(M * 32, g)
+        scal_t = scal_rows.transpose(1, 2, 0).reshape(N_SCAL * 32, g)
+        sok_t = sok_rows.T
+        coords, ok, dig = k1(ac, aok, r_t, scal_t)
+        tbl = k2(coords)
+        return k3(tbl, dig, coords, ok, sok_t)
+
+    return jax.jit(pipeline)
+
+
+def rlc_cached_fn(ep, g: int, block: int, interpret: bool):
+    """Kernel closure for the warm-epoch RLC pipeline; coords tables
+    resolve at CALL time on the dispatch-owner thread."""
+    f = _jitted_rlc_verify_cached(g, block, ep.vp, interpret)
+
+    def call(*args):
+        coords_tbl, ok_tbl = ep.coords_tables()
+        return f(coords_tbl, ok_tbl, *args)
+
+    return call
+
+
 # -- host prep ---------------------------------------------------------------
 
 
@@ -450,30 +578,21 @@ def _gen_z(bucket: int) -> np.ndarray:
     return z
 
 
-def prepare_rlc(entries, bucket: int):
-    """EntryBlock or (pub32, msg, sig64) triples -> RLC kernel args,
-    padded to `bucket` signatures (bucket % M == 0, bucket // M lanes).
-    Host work on top of the per-sig prep (pack + SHA-512 challenges +
-    s<L): one 128x256-bit mod-L mul-add per signature. For an EntryBlock
-    with the native module built, challenges + scalar mul-adds + s<L run
-    as ONE GIL-released call over the block's contiguous buffers
-    (tm_native.ed25519_rlc_prep); tuple lists and native-absent builds
-    keep the split numpy/Python path with identical outputs."""
+def _rlc_host_scalars(entries, live: int, g_live: int):
+    """Shared host scalar stage for both RLC preps: packs the live rows,
+    draws the z coefficients, and computes the lane scalars. For an
+    EntryBlock with the native module built, challenges + scalar mul-adds
+    + s<L run as ONE GIL-released call over the block's contiguous
+    buffers (tm_native.ed25519_rlc_prep); tuple lists and native-absent
+    builds keep the split numpy/Python path with identical outputs.
+
+    Returns (pub (live, 32), r_enc (live, 32), scal (g_live, N_SCAL, 32),
+    s_ok (live,) bool)."""
     from .backend import _challenges_any, _pack_rows, _s_below_l
     from .entry_block import EntryBlock
     from ..native import load as _load_native
 
     n = len(entries)
-    if bucket % M:
-        raise ValueError(f"bucket {bucket} not a multiple of M={M}")
-    g = bucket // M
-    # All host work runs over the LIVE lanes only; padding lanes get
-    # their constant pattern (identity-point A/R encodings, zero scalars,
-    # s_ok true) via broadcast assigns. A coalesced total just past a
-    # quantized bucket would otherwise pay the full bucket's packing and
-    # transposes on the host.
-    g_live = min((n + M - 1) // M, g)
-    live = g_live * M
     pub, r_enc, s_enc = _pack_rows(entries, live)
     z = _gen_z(live)
 
@@ -513,6 +632,28 @@ def prepare_rlc(entries, bucket: int):
     scal[:, 0] = S
     scal[:, 1 : M + 1] = U
     scal[:, M + 1 :] = z.reshape(g_live, M, 32)[:, 1:]
+    return pub, r_enc, scal, s_ok
+
+
+def prepare_rlc(entries, bucket: int):
+    """EntryBlock or (pub32, msg, sig64) triples -> RLC kernel args,
+    padded to `bucket` signatures (bucket % M == 0, bucket // M lanes).
+    Host work on top of the per-sig prep (pack + SHA-512 challenges +
+    s<L): one 128x256-bit mod-L mul-add per signature (see
+    _rlc_host_scalars), then the slot-major transposes the kernel layout
+    needs — warm epochs skip those via prepare_rlc_cached."""
+    n = len(entries)
+    if bucket % M:
+        raise ValueError(f"bucket {bucket} not a multiple of M={M}")
+    g = bucket // M
+    # All host work runs over the LIVE lanes only; padding lanes get
+    # their constant pattern (identity-point A/R encodings, zero scalars,
+    # s_ok true) via broadcast assigns. A coalesced total just past a
+    # quantized bucket would otherwise pay the full bucket's packing and
+    # transposes on the host.
+    g_live = min((n + M - 1) // M, g)
+    live = g_live * M
+    pub, r_enc, scal, s_ok = _rlc_host_scalars(entries, live, g_live)
 
     def slotmajor(arr):  # (live, 32) -> (M*32, g_live)
         return np.ascontiguousarray(
@@ -534,6 +675,35 @@ def prepare_rlc(entries, bucket: int):
         )
         sok_t[:, :g_live] = s_ok.reshape(g_live, M).T.astype(np.int32)
     return a_t, r_t, scal_t, sok_t
+
+
+def prepare_rlc_cached(entries, bucket: int, ep):
+    """Warm-epoch RLC prep: same host scalar stage as prepare_rlc, but
+    the committee ships as val_idx gather indices (the kernel gathers the
+    cached decompressed A coords on device) and every per-sig array ships
+    ROW-major — the slot-major transposes happen on device in the jitted
+    cached pipeline. entries must be an EntryBlock with val_idx set.
+
+    Returns (idx (bucket,) int32, r_rows (bucket, 32) uint8,
+    scal_rows (g, N_SCAL, 32) uint8, sok_rows (g, M) int32)."""
+    n = len(entries)
+    if bucket % M:
+        raise ValueError(f"bucket {bucket} not a multiple of M={M}")
+    g = bucket // M
+    g_live = min((n + M - 1) // M, g)
+    live = g_live * M
+    _pub, r_enc, scal, s_ok = _rlc_host_scalars(entries, live, g_live)
+
+    idx = np.full((bucket,), ep.vp - 1, dtype=np.int32)
+    idx[:n] = entries.val_idx
+    r_rows = np.zeros((bucket, 32), dtype=np.uint8)
+    r_rows[:live] = r_enc
+    r_rows[live:, 0] = 1  # padding lanes: identity encoding
+    scal_rows = np.zeros((g, N_SCAL, 32), dtype=np.uint8)
+    scal_rows[:g_live] = scal
+    sok_rows = np.ones((g, M), dtype=np.int32)
+    sok_rows[:g_live] = s_ok.reshape(g_live, M).astype(np.int32)
+    return idx, r_rows, scal_rows, sok_rows
 
 
 def verify_rlc_compact(a_t, r_t, scal_t, sok_t, block: int = 0,
@@ -572,15 +742,27 @@ def expand_lanes(lane_valid: np.ndarray, entries) -> np.ndarray:
 
 def verify_batch_rlc(entries, block: int = 0, interpret: bool = False) -> np.ndarray:
     """Arbitrary-size batch through the RLC fast-accept path; returns
-    per-signature (n,) bool with exact per-sig ZIP-215 blame."""
+    per-signature (n,) bool with exact per-sig ZIP-215 blame. Warm-epoch
+    EntryBlocks route through the cached kernel (committee gathered from
+    the device-resident table)."""
+    from . import epoch_cache as _epoch
+
+    ep = _epoch.lookup(entries)
     sigs_per_call = MAX_SIGS
     out = []
     i = 0
     while i < len(entries):
         chunk = entries[i : i + sigs_per_call]
         bucket, g, blk = plan_bucket(len(chunk), block)
-        args = prepare_rlc(chunk, bucket)
-        lane_valid = verify_rlc_compact(*args, block=blk, interpret=interpret)
+        if ep is not None:
+            args = prepare_rlc_cached(chunk, bucket, ep)
+            dev = rlc_cached_fn(ep, g, blk, interpret)(*args)
+            lane_valid = np.asarray(dev)[0].astype(bool)
+        else:
+            args = prepare_rlc(chunk, bucket)
+            lane_valid = verify_rlc_compact(
+                *args, block=blk, interpret=interpret
+            )
         out.append(expand_lanes(lane_valid, chunk))
         i += len(chunk)
     return (

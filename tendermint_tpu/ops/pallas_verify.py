@@ -249,6 +249,29 @@ def _k1_decompress_kernel(a_ref, r_ref, s_ref, k_ref, coords_ref, ok_ref, sdig_r
         coords_ref[(4 + c) * 32 : (4 + c) * 32 + NL] = AR[c][:, B:]
 
 
+def _k1_decompress_kernel_cached(
+    ac_ref, aok_ref, r_ref, s_ref, k_ref, coords_ref, ok_ref, sdig_ref,
+    kdig_ref
+):
+    """K1 for a WARM epoch: the committee's decompressed coordinates
+    arrive as an input (gathered on device from the epoch cache's
+    persistent table — ops/epoch_cache.py coords_tables), so this variant
+    decompresses HALF the points of _k1_decompress_kernel: R only.
+
+    ac: (4*32, B) int32 A coords in the 32-row slot layout; aok (1, B)."""
+    r_enc = r_ref[:].astype(jnp.int32)
+    sdig_ref[:] = _unpack_digits2_grouped(s_ref[:].astype(jnp.int32))
+    kdig_ref[:] = _unpack_digits2_grouped(k_ref[:].astype(jnp.int32))
+
+    r_y, r_sign = _unpack_limbs(r_enc)
+    ok_r, R = decompress(r_y, r_sign)
+    ok_ref[0:1] = aok_ref[0:1]
+    ok_ref[1:2] = ok_r.astype(jnp.int32)
+    for c in range(4):
+        coords_ref[c * 32 : c * 32 + NL] = ac_ref[c * 32 : c * 32 + NL]
+        coords_ref[(4 + c) * 32 : (4 + c) * 32 + NL] = R[c]
+
+
 def _k2_table_kernel(coords_ref, tbl_ref):
     """K2: 16-entry Straus table [s2]B + [k2](-A) built with three
     lane-folded point ops; entry e coord c lands at rows
@@ -409,6 +432,112 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool,
         return k3(tbl, sdig, kdig, coords, ok, sok_t)
 
     return jax.jit(pipeline)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_pallas_verify_cached(n: int, block: int, vp: int,
+                                 interpret: bool,
+                                 vma: frozenset | None = None):
+    """The epoch-cached 3-kernel pipeline: the jitted program GATHERS the
+    committee's decompressed coordinates from the persistent device table
+    ((4*32, vp) int32 + (1, vp) ok) and transposes the raw per-sig rows
+    on device — host prep ships row-major bytes only. K2/K3 are shared
+    with the uncached pipeline; only K1 changes (R-only decompression)."""
+    k2_block = min(block, 256)
+
+    def mkspec(b):
+        def spec(rows):
+            return pl.BlockSpec((rows, b), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+        return spec
+
+    def out(rows):
+        if vma is None:
+            return jax.ShapeDtypeStruct((rows, n), jnp.int32)
+        return jax.ShapeDtypeStruct((rows, n), jnp.int32, vma=vma)
+
+    spec = mkspec(block)
+    spec2 = mkspec(k2_block)
+
+    k1 = pl.pallas_call(
+        _k1_decompress_kernel_cached,
+        grid=(n // block,),
+        in_specs=[spec(4 * 32), spec(1), spec(32), spec(32), spec(32)],
+        out_specs=[spec(8 * 32), spec(2), spec(128), spec(128)],
+        out_shape=[out(8 * 32), out(2), out(128), out(128)],
+        interpret=interpret,
+    )
+    k2 = pl.pallas_call(
+        _k2_table_kernel,
+        grid=(n // k2_block,),
+        in_specs=[spec2(8 * 32)],
+        out_specs=spec2(16 * 4 * 32),
+        out_shape=out(16 * 4 * 32),
+        interpret=interpret,
+    )
+    k3 = pl.pallas_call(
+        _k3_ladder_kernel,
+        grid=(n // block,),
+        in_specs=[spec(16 * 4 * 32), spec(128), spec(128), spec(8 * 32), spec(2), spec(1)],
+        out_specs=spec(1),
+        out_shape=out(1),
+        interpret=interpret,
+    )
+
+    def pipeline(coords_tbl, ok_tbl, idx, r_rows, s_rows, k_rows, sok_t):
+        ac = coords_tbl[:, idx]          # (4*32, n) device gather
+        aok = ok_tbl[:, idx]             # (1, n)
+        r_t = r_rows.T                   # device-side transposes: trivial
+        s_t = s_rows.T                   # on-chip, ~31 ms on host at 10k
+        k_t = k_rows.T
+        coords, ok, sdig, kdig = k1(ac, aok, r_t, s_t, k_t)
+        tbl = k2(coords)
+        return k3(tbl, sdig, kdig, coords, ok, sok_t)
+
+    return jax.jit(pipeline)
+
+
+def prepare_compact_cached(entries, bucket: int, ep):
+    """Warm-epoch compact prep: ships val_idx + raw row-major r/s/k (the
+    jitted pipeline transposes on device) — no pubkey bytes, no host
+    transposes. entries must be an EntryBlock with val_idx set. Same
+    argument build as the XLA path (backend.cached_sig_args); only the
+    s_ok shaping differs (the kernel wants a (1, N) int32 row)."""
+    from .backend import cached_sig_args
+
+    idx, r_rows, s_rows, k_rows, s_ok = cached_sig_args(entries, bucket, ep)
+    return (
+        idx,
+        r_rows,
+        s_rows,
+        k_rows,
+        np.ascontiguousarray(s_ok.astype(np.int32)[None, :]),
+    )
+
+
+def cached_compact_fn(ep, n: int, block: int, interpret: bool):
+    """Kernel closure for the warm-epoch compact pipeline; the epoch's
+    coords tables resolve at CALL time (dispatch-owner thread — the only
+    thread allowed to issue the one-time upload)."""
+    f = _jitted_pallas_verify_cached(n, block, ep.vp, interpret)
+
+    def call(*args):
+        coords_tbl, ok_tbl = ep.coords_tables()
+        return f(coords_tbl, ok_tbl, *args)
+
+    return call
+
+
+def verify_compact_cached(args, ep, block: int = 0,
+                          interpret: bool = False):
+    """Run the cached kernel over prepare_compact_cached args; returns
+    (N,) bool."""
+    block = block or BLOCK
+    n = args[1].shape[0]
+    if n % block:
+        raise ValueError(f"batch {n} not a multiple of block {block}")
+    out = cached_compact_fn(ep, n, block, interpret)(*args)
+    return np.asarray(out)[0].astype(bool)
 
 
 def verify_compact(a_t, r_t, s_t, k_t, s_ok_t, block: int = 0, interpret: bool = False):

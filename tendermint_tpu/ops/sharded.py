@@ -108,7 +108,16 @@ def verify_commit_sharded(
     Returns (valid[n], tallied_power_of_valid, all_valid). The device
     equivalent of types/validation.go:152 verifyCommitBatch's accumulation,
     with the per-sig valid[] the blame path (:242-248) needs.
+
+    A warm-epoch EntryBlock (val_idx + epoch_key resident in the cache)
+    dispatches to the cached variant: the committee reads from each
+    shard's replicated table instead of riding the batch transfer.
     """
+    from . import epoch_cache as _epoch
+
+    if _epoch.lookup(entries) is not None:
+        return verify_commit_sharded_cached(entries, powers, mesh,
+                                            bucket=bucket)
     n = len(entries)
     nd = np.prod(mesh.devices.shape)
     bucket = bucket or _backend._bucket_for(max(n, int(nd)))
@@ -139,6 +148,113 @@ def _jitted_for(mesh: Mesh):
     if key not in _mesh_cache:
         _mesh_cache[key] = sharded_commit_verifier(mesh)
     return _mesh_cache[key]
+
+
+# ---------------------------------------------------------------------------
+# Epoch-cached sharding: the valset's device tables (ops/epoch_cache.py)
+# REPLICATED across the mesh — every shard gathers its local lanes'
+# committee rows from its own resident copy, so a warm epoch ships only
+# per-signature data to every chip (the multi-chip face of the PR-7
+# epoch cache). Table replication happens once per (epoch, mesh).
+# ---------------------------------------------------------------------------
+
+_shard_tbl_cache: dict = {}
+
+
+def epoch_tables_sharded(ep, mesh: Mesh):
+    """The epoch's XLA limb/sign tables placed with a REPLICATED
+    NamedSharding over `mesh` — per-shard residency, uploaded once per
+    (epoch key, mesh). Returns (limbs (vp, 20), sign (vp,)) jax Arrays."""
+    from . import backend as _b
+
+    key = (ep.key, tuple(d.id for d in mesh.devices.flat))
+    t = _shard_tbl_cache.get(key)
+    if t is None:
+        limbs = _b._pack_le_limbs(ep.pub_rows)
+        sign = (ep.pub_rows[:, 31] >> 7).astype(np.int32)
+        repl = NamedSharding(mesh, P())
+        t = (jax.device_put(limbs, repl), jax.device_put(sign, repl))
+        _shard_tbl_cache[key] = t
+        # bound growth: tables are small, but meshes*epochs churn in tests
+        while len(_shard_tbl_cache) > 16:
+            _shard_tbl_cache.pop(next(iter(_shard_tbl_cache)))
+    return t
+
+
+def _commit_step_cached(tbl_limbs, tbl_sign, idx, r_enc, s_enc, k_enc,
+                        s_ok, power, live):
+    """Per-shard body of the epoch-cached commit step: gather this
+    shard's committee rows from the replicated table, unpack the raw
+    per-sig rows on device, verify, then the same psum tally as
+    _commit_step."""
+    valid = _kernel.verify_kernel_cached(
+        tbl_limbs, tbl_sign, idx, r_enc, s_enc, k_enc, s_ok
+    )
+    ok = valid & live
+    lanes = jnp.sum(jnp.where(ok[..., None], power, 0), axis=0)
+    lanes = jax.lax.psum(lanes, AXIS)
+    all_valid = jax.lax.psum(jnp.sum(jnp.where(live & ~valid, 1, 0)), AXIS) == 0
+    return valid, lanes, all_valid
+
+
+def sharded_commit_verifier_cached(mesh: Mesh):
+    """Jitted mesh-sharded commit verification over a device-resident
+    epoch table: tables replicated (P(None, ...)), per-signature inputs
+    sharded on the batch axis."""
+    from jax import shard_map
+
+    fn = shard_map(
+        _commit_step_cached,
+        mesh=mesh,
+        in_specs=(
+            P(None, None), P(None),               # replicated epoch table
+            P(AXIS), P(AXIS), P(AXIS), P(AXIS),   # idx, r, s, k
+            P(AXIS), P(AXIS), P(AXIS),            # s_ok, power, live
+        ),
+        out_specs=(P(AXIS), P(), P()),
+    )
+    return jax.jit(fn)
+
+
+def verify_commit_sharded_cached(
+    block,
+    powers: List[int],
+    mesh: Mesh,
+    bucket: int | None = None,
+) -> Tuple[np.ndarray, int, bool]:
+    """verify_commit_sharded for a WARM epoch: `block` is an EntryBlock
+    carrying val_idx/epoch_key (ops/entry_block.py) whose valset is in
+    the epoch cache. Ships raw per-sig rows + gather indices; each shard
+    reads the committee from its replicated table copy. Falls back to
+    verify_commit_sharded when the epoch is not resident."""
+    from . import epoch_cache as _epoch
+
+    ep = _epoch.lookup(block)
+    if ep is None:
+        return verify_commit_sharded(block, powers, mesh, bucket=bucket)
+    n = len(block)
+    nd = int(np.prod(mesh.devices.shape))
+    bucket = bucket or _backend._bucket_for(max(n, nd))
+    if bucket % nd:
+        bucket += nd - bucket % nd
+    with _span("sharded.host_prep", n=n, bucket=bucket, cached=1):
+        args = _backend.prepare_batch_cached(block, bucket, ep)
+        live = np.zeros((bucket,), dtype=bool)
+        live[:n] = True
+        pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
+        pw[:n] = split_power(np.asarray(powers[:n]))
+    tbl = epoch_tables_sharded(ep, mesh)
+    key = ("cached", tuple(d.id for d in mesh.devices.flat))
+    if key not in _mesh_cache:
+        _mesh_cache[key] = sharded_commit_verifier_cached(mesh)
+    with _span("sharded.device", n=n, bucket=bucket, cached=1):
+        valid, lanes, all_valid = _mesh_cache[key](*tbl, *args, pw, live)
+        valid = np.asarray(valid)
+    return (
+        valid[:n],
+        join_power(lanes),
+        bool(np.asarray(all_valid)),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -169,8 +169,11 @@ class ValidatorSet:
             proposer=self.proposer,
         )
         c._total_voting_power = self._total_voting_power
-        # the hash covers (pub_key, power) only, which copy preserves
+        # the hash and ed25519 columns cover (pub_key, power) only, which
+        # copy preserves — sharing both caches keeps a copied set on the
+        # same device epoch (ops/epoch_cache.py keys on hash())
         c._hash = self._hash
+        c._ed_cols = self._ed_cols
         return c
 
     # ---- queries ------------------------------------------------------

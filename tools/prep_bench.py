@@ -36,7 +36,9 @@ os.environ.setdefault("TM_TPU_PUREPY_CRYPTO", "1")
 if "--native" not in sys.argv:
     os.environ["TM_TPU_NO_NATIVE"] = "1"
 
-FUSED_SPEEDUP_GATE = 1.3  # --fused: decode->kernel-args vs the PR-2 path
+FUSED_SPEEDUP_GATE = 1.3  # --fused: decode->kernel-args vs the PR-4 path
+TRANSFER_RATIO_GATE = 0.5  # --transfer: warm-epoch H2D vs cold-epoch H2D
+TRANSFER_SPEEDUP_GATE = 1.3  # --transfer: cached prep vs the PR-4 prep
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -179,6 +181,121 @@ def run_fused(args) -> int:
     return 0
 
 
+def run_transfer(args) -> int:
+    """--transfer: the round-7 epoch-cache gate. A validator set seen for
+    the SECOND time is device-resident (ops/epoch_cache.py), so a warm
+    commit ships only per-signature data — this gate asserts, on both the
+    device-hash and host-hash XLA preps:
+
+      bytes    steady-state (warm) H2D bytes <= TRANSFER_RATIO_GATE x the
+               cold-epoch bytes (the uncached batch args PLUS the one-time
+               epoch table upload)
+      no pubs  the warm host-hash args carry NO pubkey-derived arrays —
+               exactly gather indices + raw r/s/k rows + s<L flags
+      speed    warm host prep >= TRANSFER_SPEEDUP_GATE x faster than the
+               PR-4 prep of the same batch (interleaved min-of-reps)
+    """
+    import statistics as stats
+
+    os.environ.setdefault("TM_TPU_EPOCH_CACHE", "8")
+    from tendermint_tpu.ops import backend, epoch_cache, pipeline
+    from tendermint_tpu.types.block import Commit
+
+    chain_id = "prep-bench"
+    vset, commit = build_synthetic_commit(args.sigs)
+    needed = vset.total_voting_power() * 2 // 3
+    bucket = backend._bucket_for(args.sigs)
+    dec = Commit.decode(commit.encode())
+    epoch_cache.reset()
+    if epoch_cache.cache() is None:
+        print("  FAIL: epoch cache disabled (TM_TPU_EPOCH_CACHE=0?)",
+              file=sys.stderr)
+        return 2
+    # first sight: cold epoch — the commit rides the uncached path while
+    # the table registers
+    blk_cold, _ = pipeline.commit_entries(chain_id, vset, dec, needed)
+    if blk_cold.epoch_key is not None:
+        print("  FAIL: first-sight commit unexpectedly warm", file=sys.stderr)
+        return 2
+    blk, _ = pipeline.commit_entries(chain_id, vset, dec, needed)
+    ep = epoch_cache.lookup(blk)
+    if ep is None:
+        print("  FAIL: second-sight commit not warm", file=sys.stderr)
+        return 2
+    print(
+        f"prep_bench --transfer: n={args.sigs} bucket={bucket} "
+        f"reps={args.reps} vp={ep.vp} "
+        f"backend={os.environ.get('JAX_PLATFORMS', '?')}"
+    )
+
+    rc = 0
+    table_b = ep.nbytes_host()
+    for name, uncached, cached in (
+        (
+            "device-hash",
+            lambda b=blk_cold: backend.prepare_batch_device_hash(b, bucket),
+            lambda: backend.prepare_batch_cached_device_hash(blk, bucket, ep),
+        ),
+        (
+            "host-hash",
+            lambda b=blk_cold: backend.prepare_batch(b, bucket),
+            lambda: backend.prepare_batch_cached(blk, bucket, ep),
+        ),
+    ):
+        cold_b = backend.h2d_arg_bytes(uncached()) + table_b
+        warm_args = cached()
+        warm_b = backend.h2d_arg_bytes(warm_args)
+        ratio = warm_b / cold_b
+        # interleaved min-of-reps (this box's allocator noise drifts
+        # medians +-30%; see tests/test_gil_budget.py)
+        uncached(); cached()
+        t_u, t_c = [], []
+        for _ in range(args.reps):
+            t0 = time.perf_counter(); uncached()
+            t_u.append(time.perf_counter() - t0)
+            t0 = time.perf_counter(); cached()
+            t_c.append(time.perf_counter() - t0)
+        u_ms, c_ms = min(t_u) * 1e3, min(t_c) * 1e3
+        speedup = u_ms / c_ms if c_ms else float("inf")
+        print(f"  {name}:")
+        print(f"    cold-epoch H2D (args+table): {cold_b:>10} B")
+        print(f"    warm-epoch H2D (args only) : {warm_b:>10} B")
+        print(f"    warm/cold ratio            : {ratio:10.3f}")
+        print(f"    PR-4 prep                  : {u_ms:8.2f} ms")
+        print(f"    cached prep                : {c_ms:8.2f} ms")
+        print(f"    speedup                    : {speedup:8.2f}x")
+        if ratio > TRANSFER_RATIO_GATE:
+            print(
+                f"  FAIL: warm H2D > {TRANSFER_RATIO_GATE}x cold on {name}",
+                file=sys.stderr,
+            )
+            rc = 1
+        if speedup < TRANSFER_SPEEDUP_GATE:
+            print(
+                f"  FAIL: cached prep < {TRANSFER_SPEEDUP_GATE}x faster "
+                f"on {name}",
+                file=sys.stderr,
+            )
+            rc = 1
+    # structural "no pubkey bytes": the warm host-hash args are exactly
+    # idx(4) + r(32) + s(32) + k(32) bytes per lane + the s<L flags
+    idx, r_rows, s_rows, k_rows, s_ok = backend.prepare_batch_cached(
+        blk, bucket, ep
+    )
+    expected = bucket * (4 + 32 + 32 + 32) + s_ok.nbytes
+    got = backend.h2d_arg_bytes((idx, r_rows, s_rows, k_rows, s_ok))
+    if got != expected:
+        print(
+            f"  FAIL: warm host-hash args ship {got} B, expected {expected} "
+            "(pubkey-derived array leaked into the warm path?)",
+            file=sys.stderr,
+        )
+        rc = 2
+    else:
+        print(f"  warm host-hash args structurally pub-free: {got} B")
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -195,9 +312,17 @@ def main() -> int:
         help="round-6 gate: fused columnar-from-decode path vs the PR-2 "
         "columnar path (arg parity enforced, speedup gated)",
     )
+    ap.add_argument(
+        "--transfer",
+        action="store_true",
+        help="round-7 gate: warm-epoch H2D bytes <= 0.5x cold-epoch and "
+        "cached per-signature prep >= 1.3x the PR-4 prep",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
+    if args.transfer:
+        return run_transfer(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline
