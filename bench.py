@@ -538,11 +538,16 @@ def worker() -> None:
     # commit), not just the kernel, so it moves when consensus-side work
     # regresses even if the device rate holds.
     simnet_rate = 0.0
+    simnet_churn_rate = 0.0
     if os.environ.get("TM_TPU_BENCH_SIMNET"):
         try:
             simnet_rate = _bench_simnet()
         except Exception as e:  # noqa: BLE001
             print(f"# simnet bench failed: {e}", file=sys.stderr)
+        try:
+            simnet_churn_rate = _bench_simnet_churn()
+        except Exception as e:  # noqa: BLE001
+            print(f"# simnet churn bench failed: {e}", file=sys.stderr)
 
     out = {
         "metric": f"verify_commit_{n_sigs}",
@@ -569,6 +574,7 @@ def worker() -> None:
         "mixed_curve_sigs_per_s": round(mixed_rate, 1),
         "pipelined_headers_per_s": round(hdr_rate, 1),
         "simnet_commits_per_s": round(simnet_rate, 2),
+        "simnet_churn_commits_per_s": round(simnet_churn_rate, 2),
         "span_summary": span_summary,
     }
     print(json.dumps(out))
@@ -763,6 +769,25 @@ def _bench_simnet(height: int = 15) -> float:
         rep = cluster.run_to_height(height, max_virtual_s=600.0)
     finally:
         cluster.stop()  # closes WALs and removes the temp dir even on error
+    if not rep.ok or rep.wall_s <= 0:
+        return 0.0
+    return rep.height / rep.wall_s
+
+
+def _bench_simnet_churn(height: int = 15) -> float:
+    """Rotation variant of the simnet probe: 6 nodes / 4 active
+    validators with a join+leave churn every 4 heights, so the measured
+    path includes EndBlock validator updates, valset-hash invalidation
+    and (when enabled) epoch-cache cold/warm cycling. Heights per wall
+    second; 0.0 when the run goes red."""
+    from tendermint_tpu.simnet import Cluster, rotation_schedule
+
+    faults = rotation_schedule(6, 4, every=4, start=3, until=height - 4)
+    cluster = Cluster(n_nodes=6, n_validators=4, seed=1, faults=faults)
+    try:
+        rep = cluster.run_to_height(height, max_virtual_s=600.0)
+    finally:
+        cluster.stop()
     if not rep.ok or rep.wall_s <= 0:
         return 0.0
     return rep.height / rep.wall_s

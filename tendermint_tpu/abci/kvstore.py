@@ -221,6 +221,10 @@ class PersistentKVStoreApplication(KVStoreApplication):
     def _exec_validator_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
         body = tx[len(VALIDATOR_TX_PREFIX) :]
         pub_b64, _, power_s = body.partition(b"!")
+        # optional trailing "!nonce" so logically identical updates (a
+        # validator leaving and later rejoining at the same power) remain
+        # distinct tx bytes for the mempool's seen-tx cache
+        power_s, _, _nonce = power_s.partition(b"!")
         try:
             pub_raw = base64.b64decode(pub_b64)
             power = int(power_s)
@@ -252,5 +256,10 @@ class PersistentKVStoreApplication(KVStoreApplication):
         return out
 
 
-def make_validator_tx(pub_key_bytes: bytes, power: int) -> bytes:
-    return VALIDATOR_TX_PREFIX + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()
+def make_validator_tx(
+    pub_key_bytes: bytes, power: int, nonce: Optional[int] = None
+) -> bytes:
+    tx = VALIDATOR_TX_PREFIX + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()
+    if nonce is not None:
+        tx += b"!" + str(nonce).encode()
+    return tx

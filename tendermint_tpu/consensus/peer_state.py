@@ -10,10 +10,24 @@ re-flooding every vote to every peer (reactor.go:503 gossipDataRoutine,
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
+
+# Fault-search regression seam: TM_TPU_GOSSIP_BUG_CATCHUP=1 strips the
+# reference's ensureCatchUpCommitRound tracking (peer_state.go) out of
+# BOTH gossip pick paths — the mechanism whose absence in pick_vote_to_send
+# was one of the two real gossip bugs simnet found in PR 3 (laggards whose
+# round advanced past the commit round were never served and wedged).
+# Without the catch-up commit bits, a node that falls >= 2 heights behind
+# (crash + WAL-restart while the cluster advances, or a healed minority
+# partition) can never be served historical commit precommits and stalls
+# forever. The schedule-search harness (simnet/search.py) uses the flag to
+# prove the search+shrink loop rediscovers and minimizes the bug; it must
+# NEVER be set outside that harness.
+_BUG_NO_CATCHUP_ROUND = bool(os.environ.get("TM_TPU_GOSSIP_BUG_CATCHUP"))
 
 from ..libs.bits import BitArray
 from ..types import BlockID, Vote, VoteSet
@@ -306,7 +320,7 @@ class PeerState:
         n_vals = len(votes.votes)
         height, round_, type_ = votes.height, votes.round, votes.signed_msg_type
         with self._mtx:
-            if votes.is_commit():
+            if votes.is_commit() and not _BUG_NO_CATCHUP_ROUND:
                 # the set is a commit (vote_set.go IsCommit: PRECOMMITs
                 # with a +2/3 block): a peer stuck in a LATER round of
                 # this height can still take these round-`round_`
@@ -350,6 +364,8 @@ class PeerState:
                 return None
             n = len(commit.signatures)
             if prs.catchup_commit_round != commit.round or prs.catchup_commit is None:
+                if _BUG_NO_CATCHUP_ROUND:
+                    return None  # regression seam: no catch-up rebind
                 prs.catchup_commit_round = commit.round
                 prs.catchup_commit = (
                     prs.precommits if commit.round == prs.round and prs.precommits is not None

@@ -105,6 +105,20 @@ class ConsensusReactor:
         self._threads = []
         self._peers: Dict[str, PeerState] = {}
         self._peers_mtx = threading.Lock()
+        # Incremental gossip: a sweep visits only peers marked DIRTY by a
+        # RoundState delta on our side (new step/vote/proposal/part) or a
+        # PeerRoundState delta on theirs (inbound NRS/NVB/VoteSetBits),
+        # instead of scanning every peer each tick — at 100+ peers the
+        # all-peers scan dominated gossip_once even when nothing was
+        # sendable. A sweep that makes progress re-marks the peer (more
+        # may remain, e.g. catchup parts served one per sweep), and the
+        # query-maj23 cadence stays a FULL sweep, so a mark lost to a
+        # dropped message costs at most one 2s interval — the same
+        # recovery bound the one-shot NRS/NVB re-advertisement already
+        # leans on. Insertion-ordered dict: deterministic sweep order
+        # under simnet's seeded driver.
+        self._dirty: Dict[str, None] = {}
+        self._dirty_mtx = threading.Lock()
         self._last_nrs = None  # last broadcast (height, round, step, lcr)
         self._last_nvb = None  # last broadcast NewValidBlock key
         self._handlers = {
@@ -150,6 +164,7 @@ class ConsensusReactor:
         with self._peers_mtx:
             if peer_id not in self._peers:
                 self._peers[peer_id] = PeerState(peer_id, rng=self._rng)
+        self._mark_dirty(peer_id)
         # network send OUTSIDE the peers lock — a full send queue
         # blocks up to the mconn timeout and every inbound handler
         # takes this lock per message
@@ -160,6 +175,21 @@ class ConsensusReactor:
         reconnect starts from a fresh PeerState."""
         with self._peers_mtx:
             self._peers.pop(peer_id, None)
+        with self._dirty_mtx:
+            self._dirty.pop(peer_id, None)
+
+    # -- dirty-peer bookkeeping ------------------------------------------
+
+    def _mark_dirty(self, peer_id: str) -> None:
+        with self._dirty_mtx:
+            self._dirty[peer_id] = None
+
+    def _mark_all_dirty(self) -> None:
+        with self._peers_mtx:
+            ids = list(self._peers)
+        with self._dirty_mtx:
+            for pid in ids:
+                self._dirty[pid] = None
 
     def _peer_list(self):
         with self._peers_mtx:
@@ -201,6 +231,8 @@ class ConsensusReactor:
         if key != self._last_nrs:
             self._last_nrs = key
             self._state_ch.broadcast(self._encode_nrs(h, r, s, lcr, st))
+            # our RoundState moved: any peer may now be missing something
+            self._mark_all_dirty()
 
     def _maybe_broadcast_new_valid_block(self) -> None:
         """reactor.go broadcastNewValidBlockMessage (sent from enterCommit
@@ -225,6 +257,7 @@ class ConsensusReactor:
         w.write_message(4, bits.encode(), always=True)
         w.write_varint(5, 1 if in_commit else 0)
         self._state_ch.broadcast(_wrap(2, w.bytes()))
+        self._mark_all_dirty()  # new valid-block/parts state to serve
 
     def _broadcast_has_vote(self, vote: Vote) -> None:
         """reactor.go:1031 broadcastHasVoteMessage."""
@@ -234,13 +267,33 @@ class ConsensusReactor:
         w.write_varint(3, vote.type)
         w.write_varint(4, vote.validator_index)
         self._state_ch.broadcast(_wrap(3, w.bytes()))
+        # a vote entered OUR state: peers at (or below) its height may be
+        # missing it. The height read is deliberately lock-free — a stale
+        # read only means a spurious mark (harmless) or a missed one
+        # (healed by the next full sweep). One dirty-lock acquisition for
+        # the whole batch: this runs once per vote added, the hot path.
+        h = vote.height
+        with self._peers_mtx:
+            peers = list(self._peers.values())
+        marks = [ps.peer_id for ps in peers if ps.prs.height <= h]
+        if marks:
+            with self._dirty_mtx:
+                for pid in marks:
+                    self._dirty[pid] = None
 
     # -- gossip loop (the per-peer goroutines, folded) --------------------
 
     def gossip_once(self, query_maj23: bool = False) -> None:
-        """One gossip sweep over all peers — one iteration of the
-        reference's per-peer goroutines. The threaded path loops this; a
-        deterministic driver (simnet) calls it on its own schedule."""
+        """One gossip sweep — one iteration of the reference's per-peer
+        goroutines. The threaded path loops this; a deterministic driver
+        (simnet) calls it on its own schedule.
+
+        A plain tick sweeps only DIRTY peers (see _mark_dirty): with no
+        state deltas since the last tick the sweep is O(1), which is what
+        lets a 100+-node cluster tick 20x/s without the O(peers) scan.
+        The query-maj23 cadence (every ~2s) remains a FULL sweep over all
+        peers — the safety net that also re-sends the one-shot
+        advertisements below."""
         if query_maj23:
             # periodic refresh of the one-shot advertisements: on a lossy
             # link a dropped NewRoundStep/NewValidBlock would otherwise
@@ -251,11 +304,29 @@ class ConsensusReactor:
             self._last_nvb = None
         self._maybe_broadcast_new_round_step()
         self._maybe_broadcast_new_valid_block()
-        for ps in self._peer_list():
-            self._gossip_data(ps)
-            self._gossip_votes(ps)
+        if query_maj23:
+            with self._dirty_mtx:
+                self._dirty.clear()
+            peers = self._peer_list()
+        else:
+            # drain the dirty set BEFORE sweeping: concurrent marks during
+            # the sweep land in the next tick instead of being lost
+            with self._dirty_mtx:
+                if not self._dirty:
+                    return
+                dirty = self._dirty
+                self._dirty = {}
+            with self._peers_mtx:
+                peers = [self._peers[p] for p in dirty if p in self._peers]
+        for ps in peers:
+            sent = self._gossip_data(ps)
+            sent = self._gossip_votes(ps) or sent
             if query_maj23:
                 self._query_maj23(ps)
+            if sent:
+                # progress made ⇒ more may remain (catchup serves one
+                # part/vote per sweep): keep the peer hot
+                self._mark_dirty(ps.peer_id)
 
     def _gossip_routine(self) -> None:
         last_maj23 = 0.0
@@ -269,16 +340,19 @@ class ConsensusReactor:
             except Exception:  # noqa: BLE001 — gossip must never die
                 continue
 
-    def _gossip_data(self, ps: PeerState) -> None:
-        """reactor.go:503 gossipDataRoutine (one iteration)."""
+    def _gossip_data(self, ps: PeerState) -> bool:
+        """reactor.go:503 gossipDataRoutine (one iteration). Returns True
+        when something was sent (the dirty-sweep progress signal)."""
         rs = self._cs.rs
         prs = ps.snapshot()
+        sent = False
         if prs.height == rs.height:
             # proposal first, then missing parts
             if rs.proposal is not None and not prs.proposal:
                 w = ProtoWriter()
                 w.write_message(1, rs.proposal.encode(), always=True)
                 if self._data_ch.send(ps.peer_id, w.bytes()):
+                    sent = True
                     ps.apply_proposal(rs.proposal)
                     if rs.proposal.pol_round >= 0 and rs.votes is not None:
                         pol = rs.votes.prevotes(rs.proposal.pol_round)
@@ -302,12 +376,13 @@ class ConsensusReactor:
                     if p is not None:
                         msg = _wrap(2, _encode_block_part(rs.height, rs.round, p))
                         if self._data_ch.send(ps.peer_id, msg):
+                            sent = True
                             # bookkeeping is keyed to the PEER's round
                             # (reactor.go:545 SetHasProposalBlockPart(prs...))
                             # — with rs.round a round-lagged peer's bit
                             # would never set and the part resend forever
                             ps.set_has_proposal_block_part(prs.height, prs.round, idx)
-            return
+            return sent
         # catchup: peer is behind — serve committed block parts from the
         # store (reactor.go:556 gossipDataForCatchup)
         bs = self._block_store
@@ -318,7 +393,7 @@ class ConsensusReactor:
         ):
             meta = bs.load_block_meta(prs.height)
             if meta is None:
-                return
+                return sent
             psh = meta.block_id.part_set_header
             # Only serve parts once the peer advertises the matching part
             # set header (via its NewValidBlock after entering commit) —
@@ -328,21 +403,23 @@ class ConsensusReactor:
                 prs.proposal_block_part_set_header != psh
                 or prs.proposal_block_parts is None
             ):
-                return
+                return sent
             have = BitArray(max(psh.total, 1))
             for i in range(psh.total):
                 have.set_index(i, True)
             missing = have.sub(prs.proposal_block_parts)
             idxs = missing.get_true_indices()
             if not idxs:
-                return
+                return sent
             idx = idxs[0]
             part = bs.load_block_part(prs.height, idx)
             if part is None:
-                return
+                return sent
             msg = _wrap(2, _encode_block_part(prs.height, prs.round, part))
             if self._data_ch.send(ps.peer_id, msg):
                 ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+                sent = True
+        return sent
 
     def _send_vote(self, ps: PeerState, vote: Optional[Vote]) -> bool:
         if vote is None:
@@ -354,9 +431,11 @@ class ConsensusReactor:
             return True
         return False
 
-    def _gossip_votes(self, ps: PeerState) -> None:
+    def _gossip_votes(self, ps: PeerState) -> bool:
         """reactor.go:715 gossipVotesRoutine (one iteration): send ONE vote
-        this peer is missing, chosen in the reference's priority order."""
+        this peer is missing, chosen in the reference's priority order.
+        Returns True when a vote was sent (the dirty-sweep progress
+        signal)."""
         rs = self._cs.rs
         prs = ps.snapshot()
         hvs = rs.votes
@@ -364,7 +443,7 @@ class ConsensusReactor:
             # gossipVotesForHeight (reactor.go:616-713)
             if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
                 if self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit)):
-                    return
+                    return True
             if (
                 prs.step <= STEP_PROPOSE
                 and 0 <= prs.round <= rs.round
@@ -373,26 +452,26 @@ class ConsensusReactor:
                 if self._send_vote(
                     ps, ps.pick_vote_to_send(hvs.prevotes(prs.proposal_pol_round))
                 ):
-                    return
+                    return True
             if prs.step <= STEP_PREVOTE_WAIT and 0 <= prs.round <= rs.round:
                 if self._send_vote(ps, ps.pick_vote_to_send(hvs.prevotes(prs.round))):
-                    return
+                    return True
             if prs.step <= STEP_PRECOMMIT_WAIT and 0 <= prs.round <= rs.round:
                 if self._send_vote(ps, ps.pick_vote_to_send(hvs.precommits(prs.round))):
-                    return
+                    return True
             if 0 <= prs.round <= rs.round:
                 if self._send_vote(ps, ps.pick_vote_to_send(hvs.prevotes(prs.round))):
-                    return
+                    return True
             if prs.proposal_pol_round >= 0:
-                self._send_vote(
+                return self._send_vote(
                     ps, ps.pick_vote_to_send(hvs.prevotes(prs.proposal_pol_round))
                 )
-            return
+            return False
         # peer is exactly one height behind: our last commit's precommits
         # are its current height's votes (reactor.go:741-748)
         if prs.height != 0 and rs.height == prs.height + 1 and rs.last_commit is not None:
             if self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit)):
-                return
+                return True
         # peer is further behind: reconstruct precommits from the stored
         # commit at its height (reactor.go:750-777)
         bs = self._block_store
@@ -407,6 +486,8 @@ class ConsensusReactor:
                 vote = ps.pick_commit_vote_to_send(commit)
                 if vote is not None and self._send_vote(ps, vote):
                     ps.set_has_catchup_commit_vote(prs.height, commit.round, vote.validator_index)
+                    return True
+        return False
 
     def _query_maj23(self, ps: PeerState) -> None:
         """reactor.go:797 queryMaj23Routine (one iteration)."""
@@ -443,11 +524,15 @@ class ConsensusReactor:
             w = ProtoWriter()
             w.write_message(1, msg.proposal.encode(), always=True)
             self._data_ch.broadcast(w.bytes())
+            self._mark_all_dirty()
         elif isinstance(msg, BlockPartMessage):
             w = ProtoWriter()
             w.write_message(2, _encode_block_part(msg.height, msg.round, msg.part), always=True)
             self._data_ch.broadcast(w.bytes())
+            self._mark_all_dirty()
         elif isinstance(msg, VoteMessage):
+            # own votes reach add_vote like any other — the vote_added
+            # hook (_broadcast_has_vote) does the targeted dirty marking
             w = ProtoWriter()
             w.write_message(1, msg.vote.encode(), always=True)
             self._vote_ch.broadcast(w.bytes())
@@ -483,6 +568,7 @@ class ConsensusReactor:
             proposal = Proposal.decode(field_bytes(f, 1))
             ps.apply_proposal(proposal)
             self._cs.set_proposal(proposal, peer_id=env.from_id)
+            self._mark_all_dirty()  # we may now relay the proposal
         elif 2 in f:
             bp = decode_message(field_bytes(f, 2))
             height = to_signed64(field_int(bp, 1))
@@ -490,6 +576,7 @@ class ConsensusReactor:
             part = Part.decode(field_bytes(bp, 3))
             ps.set_has_proposal_block_part(height, round_, part.index)
             self._cs.add_block_part(height, round_, part, peer_id=env.from_id)
+            self._mark_all_dirty()  # a part we hold is a part we can serve
         elif 3 in f:
             pol = decode_message(field_bytes(f, 3))
             ps.apply_proposal_pol(
@@ -497,6 +584,7 @@ class ConsensusReactor:
                 to_signed32(field_int(pol, 2)),
                 BitArray.decode(field_bytes(pol, 3)),
             )
+            self._mark_dirty(env.from_id)  # POL prevotes became sendable
 
     def _handle_vote(self, env) -> None:
         f = decode_message(env.message)
@@ -525,6 +613,9 @@ class ConsensusReactor:
                 field_int(r, 3),
                 to_signed32(field_int(r, 5)),
             )
+            # the peer moved: it may need votes for its new round, or
+            # catchup data if it announced a lagging height
+            self._mark_dirty(env.from_id)
         elif 2 in f:  # NewValidBlock
             r = decode_message(field_bytes(f, 2))
             from ..types.block import PartSetHeader
@@ -536,6 +627,7 @@ class ConsensusReactor:
                 BitArray.decode(field_bytes(r, 4)),
                 bool(field_int(r, 5)),
             )
+            self._mark_dirty(env.from_id)  # it can now accept block parts
         elif 3 in f:  # HasVote
             r = decode_message(field_bytes(f, 3))
             rs = self._cs.rs
@@ -603,3 +695,4 @@ class ConsensusReactor:
             if vs is not None:
                 our_votes = vs.bit_array_by_block_id(block_id)
         ps.apply_vote_set_bits(height, round_, type_, bits, our_votes)
+        self._mark_dirty(env.from_id)  # its bit gaps are sendable work

@@ -266,6 +266,24 @@ def note_valset(vals) -> Optional[bytes]:
     return key if c.note(key, cols[0]) is not None else None
 
 
+def stats() -> dict:
+    """Snapshot of the cache state + the process-wide hit/miss/eviction
+    counters (cumulative — callers diff two snapshots to attribute
+    movement to a workload). Importable and callable without jax; the
+    simnet harness embeds the delta in its run report so churn scenarios
+    can assert the cache actually cycled cold→warm→evict."""
+    m = _ops()
+    c = cache()
+    return {
+        "enabled": c is not None,
+        "depth": c.depth if c is not None else 0,
+        "entries": len(c) if c is not None else 0,
+        "hits": m.epoch_cache_hits.total(),
+        "misses": m.epoch_cache_misses.total(),
+        "evictions": m.epoch_cache_evictions.total(),
+    }
+
+
 def lookup(entries) -> Optional[EpochEntry]:
     """EntryBlock -> its epoch entry, or None (no key, evicted, or cache
     disabled). Evicted-between-submit-and-prep degrades to the uncached

@@ -35,6 +35,24 @@ from .entry_block import EntryBlock, as_block
 _span = _trace.span
 
 
+class DispatchError(RuntimeError):
+    """A batch failed on the dispatch-owner thread (host prep, epoch-table
+    upload, or kernel launch). Carries the epoch/bucket context of the
+    failing batch (bucket 0 when the failure precedes bucket planning) so
+    a caller holding many futures can attribute the failure; the original
+    exception rides as __cause__. The dispatcher itself survives — only
+    the poisoned batch's futures fail."""
+
+    def __init__(self, msg: str, *, bucket: int = 0,
+                 epoch_key: Optional[bytes] = None):
+        ek = epoch_key.hex()[:16] if epoch_key else None
+        super().__init__(
+            f"{msg} (bucket={bucket}, epoch={ek or 'uncached'})"
+        )
+        self.bucket = bucket
+        self.epoch_key = epoch_key
+
+
 class _Job:
     __slots__ = ("entries", "future")
 
@@ -405,58 +423,99 @@ class AsyncBatchVerifier:
                 self._resolve_q.put(None)
                 break
             spans, fut, t_enq = item
-            m.dispatch_queue_depth.set(self._dispatch_q.qsize())
+            # Dispatcher survival invariant: NOTHING a single batch does —
+            # prep failure, metrics accounting, epoch-table upload inside
+            # the kernel closure, the launch itself — may kill or wedge
+            # this thread. A poisoned batch fails ONLY its own futures
+            # (wrapped in DispatchError with epoch/bucket context) and the
+            # loop moves to the next item with the depth semaphore intact
+            # (sem_held tracks the permit so even the last-resort handler
+            # cannot leak a depth slot).
+            sem_held = False
             try:
-                (f, args, rlc_entries, bucket), t_ready = fut.result()
-            except Exception as e:  # noqa: BLE001
-                for j, _, _ in spans:
-                    j.future.set_exception(e)
-                continue
-            # transfer accounting: host bytes this launch ships, averaged
-            # over the commits fused into it — the gauge a warm epoch
-            # cache visibly shrinks (/status verify_engine, PERF_r07)
-            m.h2d_bytes_per_commit.set(
-                _backend.h2d_arg_bytes(args) / max(len(spans), 1)
-            )
-            self._sem.acquire()  # depth: launched-but-unresolved bound
-            t0 = time.perf_counter()
-            if _trace.TRACER.enabled:
-                _trace.TRACER.record(
-                    "pipeline.queue_wait", max(t_enq, t_ready), t0,
-                    {"bucket": bucket},
-                )
-            self.dispatch_thread_idents.add(threading.get_ident())
-            try:
-                with _span("pipeline.dispatch", bucket=bucket):
-                    dev = f(*args)
-                # start the device->host copy NOW: a blocking fetch
-                # through the relay costs a full ~65ms RTT, but an async
-                # copy rides behind the compute, so the later np.asarray
-                # in _resolve returns in microseconds (measured:
-                # sustained 152k -> 286k sigs/s)
+                m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 try:
-                    dev.copy_to_host_async()
-                except AttributeError:
+                    (f, args, rlc_entries, bucket), t_ready = fut.result()
+                except Exception as e:  # noqa: BLE001 — prep-stage failure
+                    self._fail_spans(spans, self._wrap_dispatch_err(
+                        "batch prep failed", e, 0, spans))
+                    continue
+                try:
+                    # transfer accounting: host bytes this launch ships,
+                    # averaged over the commits fused into it — the gauge a
+                    # warm epoch cache visibly shrinks (/status, PERF_r07)
+                    m.h2d_bytes_per_commit.set(
+                        _backend.h2d_arg_bytes(args) / max(len(spans), 1)
+                    )
+                except Exception:  # noqa: BLE001 — accounting never fatal
                     pass
-            except Exception as e:  # noqa: BLE001
-                self._sem.release()
-                for j, _, _ in spans:
-                    j.future.set_exception(e)
-                continue
-            with self._mtx:
-                self._inflight += 1
-                m.pipeline_inflight.set(self._inflight)
-            now = time.perf_counter()
-            win_busy += now - t0
-            elapsed = now - win_start
-            if elapsed >= 2.0:
-                m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
-                win_start, win_busy = now, 0.0
-            elif elapsed > 0:
-                m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
-            self._resolve_q.put(
-                (spans, dev, rlc_entries, now, bucket)
-            )
+                self._sem.acquire()  # depth: launched-but-unresolved bound
+                sem_held = True
+                t0 = time.perf_counter()
+                if _trace.TRACER.enabled:
+                    _trace.TRACER.record(
+                        "pipeline.queue_wait", max(t_enq, t_ready), t0,
+                        {"bucket": bucket},
+                    )
+                self.dispatch_thread_idents.add(threading.get_ident())
+                try:
+                    with _span("pipeline.dispatch", bucket=bucket):
+                        dev = f(*args)
+                    # start the device->host copy NOW: a blocking fetch
+                    # through the relay costs a full ~65ms RTT, but an async
+                    # copy rides behind the compute, so the later np.asarray
+                    # in _resolve returns in microseconds (measured:
+                    # sustained 152k -> 286k sigs/s)
+                    try:
+                        dev.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                except Exception as e:  # noqa: BLE001
+                    # epoch-table upload (lazy, inside the cached-kernel
+                    # closure) or the launch itself blew up: release the
+                    # depth slot and fail this batch alone, with context
+                    self._sem.release()
+                    sem_held = False
+                    self._fail_spans(spans, self._wrap_dispatch_err(
+                        "kernel dispatch failed", e, bucket, spans))
+                    continue
+                with self._mtx:
+                    self._inflight += 1
+                    m.pipeline_inflight.set(self._inflight)
+                now = time.perf_counter()
+                win_busy += now - t0
+                elapsed = now - win_start
+                if elapsed >= 2.0:
+                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+                    win_start, win_busy = now, 0.0
+                elif elapsed > 0:
+                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+                self._resolve_q.put(
+                    (spans, dev, rlc_entries, now, bucket)
+                )
+                sem_held = False  # resolver now owns the release
+            except Exception as e:  # noqa: BLE001 — last-resort isolation
+                if sem_held:
+                    self._sem.release()
+                self._fail_spans(spans, self._wrap_dispatch_err(
+                    "dispatch bookkeeping failed", e, 0, spans))
+
+    @staticmethod
+    def _wrap_dispatch_err(msg, e, bucket, spans) -> "DispatchError":
+        err = DispatchError(
+            f"{msg}: {e!r}",
+            bucket=bucket,
+            epoch_key=getattr(spans[0][0].entries, "epoch_key", None)
+            if spans else None,
+        )
+        err.__cause__ = e
+        return err
+
+    @staticmethod
+    def _fail_spans(spans, err: BaseException) -> None:
+        for j, _, _ in spans:
+            if not j.future.done():
+                j.future.set_exception(err)
 
     def _resolver(self) -> None:
         """Completes futures: blocks on device materialization so neither

@@ -19,7 +19,9 @@ from .faults import (  # noqa: F401
     crash_restart_schedule,
     parse_faults,
     partition_heal_schedule,
+    rotation_schedule,
     smoke_schedule,
 )
 from .harness import Cluster, SimNode, SimReport  # noqa: F401
+from .search import SearchResult, search_schedules, shrink_schedule  # noqa: F401
 from .transport import LinkConfig, SimNetwork, SimRouter  # noqa: F401

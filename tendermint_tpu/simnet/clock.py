@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import heapq
 import random
+import time as _wall
 from typing import Callable, Optional
 
 # Virtual epoch: after the test-genesis times used across the repo
@@ -51,6 +52,10 @@ class SimClock:
         self.seed = seed
         self.rng = random.Random(seed)
         self.events_run = 0
+        # True when the LAST run_until call exited because its max_wall_s
+        # budget expired (vs predicate/deadline/heap-drain) — lets callers
+        # classify a wall cutoff without re-deriving it from elapsed time
+        self.wall_budget_hit = False
 
     # -- time source (ConsensusState/NodeClock read side) ----------------
 
@@ -83,17 +88,32 @@ class SimClock:
         predicate: Optional[Callable[[], bool]] = None,
         deadline: Optional[float] = None,
         max_events: Optional[int] = None,
+        max_wall_s: Optional[float] = None,
     ) -> bool:
         """Run events in order until `predicate()` is true (checked after
         each event), virtual `deadline` passes, the heap drains, or
         `max_events` fire. Returns predicate status (True also when no
-        predicate was given and the loop ended for another reason)."""
+        predicate was given and the loop ended for another reason).
+
+        `max_wall_s` bounds REAL elapsed time (checked every 1024 events
+        so the clock read never dominates tiny events) — the guard rail
+        for 100+-node clusters and schedule-search sweeps, where a
+        wedged scenario must cost a bounded slice of the budget instead
+        of grinding the virtual deadline event by event."""
         n = 0
+        self.wall_budget_hit = False
+        wall_deadline = (
+            _wall.monotonic() + max_wall_s if max_wall_s is not None else None
+        )
         if predicate is not None and predicate():
             return True
         while self._heap:
             if max_events is not None and n >= max_events:
                 return predicate() if predicate is not None else False
+            if wall_deadline is not None and (n & 1023) == 1023:
+                if _wall.monotonic() > wall_deadline:
+                    self.wall_budget_hit = True
+                    return predicate() if predicate is not None else False
             t = heapq.heappop(self._heap)
             if t.cancelled:
                 continue

@@ -16,12 +16,25 @@ Kinds:
   clock_skew   shift what one node reads as "now" by `skew` seconds
   double_sign  make a node's vote source byzantine: it signs and gossips
                two conflicting prevotes per round (equivocation)
+  val_join     promote node `node` (a standby full node) into the active
+               validator set with voting power `power` — a validator tx
+               rides a block, EndBlock returns the update, and the REAL
+               state.execution update path rotates the set two heights on
+  val_leave    remove validator `node` from the active set (power-0
+               update through the same EndBlock path)
+  val_power    change validator `node`'s voting power to `power`
+
+The three val_* kinds all route through ValidatorSet._update_with_change_set,
+so each one structurally invalidates ValidatorSet.hash() — a new epoch key
+for the device epoch cache (ops/epoch_cache.py) — and drives the cache
+through cold→warm→evict cycles under live consensus.
 
 JSON form (tools/simnet_run.py --faults): a list of objects with the
 same field names, e.g.
   [{"kind": "partition", "at_height": 5, "groups": [[0, 1], [2, 3]],
     "duration": 2.0},
-   {"kind": "crash", "at_height": 8, "node": 2, "restart_after": 1.0}]
+   {"kind": "crash", "at_height": 8, "node": 2, "restart_after": 1.0},
+   {"kind": "val_join", "at_height": 6, "node": 4, "power": 10}]
 """
 
 from __future__ import annotations
@@ -41,6 +54,7 @@ class Fault:
     duration: Optional[float] = None  # partition: heal after
     restart_after: Optional[float] = None  # crash: restart after
     skew: float = 0.0
+    power: Optional[int] = None  # val_join/val_power: new voting power
 
     VALID_KINDS = (
         "partition",
@@ -49,30 +63,66 @@ class Fault:
         "restart",
         "clock_skew",
         "double_sign",
+        "val_join",
+        "val_leave",
+        "val_power",
+    )
+    _NODE_KINDS = (
+        "crash", "restart", "clock_skew", "double_sign",
+        "val_join", "val_leave", "val_power",
     )
 
     def validate(self, n_nodes: int) -> None:
         if self.kind not in self.VALID_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.at_height is not None and self.at_time is not None:
+            raise ValueError(
+                f"{self.kind}: at_height and at_time are mutually exclusive"
+            )
         if self.at_height is None and self.at_time is None and self.kind != "double_sign":
             raise ValueError(f"{self.kind}: needs at_height or at_time")
+        if self.restart_after is not None and self.kind != "crash":
+            raise ValueError(f"{self.kind}: restart_after only applies to crash")
+        if self.duration is not None and self.kind != "partition":
+            raise ValueError(f"{self.kind}: duration only applies to partition")
         if self.kind == "partition" and not self.groups:
             raise ValueError("partition: needs groups")
-        if self.kind in ("crash", "restart", "clock_skew", "double_sign"):
+        if self.kind in self._NODE_KINDS:
             if self.node is None or not 0 <= self.node < n_nodes:
                 raise ValueError(f"{self.kind}: needs node in 0..{n_nodes - 1}")
+        if self.kind in ("val_join", "val_power"):
+            if self.power is None or self.power <= 0:
+                raise ValueError(f"{self.kind}: needs power >= 1")
+        elif self.power is not None:
+            # val_leave included: leaving IS the power-0 update — an
+            # explicit power here would be silently ignored
+            raise ValueError(f"{self.kind}: power only applies to val_join/val_power")
         if self.groups:
             for g in self.groups:
                 for i in g:
                     if not 0 <= i < n_nodes:
                         raise ValueError(f"partition: node {i} out of range")
 
+    def to_dict(self) -> dict:
+        """JSON form: only the fields that differ from the defaults, so
+        emitted regression scenarios stay minimal and diff-friendly."""
+        out = {"kind": self.kind}
+        for name, field_ in self.__dataclass_fields__.items():
+            if name == "kind":
+                continue
+            v = getattr(self, name)
+            if v != field_.default:
+                out[name] = v
+        return out
+
+
+_KNOWN_FAULT_FIELDS = frozenset(Fault.__dataclass_fields__)
+
 
 def parse_faults(raw: Sequence[dict]) -> List[Fault]:
     out = []
     for obj in raw:
-        known = {f for f in Fault.__dataclass_fields__}
-        extra = set(obj) - known
+        extra = set(obj) - _KNOWN_FAULT_FIELDS
         if extra:
             raise ValueError(f"unknown fault fields: {sorted(extra)}")
         out.append(Fault(**obj))
@@ -110,6 +160,51 @@ def smoke_schedule(n_nodes: int) -> List[Fault]:
     return partition_heal_schedule(n_nodes, at_height=3, duration=2.0) + (
         crash_restart_schedule(n_nodes - 1, at_height=6, restart_after=1.0)
     )
+
+
+def rotation_schedule(
+    n_nodes: int,
+    n_validators: int,
+    every: int = 5,
+    start: int = 3,
+    until: int = 20,
+    power: int = 10,
+) -> List[Fault]:
+    """Churn the active validator set every `every` heights: at each
+    rotation height the next standby full node joins and the oldest
+    active validator leaves (both in the same block's EndBlock updates,
+    so the active set size stays constant and quorum viability is never
+    in question). Validators cycle round-robin through ALL nodes, so a
+    long enough run rotates every node through the active set.
+
+    With no standbys (n_validators == n_nodes) rotations degrade to
+    power changes — still a structural ValidatorSet.hash() invalidation,
+    still a fresh epoch for the device cache."""
+    if not 1 <= n_validators <= n_nodes:
+        raise ValueError(f"n_validators must be in 1..{n_nodes}")
+    active = list(range(n_validators))
+    standby = list(range(n_validators, n_nodes))
+    out: List[Fault] = []
+    bump = 0
+    for h in range(start, until + 1, max(every, 1)):
+        if standby:
+            joiner = standby.pop(0)
+            leaver = active.pop(0)
+            out.append(Fault(kind="val_join", at_height=h, node=joiner, power=power))
+            out.append(Fault(kind="val_leave", at_height=h, node=leaver))
+            active.append(joiner)
+            standby.append(leaver)
+        else:
+            # full-validator cluster: rotate powers instead of membership
+            bump += 1
+            target = active[bump % len(active)]
+            out.append(
+                Fault(
+                    kind="val_power", at_height=h, node=target,
+                    power=power + bump,
+                )
+            )
+    return out
 
 
 # -- byzantine vote source ---------------------------------------------------

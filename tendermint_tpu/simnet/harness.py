@@ -75,9 +75,46 @@ class SimReport:
     events_run: int
     net: dict
     faults_applied: List[str] = _field(default_factory=list)
+    n_validators: int = 0
+    valset_changes: List[int] = _field(default_factory=list)
+    epoch_cache: dict = _field(default_factory=dict)
+    # the run ended because the REAL-time budget expired, not because the
+    # virtual deadline passed or an invariant broke — machine-speed
+    # dependent, so schedule search treats such a run as INCONCLUSIVE
+    # rather than a bug (a wedge is detected deterministically by the
+    # virtual deadline as long as the wall budget exceeds the time needed
+    # to burn it)
+    wall_budget_hit: bool = False
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
+
+
+class _SigMemo:
+    """Process-wide ed25519 verify memo for LARGE clusters: in a
+    single-process simulation every node re-verifies the same (pub, msg,
+    sig) triples — at 100 nodes that is ~99 redundant pure-Python curve
+    evaluations per vote. Verification is a deterministic pure function,
+    so memoizing the VERDICT (true and false alike) changes no observable
+    behavior, only the wall clock. Installed around crypto.ed25519.
+    verify_zip215_fast for the duration of a run; bounded by wholesale
+    clear (entries are tiny and a run's unique-signature count is far
+    below the cap)."""
+
+    def __init__(self, real, cap: int = 1 << 17):
+        self.real = real
+        self.cap = cap
+        self.cache: Dict[tuple, bool] = {}
+
+    def __call__(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
+        key = (pub, msg, sig)
+        v = self.cache.get(key)
+        if v is None:
+            v = self.real(pub, msg, sig)
+            if len(self.cache) >= self.cap:
+                self.cache.clear()
+            self.cache[key] = v
+        return v
 
 
 class SimNode:
@@ -109,6 +146,8 @@ class SimNode:
         self.reactor = None
         self.router: Optional[SimRouter] = None
         self.bstore = None
+        self.sstore = None
+        self.mp = None
         self._pump_pending = False
         self._gossip_timer = None
         self._last_maj23 = float("-inf")
@@ -120,7 +159,8 @@ class SimNode:
     def build(self, genesis: bool) -> None:
         """Construct the runtime (ConsensusState + reactor) from the
         persistent stores; `genesis=False` is the restart path."""
-        from ..abci import KVStoreApplication, LocalClient
+        from ..abci import LocalClient
+        from ..abci.kvstore import PersistentKVStoreApplication
         from ..consensus import ConsensusState, WAL
         from ..consensus.reactor import ConsensusReactor
         from ..eventbus import EventBus
@@ -131,7 +171,11 @@ class SimNode:
         from ..store import BlockStore
 
         c = self.cluster
-        app = KVStoreApplication(db=self.app_db)
+        # the persistent kvstore variant: "val:<b64 pub>!<power>" txs come
+        # back as EndBlock validator updates, so val_join/val_leave/
+        # val_power faults rotate the ACTIVE set through the real
+        # state.execution update path
+        app = PersistentKVStoreApplication(db=self.app_db)
         sstore = StateStore(self.state_db)
         if genesis:
             state = make_genesis_state(c.genesis_doc)
@@ -140,8 +184,10 @@ class SimNode:
             state = sstore.load()
             if state is None:  # crashed before the first state save
                 state = make_genesis_state(c.genesis_doc)
+        self.sstore = sstore
         self.bstore = BlockStore(self.block_db)
         mp = TxMempool(LocalClient(app))
+        self.mp = mp
         if genesis:
             for tx in c.txs_for(self.idx):
                 mp.check_tx(tx)
@@ -268,6 +314,8 @@ class Cluster:
         txs_per_node: int = 0,
         base_dir: Optional[str] = None,
         chain_id: str = CHAIN_ID,
+        n_validators: Optional[int] = None,
+        sig_memo: Optional[bool] = None,
     ):
         from ..types import Timestamp
         from ..types.genesis import GenesisDoc, GenesisValidator
@@ -277,6 +325,15 @@ class Cluster:
         self.faults = list(faults or [])
         for f in self.faults:  # validate before any filesystem side effects
             f.validate(n_nodes)
+        if n_validators is None:
+            n_validators = n_nodes
+        if not 1 <= n_validators <= n_nodes:
+            raise ValueError(f"n_validators must be in 1..{n_nodes}")
+        # nodes [0, n_validators) are genesis validators; the rest are
+        # standby FULL nodes — they run the complete consensus state
+        # machine (track rounds, fetch parts, commit blocks) but hold no
+        # voting power until a val_join fault rotates them in
+        self.n_validators = n_validators
         self.clock = SimClock(seed=seed)
         self.network = SimNetwork(self.clock, default_link=link)
         self.config = config or _default_config()
@@ -289,6 +346,14 @@ class Cluster:
         self._canonical: Dict[int, bytes] = {}
         self._started = False
         self._stopped = False
+        # memoize ed25519 verification verdicts across nodes — pure
+        # wall-clock relief for big clusters (see _SigMemo); default on
+        # from 12 nodes up
+        self._sig_memo_wanted = n_nodes >= 12 if sig_memo is None else sig_memo
+        self._sig_memo: Optional[_SigMemo] = None
+        # (height, fault) for fired val_* faults that must change the set
+        self._rotations_fired: List[tuple] = []
+        self._epoch_stats0 = self._epoch_stats()
         # nodes whose crash fault promises a restart (restart_after or an
         # explicit restart fault) — run_to_height waits for these, while a
         # crash-stop node is simply excluded from the liveness target
@@ -300,7 +365,7 @@ class Cluster:
             genesis_time=Timestamp(seconds=GENESIS_SECONDS),
             validators=[
                 GenesisValidator(address=b"", pub_key=n.sk.pub_key(), power=10)
-                for n in self.nodes
+                for n in self.nodes[:n_validators]
             ],
         )
         # trigger-less double_sign faults are byzantine from genesis and
@@ -319,10 +384,44 @@ class Cluster:
 
     # -- lifecycle -------------------------------------------------------
 
+    @staticmethod
+    def _epoch_stats() -> dict:
+        from ..ops import epoch_cache as _epoch
+
+        return _epoch.stats()
+
+    def _install_sig_memo(self) -> None:
+        from ..crypto import ed25519 as _ed
+
+        if self._sig_memo_wanted and not isinstance(
+            _ed.verify_zip215_fast, _SigMemo
+        ):
+            self._sig_memo = _SigMemo(_ed.verify_zip215_fast)
+            _ed.verify_zip215_fast = self._sig_memo
+
+    def _remove_sig_memo(self) -> None:
+        from ..crypto import ed25519 as _ed
+
+        if self._sig_memo is not None and _ed.verify_zip215_fast is self._sig_memo:
+            _ed.verify_zip215_fast = self._sig_memo.real
+        self._sig_memo = None
+
     def start(self) -> None:
         if self._started:
             return
         self._started = True
+        self._install_sig_memo()
+        # start from a COLD epoch cache: the cache is process-wide, so a
+        # previous same-process run (e.g. the replay-exactness second
+        # pass) would otherwise leave this run's epochs pre-warmed —
+        # breaking both the cold-registration invariant and the
+        # run-to-run identity of cache behavior
+        from ..ops import epoch_cache as _epoch
+
+        c = _epoch.cache()
+        if c is not None:
+            c.clear()
+        self._epoch_stats0 = self._epoch_stats()
         for n in self.nodes:
             n.start()
         for i, f in enumerate(self.faults):
@@ -337,6 +436,7 @@ class Cluster:
         if self._stopped:
             return
         self._stopped = True
+        self._remove_sig_memo()
         for n in self.nodes:
             if not n.crashed and n.cs is not None:
                 n.cs.stop_stepped()
@@ -368,7 +468,7 @@ class Cluster:
         # quorum (+2/3 voting power on the stored seen commit)
         seen = node.bstore.load_seen_commit()
         if seen is not None and seen.height == height:
-            bad = self.commit_quorum_violation(seen, node.idx)
+            bad = self.commit_quorum_violation(seen, node.idx, node=node)
             if bad is not None:
                 self.violations.append(bad)
         # height-triggered faults
@@ -425,6 +525,67 @@ class Cluster:
                     node.sk, self.chain_id
                 )
             self.faults_applied.append(f"t={t:.2f} double_sign node {f.node}")
+        elif f.kind in ("val_join", "val_leave", "val_power"):
+            power = 0 if f.kind == "val_leave" else int(f.power)
+            self._inject_validator_update(i, f.node, power)
+            self.faults_applied.append(
+                f"t={t:.2f} {f.kind} node {f.node} power {power}"
+            )
+
+    def _inject_validator_update(self, fault_idx: int, node_idx: int, power: int) -> None:
+        """Route a validator-set change through the REAL update path: a
+        "val:<b64 pub>!<power>!<nonce>" tx is fed to every live node's
+        mempool; whichever proposer wins next reaps it, the kvstore app
+        echoes it from EndBlock, and state.execution.update_state rotates
+        next_validators via ValidatorSet._update_with_change_set — which
+        structurally invalidates the set's hash()/ed25519 columns, keying
+        a fresh epoch for the device cache. The nonce keeps a rejoin at a
+        previous power distinct for the mempools' seen-tx caches."""
+        from ..abci.kvstore import make_validator_tx
+
+        target = self.nodes[node_idx]
+        tx = make_validator_tx(
+            target.sk.pub_key().bytes(), power, nonce=fault_idx
+        )
+        injected = 0
+        for n in self.nodes:
+            if n.crashed or n.mp is None:
+                continue
+            try:
+                n.mp.check_tx(tx)
+                injected += 1
+            except Exception:  # noqa: BLE001 — dup/full pools must not kill a run
+                pass
+        # only a rotation that can actually land AND changes the set is
+        # held to the churn invariant (check_invariants)
+        if injected and self._rotation_changes_set(target, power):
+            self._rotations_fired.append(
+                (self._max_committed(), self.faults[fault_idx].kind, node_idx)
+            )
+
+    def _rotation_changes_set(self, target: "SimNode", power: int) -> bool:
+        """Would (target, power) actually alter the CURRENT next-validator
+        set? A no-op update (joining at the power it already has) never
+        obliges a hash change. Read from the most-advanced live node —
+        a lagging node's stale next_validators could misclassify an
+        already-applied update as set-changing."""
+        pub = target.sk.pub_key().bytes()
+        best = None
+        for n in self.nodes:
+            if n.crashed or n.cs is None:
+                continue
+            if best is None or n.height() > best.height():
+                best = n
+        if best is None:
+            return False
+        vals = best.cs._state.next_validators
+        for v in vals.validators:
+            if v.pub_key.bytes() == pub:
+                return v.voting_power != power
+        return power > 0  # not in the set: joins iff power > 0
+
+    def _max_committed(self) -> int:
+        return max(self._canonical) if self._canonical else 0
 
     def _for_cross_group_pairs(self, groups, fn) -> None:
         group_of = {}
@@ -438,15 +599,30 @@ class Cluster:
                 if group_of.get(a.idx) != group_of.get(b.idx):
                     fn(a, b)
 
-    def commit_quorum_violation(self, commit, node_idx: int = -1) -> Optional[str]:
-        """None if `commit` carries > 2/3 of the genesis voting power,
-        else the violation record (also the _node_committed live check)."""
-        vals = self.genesis_doc.validators
-        total = sum(v.power for v in vals)
+    def commit_quorum_violation(
+        self, commit, node_idx: int = -1, node: Optional[SimNode] = None
+    ) -> Optional[str]:
+        """None if `commit` carries > 2/3 of the voting power of the set
+        that SIGNED it, else the violation record (also the
+        _node_committed live check). Under validator-set churn the
+        per-height set comes from the node's state store (the same
+        checkpoints verify_commit uses); genesis powers are the fallback
+        for callers without a node (static-set shortcut)."""
+        powers = None
+        if node is not None and node.sstore is not None:
+            try:
+                vals = node.sstore.load_validators(commit.height)
+                powers = [v.voting_power for v in vals.validators]
+            except KeyError:  # pre-checkpoint heights only — any other
+                powers = None  # store fault must surface, not silently
+                # fall back to (possibly wrong) genesis powers
+        if powers is None:
+            powers = [v.power for v in self.genesis_doc.validators]
+        total = sum(powers)
         power = sum(
-            vals[i].power
+            powers[i]
             for i, cs_ in enumerate(commit.signatures)
-            if i < len(vals) and cs_.for_block()
+            if i < len(powers) and cs_.for_block()
         )
         if 3 * power <= 2 * total:
             return (
@@ -493,9 +669,68 @@ class Cluster:
             h.update(b";")
         return h.hexdigest()
 
-    def check_invariants(self) -> List[str]:
+    def _valset_hash_walk(self) -> tuple:
+        """One pass over the longest live node's committed headers:
+        (change_heights, distinct_hash_count). A rotation cycling BACK to
+        an earlier membership re-uses its content-derived hash, so the
+        distinct count can be smaller than changes+1 — the epoch-cache
+        invariant must compare against distinct sets, not change events.
+        The FINAL height's valset is excluded from the distinct count:
+        height h's commit is only batch-verified when block h+1 carries
+        it, so a rotation landing exactly at the last committed height
+        can never have cold-registered within the run."""
+        best = None
+        for n in self.nodes:
+            if n.bstore is not None and (best is None or n.height() > best.height()):
+                best = n
+        if best is None:
+            return [], 0
+        changes: List[int] = []
+        seen: set = set()
+        prev = None
+        top = best.height()
+        for h in range(max(best.bstore.base(), 1), top + 1):
+            # meta is enough: the header carries validators_hash and a
+            # full load_block would reassemble every part + tx per height
+            meta = best.bstore.load_block_meta(h)
+            if meta is None:
+                continue
+            vh = bytes(meta.header.validators_hash)
+            if h < top:
+                seen.add(vh)
+            if prev is not None and vh != prev:
+                changes.append(h)
+            prev = vh
+        return changes, len(seen)
+
+    def valset_change_heights(self) -> List[int]:
+        """Heights whose committed header carries a validators_hash
+        different from the previous height's — the chain-visible trace of
+        every rotation."""
+        return self._valset_hash_walk()[0]
+
+    def epoch_cache_delta(self) -> dict:
+        """Cache movement attributable to this run (counter deltas since
+        Cluster construction) + the live cache state."""
+        now = self._epoch_stats()
+        d = {
+            k: now[k] - self._epoch_stats0.get(k, 0)
+            for k in ("hits", "misses", "evictions")
+        }
+        d["enabled"] = now["enabled"]
+        d["depth"] = now["depth"]
+        d["entries"] = now["entries"]
+        return d
+
+    def check_invariants(self, _walk=None) -> List[str]:
         """Final sweep: every node's whole chain must be a prefix of the
-        canonical chain (convergence after crash/partition recovery)."""
+        canonical chain (convergence after crash/partition recovery);
+        under churn, every effective rotation must surface as a
+        validators_hash change, and — when the device epoch cache is on —
+        the cache counters must actually move through the cold/warm/evict
+        cycle the rotations imply. `_walk` is an optional precomputed
+        `_valset_hash_walk()` result so run_to_height scans the chain
+        once for both the invariants and the report."""
         out = list(self.violations)
         for n in self.nodes:
             if n.bstore is None:
@@ -511,15 +746,64 @@ class Cluster:
                         f"convergence: node {n.idx} has {bh.hex()[:16]} at "
                         f"h{height}, canonical {want.hex()[:16]}"
                     )
+        # churn: a set-changing rotation injected at height h lands in a
+        # block within a couple of heights and takes effect two later
+        # (update_state next_validators plumbing) — if the chain ran on
+        # long enough, the validators_hash MUST have moved in (h, h+6]
+        if self._rotations_fired:
+            changes, distinct = (
+                _walk if _walk is not None else self._valset_hash_walk()
+            )
+        else:
+            changes, distinct = [], 0
+        max_h = self._max_committed()
+        for inj_h, kind, node_idx in self._rotations_fired:
+            if max_h < inj_h + 6:
+                continue  # run ended before the rotation could land
+            if not any(inj_h < ch <= inj_h + 6 for ch in changes):
+                out.append(
+                    f"rotation: {kind} node {node_idx} injected at h{inj_h} "
+                    f"never changed validators_hash by h{inj_h + 6} "
+                    f"(changes at {changes})"
+                )
+        if self._rotations_fired and changes:
+            ec = self.epoch_cache_delta()
+            # counters only move through the batch-verify path (note_valset);
+            # commits below BATCH_VERIFY_THRESHOLD sigs (tiny valsets) ride
+            # the single-sig path, so "enabled but untouched" proves nothing
+            if ec["enabled"] and ec["misses"] + ec["hits"] > 0:
+                # every DISTINCT valset must have cold-registered once
+                # (a rotation cycling back to an earlier membership
+                # re-uses its content hash — counted once); the LRU must
+                # have evicted what its depth cannot hold
+                if ec["misses"] < distinct:
+                    out.append(
+                        f"epoch-cache: {distinct} distinct valsets committed "
+                        f"but only {ec['misses']} cold registrations"
+                    )
+                if ec["hits"] == 0:
+                    out.append(
+                        "epoch-cache: warm re-verifications recorded no hits"
+                    )
+                expect_evict = distinct - ec["depth"]
+                if expect_evict > 0 and ec["evictions"] < expect_evict:
+                    out.append(
+                        f"epoch-cache: {distinct} epochs through depth "
+                        f"{ec['depth']} implies >= {expect_evict} evictions, "
+                        f"saw {ec['evictions']}"
+                    )
         return out
 
     # -- the driver ------------------------------------------------------
 
     def run_to_height(
-        self, target: int, max_virtual_s: float = 600.0
+        self, target: int, max_virtual_s: float = 600.0,
+        max_wall_s: Optional[float] = None,
     ) -> SimReport:
         """Run the event loop until every live node commits `target` (and
-        every crash-faulted node has restarted), then report."""
+        every crash-faulted node has restarted), then report.
+        `max_wall_s` bounds REAL time — the guard rail for 100+-node
+        clusters and search sweeps."""
         wall0 = _wall.monotonic()
         t0 = self.clock.time()
         self.start()
@@ -537,13 +821,23 @@ class Cluster:
             return any_live
 
         reached = self.clock.run_until(
-            predicate=done, deadline=t0 + max_virtual_s
+            predicate=done, deadline=t0 + max_virtual_s,
+            max_wall_s=max_wall_s,
         )
-        violations = self.check_invariants()
+        walk = self._valset_hash_walk() if self._rotations_fired else ([], 0)
+        violations = self.check_invariants(_walk=walk)
+        # classification comes from the event loop's OWN exit reason — an
+        # elapsed-time heuristic would misread a virtual-deadline exit
+        # (a real, deterministic wedge) as a wall cutoff whenever the
+        # post-run invariant walk pushed total elapsed past the budget
+        wall_hit = self.clock.wall_budget_hit
         reason = "ok"
         if not reached:
+            budget = f"{max_virtual_s}s virtual"
+            if wall_hit:
+                budget = f"{max_wall_s}s wall"
             reason = (
-                f"height {target} not reached within {max_virtual_s}s virtual"
+                f"height {target} not reached within {budget}"
                 f" (heights={self.heights()})"
             )
         elif violations:
@@ -562,4 +856,8 @@ class Cluster:
             events_run=self.clock.events_run,
             net=self.network.stats(),
             faults_applied=list(self.faults_applied),
+            n_validators=self.n_validators,
+            valset_changes=walk[0],
+            epoch_cache=self.epoch_cache_delta(),
+            wall_budget_hit=wall_hit,
         )

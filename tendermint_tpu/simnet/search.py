@@ -1,0 +1,398 @@
+"""Property-based fault-schedule search + delta-debug shrinking.
+
+The simnet analog of a property-based tester: seeded generators produce
+random-but-liveness-safe fault schedules (every partition heals, every
+crash restarts, at most f nodes are byzantine), a cluster runs each one,
+and the Tendermint safety/liveness invariants are the property. Any
+failing (seed, generator) pair is deterministic — the pair IS the repro —
+and the failing schedule is then shrunk like a property-based
+counterexample: drop one fault at a time, re-run, keep the failure, until
+no single removal preserves it. The minimal schedule is emitted as a JSON
+regression scenario (tests/scenarios/) that `tools/simnet_run.py
+--scenario` replays forever after.
+
+Generator RNGs are seeded with `random.Random(f"{generator}:{seed}")`
+(string seeding is PYTHONHASHSEED-independent), so a sweep's schedules —
+and through the cluster seed, its runs — are byte-stable across processes
+and machines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+from dataclasses import dataclass, field as _field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .faults import Fault, rotation_schedule
+from .harness import Cluster
+from .transport import LinkConfig
+
+
+# ---------------------------------------------------------------------------
+# Schedule generators: random interleavings that are liveness-SAFE by
+# construction, so "target height not reached" is a bug, not bad luck.
+# ---------------------------------------------------------------------------
+
+
+def _f_budget(n_validators: int) -> int:
+    """Max simultaneously-untrusted validators: f in n >= 3f + 1."""
+    return max((n_validators - 1) // 3, 0)
+
+
+def _gen_mixed(rng: random.Random, n_nodes: int, n_validators: int):
+    """Random interleavings of partition / crash / clock-skew /
+    double-sign over a (possibly) lossy link."""
+    link = LinkConfig(
+        latency_s=0.005,
+        jitter_s=rng.choice([0.0, 0.01, 0.02]),
+        drop=rng.choice([0.0, 0.02, 0.05]),
+        duplicate=rng.choice([0.0, 0.02]),
+        reorder=rng.choice([0.0, 0.05]),
+    )
+    faults: List[Fault] = []
+    budget = _f_budget(n_validators)
+    crashed: set = set()
+    byzantine: set = set()
+    for _ in range(rng.randint(1, 4)):
+        kind = rng.choice(("partition", "crash", "clock_skew", "double_sign"))
+        h = rng.randint(2, 7)
+        if kind == "partition":
+            # bias toward EVEN splits: a quorum-less partition forces
+            # round divergence on both sides, historically the richest
+            # failure soil (both PR-3 gossip bugs needed it)
+            cut = n_nodes // 2 if rng.random() < 0.5 else rng.randint(1, n_nodes - 1)
+            ids = list(range(n_nodes))
+            rng.shuffle(ids)
+            faults.append(
+                Fault(
+                    kind="partition", at_height=h,
+                    groups=[sorted(ids[:cut]), sorted(ids[cut:])],
+                    duration=rng.uniform(1.0, 4.0),
+                )
+            )
+        elif kind == "crash":
+            # every crash restarts; at most f validators crash per
+            # schedule (conservative — restarts would allow more) while
+            # standby full nodes (>= n_validators) crash freely
+            val_crashes = sum(1 for i in crashed if i < n_validators)
+            pool = [
+                i for i in range(n_nodes)
+                if i not in crashed
+                and (i >= n_validators or val_crashes < budget)
+            ]
+            if not pool:
+                continue
+            node = rng.choice(pool)
+            crashed.add(node)
+            faults.append(
+                Fault(
+                    kind="crash", at_height=h, node=node,
+                    restart_after=rng.uniform(0.5, 2.0),
+                )
+            )
+        elif kind == "clock_skew":
+            faults.append(
+                Fault(
+                    kind="clock_skew", at_height=h,
+                    node=rng.randrange(n_nodes),
+                    skew=rng.choice([-0.4, 0.3, 0.8]),
+                )
+            )
+        else:  # double_sign
+            if len(byzantine) >= budget:
+                continue
+            pool = [i for i in range(n_validators) if i not in byzantine]
+            if not pool:
+                continue
+            node = rng.choice(pool)
+            byzantine.add(node)
+            faults.append(Fault(kind="double_sign", at_height=h, node=node))
+    if not faults:  # degenerate draw: at least exercise a partition+heal
+        faults.append(
+            Fault(
+                kind="partition", at_height=3,
+                groups=[[0], list(range(1, n_nodes))], duration=1.5,
+            )
+        )
+    return faults, link
+
+
+def _gen_churn(rng: random.Random, n_nodes: int, n_validators: int):
+    """Validator-set rotation under mild loss, plus one disturbance —
+    the epoch-cache-churn shape (ISSUE 6 tentpole leg a)."""
+    link = LinkConfig(
+        latency_s=0.005,
+        jitter_s=rng.choice([0.0, 0.01]),
+        drop=rng.choice([0.0, 0.02]),
+    )
+    faults = rotation_schedule(
+        n_nodes, n_validators,
+        every=rng.choice([3, 4, 5]), start=rng.randint(2, 4), until=10,
+    )
+    roll = rng.random()
+    if roll < 0.4:
+        half = n_nodes // 2
+        faults.append(
+            Fault(
+                kind="partition", at_height=rng.randint(4, 7),
+                groups=[list(range(half)), list(range(half, n_nodes))],
+                duration=rng.uniform(1.0, 2.5),
+            )
+        )
+    elif roll < 0.8:
+        faults.append(
+            Fault(
+                kind="crash", at_height=rng.randint(4, 7),
+                node=rng.randrange(n_nodes),
+                restart_after=rng.uniform(0.5, 1.5),
+            )
+        )
+    return faults, link
+
+
+GENERATORS: Dict[str, Callable] = {
+    "mixed": _gen_mixed,
+    "churn": _gen_churn,
+}
+
+
+# ---------------------------------------------------------------------------
+# Running, searching, shrinking
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SearchResult:
+    """One sweep's outcome: every run's verdict + every (shrunk) failure.
+    `failure` is the first one (the common stop-on-failure case);
+    `failures` carries ALL of them when the sweep keeps searching."""
+
+    runs: List[dict] = _field(default_factory=list)
+    failures: List[dict] = _field(default_factory=list)
+
+    @property
+    def failure(self) -> Optional[dict]:
+        return self.failures[0] if self.failures else None
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "runs": self.runs,
+            "failure": self.failure,
+            "failures": self.failures,
+        }
+
+
+def run_schedule(
+    faults: Sequence[Fault],
+    seed: int,
+    n_nodes: int,
+    n_validators: Optional[int] = None,
+    link: Optional[LinkConfig] = None,
+    height: int = 12,
+    max_virtual_s: float = 300.0,
+    max_wall_s: Optional[float] = 120.0,
+):
+    """One deterministic cluster run of `faults`; returns the SimReport."""
+    c = Cluster(
+        n_nodes=n_nodes,
+        seed=seed,
+        link=link,
+        faults=list(faults),
+        n_validators=n_validators,
+    )
+    try:
+        return c.run_to_height(
+            height, max_virtual_s=max_virtual_s, max_wall_s=max_wall_s
+        )
+    finally:
+        c.stop()
+
+
+def shrink_schedule(
+    faults: Sequence[Fault],
+    still_fails: Callable[[List[Fault]], bool],
+    max_runs: int = 48,
+) -> Tuple[List[Fault], int]:
+    """Delta-debug a failing schedule to a minimal one: drop one fault at
+    a time, re-run, keep the failure; restart the scan after every
+    successful removal until a fixpoint (no single removal preserves the
+    failure) or the run budget is spent. Returns (minimal, runs_used)."""
+    cur = list(faults)
+    runs = 0
+    changed = True
+    while changed and runs < max_runs:
+        changed = False
+        for i in range(len(cur)):
+            cand = cur[:i] + cur[i + 1 :]
+            runs += 1
+            if still_fails(cand):
+                cur = cand
+                changed = True
+                break
+            if runs >= max_runs:
+                break
+    return cur, runs
+
+
+def search_schedules(
+    seeds: Sequence[int],
+    generators: Sequence[str] = ("mixed", "churn"),
+    n_nodes: int = 8,
+    n_validators: Optional[int] = None,
+    height: int = 12,
+    max_virtual_s: float = 300.0,
+    max_wall_s: Optional[float] = 120.0,
+    shrink: bool = True,
+    shrink_budget: int = 48,
+    scenario_dir: Optional[str] = None,
+    stop_on_failure: bool = True,
+    progress: Optional[Callable[[str], None]] = None,
+) -> SearchResult:
+    """Explore seeds x generators until an invariant breaks (or the grid
+    is exhausted). The first failure is shrunk to a minimal schedule and
+    — when `scenario_dir` is given — emitted as a JSON regression
+    scenario."""
+    res = SearchResult()
+    for gen_name in generators:
+        gen = GENERATORS[gen_name]
+        for seed in seeds:
+            rng = random.Random(f"{gen_name}:{seed}")
+            faults, link = gen(rng, n_nodes, n_validators or n_nodes)
+            rep = run_schedule(
+                faults, seed, n_nodes, n_validators, link,
+                height, max_virtual_s, max_wall_s,
+            )
+            # a run cut off by the REAL-time budget is machine-speed
+            # dependent: classify it INCONCLUSIVE, never a bug — a wedge
+            # is detected deterministically by the virtual deadline, and
+            # the wall budget only bounds how much CPU a wedged run may
+            # burn to get there
+            inconclusive = (not rep.ok) and rep.wall_budget_hit
+            rec = {
+                "generator": gen_name,
+                "seed": seed,
+                "ok": rep.ok,
+                "inconclusive": inconclusive,
+                "reason": rep.reason,
+                "height": rep.height,
+                "fingerprint": rep.fingerprint,
+                "faults": [f.to_dict() for f in faults],
+                "wall_s": round(rep.wall_s, 3),
+            }
+            res.runs.append(rec)
+            if progress is not None:
+                tag = "ok" if rep.ok else (
+                    "INCONCLUSIVE (wall budget)" if inconclusive else "FAIL"
+                )
+                progress(f"{gen_name}:{seed} {tag} h={rep.height} ({rep.reason})")
+            if rep.ok or inconclusive:
+                continue
+
+            def _fails(cand: List[Fault]) -> bool:
+                r = run_schedule(
+                    cand, seed, n_nodes, n_validators, link,
+                    height, max_virtual_s, max_wall_s,
+                )
+                # an inconclusive candidate run does NOT count as still-
+                # failing (conservative: the fault under test is kept)
+                return not r.ok and not r.wall_budget_hit
+
+            minimal, shrink_runs = (
+                shrink_schedule(faults, _fails, shrink_budget)
+                if shrink
+                else (list(faults), 0)
+            )
+            failure = {
+                "generator": gen_name,
+                "seed": seed,
+                "reason": rep.reason,
+                "violations": rep.violations,
+                "schedule": [f.to_dict() for f in faults],
+                "minimal": [f.to_dict() for f in minimal],
+                "shrink_runs": shrink_runs,
+                "link": dataclasses.asdict(link),
+                "n_nodes": n_nodes,
+                "n_validators": n_validators or n_nodes,
+                "height": height,
+            }
+            if scenario_dir:
+                failure["scenario_path"] = emit_scenario(
+                    scenario_dir, failure
+                )
+            res.failures.append(failure)
+            if stop_on_failure:
+                return res
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Regression scenarios: every bug the search finds becomes a replayable file
+# ---------------------------------------------------------------------------
+
+
+def emit_scenario(dir_path: str, failure: dict) -> str:
+    """Write a failing (shrunk) schedule as a self-contained scenario:
+    `tools/simnet_run.py --scenario <path>` replays it, and the file is
+    meant to be committed under tests/scenarios/."""
+    os.makedirs(dir_path, exist_ok=True)
+    stem = f"search_{failure['generator']}_seed{failure['seed']}"
+    path = os.path.join(dir_path, stem + ".json")
+    suffix = 1
+    while os.path.exists(path):
+        # never clobber a committed regression scenario: a later search
+        # failing on the same (generator, seed) is a DIFFERENT bug
+        suffix += 1
+        path = os.path.join(dir_path, f"{stem}-{suffix}.json")
+    # provenance: if a bug-injection seam was active during the search,
+    # record it — re-running the described search WITHOUT the seam is
+    # green, and a scenario file that cannot name the bug it guards
+    # against is unmaintainable
+    injected = sorted(
+        k for k in os.environ if k.startswith("TM_TPU_GOSSIP_BUG_")
+        and os.environ[k]
+    )
+    desc = (
+        "minimal failing schedule found by simnet search "
+        f"(generator={failure['generator']}, seed={failure['seed']}"
+        + (f", injected bug seam: {', '.join(injected)}" if injected else "")
+        + f"): {failure['reason']}"
+    )
+    doc = {
+        "description": desc,
+        "found_with_injected_bugs": injected,
+        "seed": failure["seed"],
+        "n_nodes": failure["n_nodes"],
+        "n_validators": failure["n_validators"],
+        "height": failure["height"],
+        "link": failure["link"],
+        "faults": failure["minimal"],
+        "expect": "ok",  # replays must PASS once the bug is fixed
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_scenario(path: str) -> dict:
+    """Parse a scenario file into run_schedule kwargs."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    from .faults import parse_faults
+
+    return {
+        "faults": parse_faults(doc["faults"]),
+        "seed": int(doc["seed"]),
+        "n_nodes": int(doc["n_nodes"]),
+        "n_validators": int(doc.get("n_validators") or doc["n_nodes"]),
+        "link": LinkConfig(**doc.get("link", {})),
+        "height": int(doc.get("height", 12)),
+    }

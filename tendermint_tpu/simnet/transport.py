@@ -136,6 +136,10 @@ class SimNetwork:
         self.delivered = 0
         self.dropped = 0
         self.duplicated = 0
+        # per-channel delivery counts: at 100+ nodes the p2p volume is
+        # dominated by per-vote HasVote chatter — the breakdown shows
+        # where a slow big-cluster run's events actually go
+        self.delivered_by_channel: Dict[int, int] = {}
         self._digest = hashlib.sha256()
 
     # -- wiring ----------------------------------------------------------
@@ -248,6 +252,8 @@ class SimNetwork:
             self.dropped += 1
             return
         self.delivered += 1
+        ch = env.channel_id
+        self.delivered_by_channel[ch] = self.delivered_by_channel.get(ch, 0) + 1
         self._digest.update(
             b"%d|%s|%s|%d|%d;"
             % (
@@ -272,4 +278,8 @@ class SimNetwork:
             "delivered": self.delivered,
             "dropped": self.dropped,
             "duplicated": self.duplicated,
+            "by_channel": {
+                "0x%02x" % ch: n
+                for ch, n in sorted(self.delivered_by_channel.items())
+            },
         }

@@ -417,6 +417,140 @@ class TestCachedVerdictParity:
 
 
 # ---------------------------------------------------------------------------
+# Churn lifecycle (ISSUE 6 satellite): realistic validator-set rotation —
+# join + leave through the REAL update_with_change_set path, exactly what
+# an EndBlock validator update drives — must cycle the cache through
+# cold -> warm -> invalidate -> evict -> re-register, with verdict/blame
+# parity on the evicted-fallback path. Sizes stay in the vp=128/bucket-128
+# shape class the parity tests above already compiled.
+# ---------------------------------------------------------------------------
+
+
+def _vset_with_sks(n, first_byte=1):
+    sks = [ed25519.gen_priv_key(bytes([first_byte + i]) * 32) for i in range(n)]
+    vals = [Validator.new(sk.pub_key(), 100) for sk in sks]
+    vset = ValidatorSet(validators=vals, proposer=vals[0])
+    return vset, {sk.pub_key().bytes(): sk for sk in sks}
+
+
+def _commit_signed_by(vset, by_pub, height=7, bad=()):
+    """A commit signed by the CURRENT set in its CURRENT order (rotation
+    re-sorts validators, so indices must be re-derived per epoch)."""
+    bid = _block_id()
+    ts = Timestamp(seconds=1_700_000_000)
+    sigs = []
+    for i, val in enumerate(vset.validators):
+        v = Vote(
+            type=PRECOMMIT_TYPE, height=height, round=0, block_id=bid,
+            timestamp=ts, validator_address=val.address, validator_index=i,
+        )
+        sig = (
+            b"\x01" * 64 if i in bad
+            else by_pub[val.pub_key.bytes()].sign(v.sign_bytes(CHAIN_ID))
+        )
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address, timestamp=ts, signature=sig,
+            )
+        )
+    return Commit(height=height, round=0, block_id=bid, signatures=sigs), bid
+
+
+def _rotate(vset, by_pub, joiner_byte):
+    """One churn: a fresh validator joins, the current first leaves —
+    the same change-set shape state.execution.update_state applies from
+    EndBlock updates (power 0 = removal)."""
+    new_sk = ed25519.gen_priv_key(bytes([joiner_byte]) * 32)
+    by_pub[new_sk.pub_key().bytes()] = new_sk
+    leaver = vset.validators[0]
+    vset.update_with_change_set(
+        [
+            Validator.new(new_sk.pub_key(), 100),
+            Validator.new(leaver.pub_key, 0),
+        ]
+    )
+
+
+class TestChurnLifecycle:
+    def test_rotation_cycles_cold_warm_invalidate_evict_reregister(self):
+        epoch_cache.reset(depth=2)
+        m = _ops()
+        vset, by_pub = _vset_with_sks(90)
+
+        def deltas():
+            return (
+                m.epoch_cache_hits.total(),
+                m.epoch_cache_misses.total(),
+                m.epoch_cache_evictions.total(),
+            )
+
+        def verify(h):
+            commit, bid = _commit_signed_by(vset, by_pub, height=h)
+            dec = Commit.decode(commit.encode())
+            validation.verify_commit(CHAIN_ID, vset, bid, h, dec)
+
+        h0, m0, e0 = deltas()
+        key_a = vset.hash()
+        epoch_a = vset.copy()  # pre-rotation snapshot: same hash/key
+        verify(7)  # cold: registers epoch A
+        h1, m1, e1 = deltas()
+        assert (m1 - m0, e1 - e0) == (1, 0)
+        verify(8)  # warm: hits epoch A
+        h2, m2, _ = deltas()
+        assert h2 - h1 >= 1 and m2 == m1
+
+        _rotate(vset, by_pub, 200)  # epoch B: structural invalidation
+        assert vset.hash() != key_a
+        verify(9)   # cold under the NEW key (depth 2: A + B resident)
+        verify(10)  # warm B
+        _, m3, e3 = deltas()
+        assert m3 - m2 == 1 and e3 - e1 == 0
+        assert len(epoch_cache.cache()) == 2
+
+        _rotate(vset, by_pub, 201)  # epoch C: LRU depth 2 evicts A
+        verify(11)
+        _, m4, e4 = deltas()
+        assert m4 - m3 == 1 and e4 - e3 == 1
+        assert epoch_cache.cache().get(key_a) is None  # A really evicted
+
+        # re-register: the SAME membership (content-derived hash == key_a)
+        # returning after eviction is a fresh cold registration, then warm
+        assert epoch_a.hash() == key_a
+        assert epoch_cache.note_valset(epoch_a) is None       # cold again
+        assert epoch_cache.note_valset(epoch_a) == key_a      # warm again
+        _, m5, _ = deltas()
+        assert m5 - m4 == 1
+
+    def test_evicted_epoch_verdict_and_blame_bit_identical(self):
+        """The satellite's parity leg: a commit verified WARM (cached
+        kernels) and the same commit verified after EVICTION (uncached
+        fallback) must produce byte-identical error strings — same
+        verdicts, same blamed lane."""
+        epoch_cache.reset(depth=4)
+        vset, by_pub = _vset_with_sks(90)
+        bad_i = 31
+        commit, bid = _commit_signed_by(vset, by_pub, height=7, bad=(bad_i,))
+        dec = Commit.decode(commit.encode())
+        with pytest.raises(ValueError) as cold_err:
+            validation.verify_commit(CHAIN_ID, vset, bid, 7, dec)  # cold
+        with pytest.raises(ValueError) as warm_err:
+            validation.verify_commit(CHAIN_ID, vset, bid, 7, dec)  # cached
+        epoch_cache.cache().clear()  # evict everything mid-stream
+        with pytest.raises(ValueError) as evicted_err:
+            validation.verify_commit(CHAIN_ID, vset, bid, 7, dec)  # fallback
+        assert str(cold_err.value) == str(warm_err.value) == str(
+            evicted_err.value
+        )
+        assert "wrong signature (#" in str(evicted_err.value)
+        # a GOOD commit from the same (re-registered) epoch verifies warm
+        good, gbid = _commit_signed_by(vset, by_pub, height=8)
+        gdec = Commit.decode(good.encode())
+        validation.verify_commit(CHAIN_ID, vset, gbid, 8, gdec)
+        validation.verify_commit(CHAIN_ID, vset, gbid, 8, gdec)
+
+
+# ---------------------------------------------------------------------------
 # Sharded cached path (needs jax.shard_map — absent on this container's
 # jax; runs on images that have it, e.g. the TPU driver)
 # ---------------------------------------------------------------------------

@@ -41,6 +41,50 @@ class TestAsyncBatchVerifier:
     def test_shared_verifier_is_singleton(self):
         assert pl.shared_verifier() is pl.shared_verifier()
 
+    def test_poisoned_job_fails_alone_dispatcher_survives(self, monkeypatch):
+        """ISSUE 6 satellite: a job whose kernel launch (or lazy
+        epoch-table upload — same code path: inside the prepared callable
+        on the dispatch-owner thread) raises must fail ONLY its own
+        future, with epoch/bucket context, and the dispatcher must keep
+        serving later jobs."""
+        real_prepare = pl.AsyncBatchVerifier._prepare
+        POISON_N = 3  # poisoned jobs are 3 entries long, healthy ones differ
+
+        def prep(entries):
+            f, args, rlc, bucket = real_prepare(entries)
+            if len(entries) == POISON_N:
+                def boom(*_a):
+                    raise RuntimeError("epoch table upload exploded")
+
+                return boom, args, rlc, bucket
+            return f, args, rlc, bucket
+
+        monkeypatch.setattr(
+            pl.AsyncBatchVerifier, "_prepare", staticmethod(prep)
+        )
+        v = pl.AsyncBatchVerifier(depth=2)
+        try:
+            bad = v.submit(_entries(POISON_N, tag=9))
+            with pytest.raises(pl.DispatchError) as ei:
+                bad.result(timeout=120)
+            assert "bucket=" in str(ei.value) and "epoch=" in str(ei.value)
+            assert isinstance(ei.value.__cause__, RuntimeError)
+            # the dispatcher must still be alive and serving
+            assert v._dispatch_thread.is_alive()
+            good = v.submit(_entries(8, tag=10))
+            res = good.result(timeout=120)
+            assert res.shape == (8,) and res.all()
+            # and a second poisoned job again fails only itself
+            bad2 = v.submit(_entries(POISON_N, tag=11))
+            with pytest.raises(pl.DispatchError):
+                bad2.result(timeout=120)
+            good2 = v.submit(_entries(5, tag=12))
+            assert good2.result(timeout=120).all()
+            assert v._dispatch_thread.is_alive()
+            assert v._resolve_thread.is_alive()
+        finally:
+            v.close()
+
 
 class TestPipelinedCommits:
     def test_verify_commits_pipelined_mixed(self):

@@ -13,6 +13,7 @@ Two layers:
      pattern as tests/test_entry_block_isolated.py).
 """
 
+import dataclasses
 import importlib.util
 import json
 import os
@@ -22,7 +23,12 @@ import sys
 import pytest
 
 from tendermint_tpu.simnet.clock import NodeClock, SimClock
-from tendermint_tpu.simnet.faults import Fault, parse_faults, smoke_schedule
+from tendermint_tpu.simnet.faults import (
+    Fault,
+    parse_faults,
+    rotation_schedule,
+    smoke_schedule,
+)
 from tendermint_tpu.simnet.transport import Envelope, LinkConfig, SimNetwork, SimRouter
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -203,6 +209,138 @@ class TestFaultSchedules:
         assert sched[0].duration is not None
         assert sched[1].restart_after is not None
 
+    def test_valset_fault_kinds_validate(self):
+        Fault(kind="val_join", at_height=5, node=4, power=10).validate(6)
+        Fault(kind="val_leave", at_height=5, node=1).validate(6)
+        Fault(kind="val_power", at_height=5, node=0, power=7).validate(6)
+        with pytest.raises(ValueError, match="power"):
+            Fault(kind="val_join", at_height=5, node=4).validate(6)
+        with pytest.raises(ValueError, match="power"):
+            Fault(kind="val_power", at_height=5, node=0, power=0).validate(6)
+        with pytest.raises(ValueError, match="node"):
+            Fault(kind="val_join", at_height=5, node=9, power=10).validate(6)
+
+    def test_validation_tightened(self):
+        """ISSUE 6 satellite: mutually exclusive triggers, kind-scoped
+        optional fields."""
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            Fault(
+                kind="crash", at_height=3, at_time=1.0, node=0,
+            ).validate(4)
+        with pytest.raises(ValueError, match="restart_after"):
+            Fault(
+                kind="clock_skew", at_height=3, node=0, restart_after=1.0,
+            ).validate(4)
+        with pytest.raises(ValueError, match="duration"):
+            Fault(
+                kind="crash", at_height=3, node=0, duration=1.0,
+            ).validate(4)
+        with pytest.raises(ValueError, match="power only"):
+            Fault(kind="crash", at_height=3, node=0, power=5).validate(4)
+        # the valid forms still pass
+        Fault(kind="partition", at_height=3, groups=[[0], [1, 2, 3]],
+              duration=2.0).validate(4)
+        Fault(kind="crash", at_height=3, node=0, restart_after=1.0).validate(4)
+
+    def test_to_dict_minimal_and_roundtrip(self):
+        f = Fault(kind="val_join", at_height=5, node=4, power=10)
+        d = f.to_dict()
+        assert d == {"kind": "val_join", "at_height": 5, "node": 4, "power": 10}
+        assert parse_faults([d]) == [f]
+
+    def test_rotation_schedule_membership_and_power_modes(self):
+        sched = rotation_schedule(6, 4, every=4, start=3, until=12)
+        assert [f.kind for f in sched] == ["val_join", "val_leave"] * 3
+        # joiners are standbys first, then cycled-out validators
+        assert [f.node for f in sched if f.kind == "val_join"] == [4, 5, 0]
+        assert [f.node for f in sched if f.kind == "val_leave"] == [0, 1, 2]
+        for f in sched:
+            f.validate(6)
+        # no standbys -> power churn, each still a structural change
+        sched2 = rotation_schedule(4, 4, every=5, start=3, until=13)
+        assert all(f.kind == "val_power" for f in sched2)
+        assert len({f.power for f in sched2}) == len(sched2)
+
+
+class TestSearchUnit:
+    """Crypto-free layer of the schedule-search engine: generator
+    determinism and shrink logic (cluster-backed search runs live in
+    tests/test_simnet.py via the subprocess runner)."""
+
+    def test_generators_are_seed_deterministic(self):
+        import random
+
+        from tendermint_tpu.simnet.search import GENERATORS
+
+        for name, gen in GENERATORS.items():
+            f1, l1 = gen(random.Random(f"{name}:5"), 8, 6)
+            f2, l2 = gen(random.Random(f"{name}:5"), 8, 6)
+            f3, l3 = gen(random.Random(f"{name}:6"), 8, 6)
+            assert [f.to_dict() for f in f1] == [f.to_dict() for f in f2]
+            assert l1 == l2
+            assert f1, f"{name} generated an empty schedule"
+            for f in f1:
+                f.validate(8)
+            # different seeds must actually explore different schedules
+            assert (
+                [f.to_dict() for f in f1] != [f.to_dict() for f in f3]
+                or l1 != l3
+            )
+
+    def test_shrink_drops_irrelevant_faults(self):
+        from tendermint_tpu.simnet.search import shrink_schedule
+
+        poison = Fault(kind="crash", at_height=5, node=0, restart_after=1.0)
+        noise = [
+            Fault(kind="clock_skew", at_height=2, node=1, skew=0.3),
+            Fault(kind="partition", at_height=3, groups=[[0], [1, 2, 3]],
+                  duration=1.0),
+            Fault(kind="double_sign", at_height=4, node=2),
+        ]
+        sched = [noise[0], poison, noise[1], noise[2]]
+        runs = {"n": 0}
+
+        def still_fails(cand):
+            runs["n"] += 1
+            return poison in cand
+
+        minimal, used = shrink_schedule(sched, still_fails)
+        assert minimal == [poison]
+        assert used == runs["n"] <= 12
+
+    def test_shrink_respects_budget(self):
+        from tendermint_tpu.simnet.search import shrink_schedule
+
+        sched = [
+            Fault(kind="clock_skew", at_height=i + 2, node=0, skew=0.1)
+            for i in range(6)
+        ]
+        minimal, used = shrink_schedule(sched, lambda cand: True, max_runs=3)
+        assert used <= 3
+        assert len(minimal) >= len(sched) - 3
+
+    def test_scenario_emit_load_roundtrip(self, tmp_path):
+        from tendermint_tpu.simnet.search import emit_scenario, load_scenario
+
+        failure = {
+            "generator": "mixed",
+            "seed": 9,
+            "reason": "height 10 not reached",
+            "minimal": [
+                {"kind": "partition", "at_height": 7,
+                 "groups": [[0], [1, 2, 3]], "duration": 1.5},
+            ],
+            "link": dataclasses.asdict(LinkConfig(drop=0.05)),
+            "n_nodes": 4,
+            "n_validators": 4,
+            "height": 10,
+        }
+        path = emit_scenario(str(tmp_path), failure)
+        kw = load_scenario(path)
+        assert kw["seed"] == 9 and kw["n_nodes"] == 4
+        assert kw["faults"][0].kind == "partition"
+        assert kw["link"].drop == 0.05
+
 
 def _purepy_env():
     return dict(os.environ, TM_TPU_PUREPY_CRYPTO="1", JAX_PLATFORMS="cpu")
@@ -230,6 +368,53 @@ def test_simnet_suite_under_purepy_fallback():
     )
     tail = (r.stdout or b"").decode(errors="replace")[-3000:]
     assert r.returncode == 0, f"isolated test_simnet run failed:\n{tail}"
+
+
+def test_mini_search_sweep_green_and_replay_exact():
+    """ISSUE 6 satellite: a fixed-seed mini sweep (5 seeds x 2 generators,
+    8 nodes, to h>=10) through the search engine must come back green on
+    the fixed build, and re-running one (generator, seed) cell must be
+    replay-exact — regression-guarding the schedule generators themselves
+    (a generator drift would move every downstream search)."""
+    code = r"""
+import json, sys
+from tendermint_tpu.simnet.search import search_schedules
+res = search_schedules(
+    list(range(5)), generators=("mixed", "churn"), n_nodes=8,
+    n_validators=6, height=10, max_virtual_s=180.0, max_wall_s=30.0,
+    shrink=False,
+)
+rerun = search_schedules(
+    [0], generators=("churn",), n_nodes=8, n_validators=6, height=10,
+    max_virtual_s=180.0, max_wall_s=30.0, shrink=False,
+)
+first_churn = next(r for r in res.runs if r["generator"] == "churn" and r["seed"] == 0)
+print(json.dumps({
+    "ok": res.ok,
+    "n_runs": len(res.runs),
+    "all_ok": all(r["ok"] for r in res.runs),
+    "replay_exact": (
+        rerun.runs[0]["fingerprint"] == first_churn["fingerprint"]
+        and rerun.runs[0]["faults"] == first_churn["faults"]
+    ),
+    "reasons": [r["reason"] for r in res.runs if not r["ok"]],
+}))
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        env=_purepy_env(),
+        cwd=REPO,
+        timeout=600,
+    )
+    out = (r.stdout or b"").decode(errors="replace")
+    assert r.returncode == 0, (
+        f"mini sweep crashed:\n{(r.stderr or b'').decode(errors='replace')[-3000:]}"
+    )
+    verdict = json.loads(out.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["all_ok"], verdict
+    assert verdict["n_runs"] == 10
+    assert verdict["replay_exact"], "generator or cluster replay drifted"
 
 
 def test_smoke_cli_partition_heal_crash_restart():

@@ -14,9 +14,19 @@ Examples:
     # assert replay-exact fingerprints
     python tools/simnet_run.py --smoke
 
-    # a custom schedule + lossy links, with a trace
-    python tools/simnet_run.py --seed 9 --faults sched.json \\
-        --drop 0.05 --jitter-ms 20 --trace /tmp/simnet-trace.json
+    # 100-node cluster, 12 active validators, rotation every 5 heights,
+    # two replay-exact runs
+    python tools/simnet_run.py --nodes 100 --validators 12 \\
+        --preset rotation --rotate-every 5 --height 20 --repeat 2
+
+    # property-based schedule search: seeds x generators until an
+    # invariant breaks, then shrink the failing schedule to a minimal
+    # JSON regression scenario
+    python tools/simnet_run.py --search --search-seeds 0:20 \\
+        --nodes 8 --height 12 --scenario-dir tests/scenarios
+
+    # replay a recorded regression scenario
+    python tools/simnet_run.py --scenario tests/scenarios/foo.json
 
 Fault schedule JSON: see tendermint_tpu/simnet/faults.py docstring.
 Runs on CPU without the `cryptography` wheel (pure-Python ed25519
@@ -43,23 +53,25 @@ SMOKE_SEED = 42
 SMOKE_HEIGHT = 20  # the acceptance bar: partition+heal+crash/restart to h>=20
 
 
-def build_cluster(args, faults):
+def build_cluster(args, faults, link=None):
     from tendermint_tpu.simnet import Cluster, LinkConfig
 
-    link = LinkConfig(
-        latency_s=args.latency_ms / 1000.0,
-        jitter_s=args.jitter_ms / 1000.0,
-        drop=args.drop,
-        duplicate=args.duplicate,
-        reorder=args.reorder,
-        bandwidth_bps=args.bandwidth_bps or None,
-    )
+    if link is None:
+        link = LinkConfig(
+            latency_s=args.latency_ms / 1000.0,
+            jitter_s=args.jitter_ms / 1000.0,
+            drop=args.drop,
+            duplicate=args.duplicate,
+            reorder=args.reorder,
+            bandwidth_bps=args.bandwidth_bps or None,
+        )
     return Cluster(
         n_nodes=args.nodes,
         seed=args.seed,
         link=link,
         faults=faults,
         txs_per_node=args.txs,
+        n_validators=args.validators or None,
     )
 
 
@@ -68,6 +80,7 @@ def load_faults(args):
         crash_restart_schedule,
         parse_faults,
         partition_heal_schedule,
+        rotation_schedule,
         smoke_schedule,
     )
 
@@ -81,16 +94,28 @@ def load_faults(args):
         return crash_restart_schedule(args.nodes - 1)
     if preset == "smoke":
         return smoke_schedule(args.nodes)
+    if preset == "rotation":
+        return rotation_schedule(
+            args.nodes,
+            args.validators or args.nodes,
+            every=args.rotate_every,
+            start=args.rotate_start,
+            until=args.height,
+        )
     return []
 
 
-def run_once(args, faults) -> dict:
+def run_once(args, faults, link=None) -> dict:
     from tendermint_tpu.observability import trace as _trace
 
-    cluster = build_cluster(args, faults)
+    cluster = build_cluster(args, faults, link=link)
     try:
         with _trace.span("simnet.run", seed=args.seed, nodes=args.nodes):
-            rep = cluster.run_to_height(args.height, max_virtual_s=args.max_virtual_s)
+            rep = cluster.run_to_height(
+                args.height,
+                max_virtual_s=args.max_virtual_s,
+                max_wall_s=_wall_budget(args, None),
+            )
     finally:
         cluster.stop()  # closes WALs and removes the temp dir even on error
     out = rep.to_dict()
@@ -100,17 +125,123 @@ def run_once(args, faults) -> dict:
     return out
 
 
+def _wall_budget(args, mode_default):
+    """-1 = mode default, 0 = explicitly unbounded, else the bound."""
+    if args.max_wall_s < 0:
+        return mode_default
+    return args.max_wall_s or None
+
+
+def parse_seed_range(spec: str):
+    """"a:b" -> range(a, b); "3,7,9" -> [3, 7, 9]; "12" -> [12]."""
+    if ":" in spec:
+        a, b = spec.split(":", 1)
+        return list(range(int(a), int(b)))
+    return [int(s) for s in spec.split(",") if s.strip() != ""]
+
+
+def run_search(args) -> int:
+    from tendermint_tpu.simnet.search import GENERATORS, search_schedules
+
+    seeds = parse_seed_range(args.search_seeds)
+    generators = [g for g in args.generators.split(",") if g]
+    # an empty grid or a typo'd generator must be a usage error, not a
+    # vacuous green sweep / raw KeyError
+    if not seeds:
+        print(f"error: empty seed grid {args.search_seeds!r}", file=sys.stderr)
+        return 2
+    unknown = [g for g in generators if g not in GENERATORS]
+    if not generators or unknown:
+        print(
+            f"error: unknown generators {unknown or args.generators!r}; "
+            f"available: {sorted(GENERATORS)}",
+            file=sys.stderr,
+        )
+        return 2
+    t0 = time.monotonic()
+    res = search_schedules(
+        seeds,
+        generators=generators,
+        n_nodes=args.nodes,
+        n_validators=args.validators or None,
+        height=args.height,
+        max_virtual_s=args.max_virtual_s,
+        max_wall_s=_wall_budget(args, 120.0),
+        shrink=not args.no_shrink,
+        scenario_dir=args.scenario_dir or None,
+        stop_on_failure=not args.keep_searching,
+        progress=(lambda m: print(f"# {m}", file=sys.stderr))
+        if args.verbose
+        else None,
+    )
+    out = res.to_dict()
+    out["wall_total_s"] = round(time.monotonic() - t0, 3)
+    out["seeds"] = seeds
+    out["generators"] = generators
+    print(json.dumps(out, indent=2, default=str))
+    return 0 if res.ok else 1
+
+
+def run_scenario(args) -> int:
+    """Replay a recorded regression scenario. Exit 0 when it passes,
+    1 on a real failure (the bug is back), 3 when the wall budget cut
+    the run short — inconclusive, the same classification the search
+    applies (machine speed must never read as a regression)."""
+    from tendermint_tpu.simnet.search import load_scenario, run_schedule
+
+    kw = load_scenario(args.scenario)
+    t0 = time.monotonic()
+    rep = run_schedule(
+        kw["faults"],
+        kw["seed"],
+        kw["n_nodes"],
+        kw["n_validators"],
+        kw["link"],
+        kw["height"],
+        max_virtual_s=args.max_virtual_s,
+        max_wall_s=_wall_budget(args, 120.0),
+    )
+    inconclusive = (not rep.ok) and rep.wall_budget_hit
+    out = rep.to_dict()
+    out["scenario"] = args.scenario
+    out["inconclusive"] = inconclusive
+    out["wall_total_s"] = round(time.monotonic() - t0, 3)
+    print(json.dumps(out, indent=2, default=str))
+    return 0 if rep.ok else (3 if inconclusive else 1)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument(
+        "--validators",
+        type=int,
+        default=0,
+        help="genesis validator count (0 = all nodes); the rest are "
+        "standby full nodes that val_join faults can rotate in",
+    )
     ap.add_argument("--height", type=int, default=20)
     ap.add_argument("--max-virtual-s", type=float, default=600.0)
+    ap.add_argument(
+        "--max-wall-s", type=float, default=-1.0,
+        help="bound REAL elapsed time per run (0 = unbounded; default: "
+        "unbounded for plain runs, 120s per run in --search/--scenario "
+        "modes, where a budget-cut run counts as inconclusive, not a bug)",
+    )
     ap.add_argument("--faults", default="", help="JSON fault schedule file")
     ap.add_argument(
         "--preset",
-        choices=["none", "partition_heal", "crash_restart", "smoke"],
+        choices=["none", "partition_heal", "crash_restart", "smoke", "rotation"],
         default="none",
+    )
+    ap.add_argument(
+        "--rotate-every", type=int, default=5,
+        help="rotation preset: churn the valset every N heights",
+    )
+    ap.add_argument(
+        "--rotate-start", type=int, default=3,
+        help="rotation preset: first churn height",
     )
     ap.add_argument("--txs", type=int, default=0, help="seed N txs per node")
     ap.add_argument("--latency-ms", type=float, default=5.0)
@@ -132,10 +263,56 @@ def main() -> int:
         help=f"tier-1 smoke: 4 nodes, smoke schedule, seed {SMOKE_SEED}, "
         f"height {SMOKE_HEIGHT}, two replay-exact runs",
     )
+    # -- property-based schedule search ----------------------------------
+    ap.add_argument(
+        "--search",
+        action="store_true",
+        help="explore --search-seeds x --generators until an invariant "
+        "breaks, then shrink the failing schedule to a minimal repro",
+    )
+    ap.add_argument(
+        "--search-seeds", default="0:10",
+        help='seed grid: "a:b" range or comma list (default 0:10)',
+    )
+    ap.add_argument(
+        "--generators", default="mixed,churn",
+        help="comma list of schedule generators (mixed, churn)",
+    )
+    ap.add_argument("--no-shrink", action="store_true")
+    ap.add_argument(
+        "--keep-searching", action="store_true",
+        help="do not stop at the first failure",
+    )
+    ap.add_argument(
+        "--scenario-dir", default="",
+        help="write the shrunk failing schedule here as a JSON scenario",
+    )
+    ap.add_argument(
+        "--scenario", default="",
+        help="replay a recorded regression scenario file and exit",
+    )
+    ap.add_argument(
+        "--inject-bug",
+        choices=["", "catchup"],
+        default="",
+        help="re-introduce a known-fixed gossip bug (TM_TPU_GOSSIP_BUG_* "
+        "seam) so the search demonstrably rediscovers and shrinks it",
+    )
+    ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+
+    if args.inject_bug == "catchup":
+        # must land before tendermint_tpu.consensus.peer_state is imported
+        os.environ["TM_TPU_GOSSIP_BUG_CATCHUP"] = "1"
+
+    if args.scenario:
+        return run_scenario(args)
+    if args.search:
+        return run_search(args)
 
     if args.smoke:
         args.nodes = 4
+        args.validators = 0
         args.seed = SMOKE_SEED
         args.height = max(args.height if args.height != 20 else 0, SMOKE_HEIGHT)
         args.preset = "smoke"
