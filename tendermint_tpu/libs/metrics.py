@@ -375,6 +375,25 @@ class OpsMetrics:
             "Host bytes shipped to the device by the last dispatched "
             "batch, averaged over its coalesced commits.",
         )
+        # overlapped relay (ops/pipeline.py dispatcher + ops/device_pool):
+        # transfer_overlap_ratio = fraction of H2D transfer time issued
+        # while a kernel was in flight (hidden behind compute); the pool
+        # counters split slot acquires into recycled vs freshly minted —
+        # steady state over one bucket shows misses == pool depth, then
+        # hits only (allocations flat)
+        self.transfer_overlap_ratio = registry.gauge(
+            "ops", "transfer_overlap_ratio",
+            "Fraction of recent H2D transfer time hidden behind device "
+            "compute (windowed).",
+        )
+        self.buffer_pool_hits = registry.counter(
+            "ops", "buffer_pool_hits_total",
+            "Device input-buffer slot acquires served by a recycled slot.",
+        )
+        self.buffer_pool_misses = registry.counter(
+            "ops", "buffer_pool_misses_total",
+            "Device input-buffer slot acquires that minted a new slot.",
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +454,9 @@ def ops_stats() -> dict:
         "epoch_cache_misses": int(m.epoch_cache_misses.total()),
         "epoch_cache_evictions": int(m.epoch_cache_evictions.total()),
         "h2d_bytes_per_commit": float(m.h2d_bytes_per_commit.value()),
+        "transfer_overlap_ratio": float(m.transfer_overlap_ratio.value()),
+        "buffer_pool_hits": int(m.buffer_pool_hits.total()),
+        "buffer_pool_misses": int(m.buffer_pool_misses.total()),
     }
 
 

@@ -1,0 +1,57 @@
+"""Shared test scaffolding for the overlapped dispatcher (ISSUE 7).
+
+Used by BOTH tests/test_overlap.py and the `tools/prep_bench.py
+--overlap` tier-1 gate: they pin the same dispatcher loop structure
+(transfer k+1 issued before batch k resolves) against the same mock, so
+the mock lives in one place instead of drifting as two copies.
+
+Not imported by any production path.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class SlowReadback:
+    """Proxy device result whose materialization costs `delay` seconds —
+    the resolver blocks on __array__ exactly like a relay-attached TPU's
+    D2H wait; async-copy capability passes through to the real result."""
+
+    def __init__(self, dev, delay: float):
+        self._dev = dev
+        self._delay = delay
+
+    def copy_to_host_async(self):
+        fn = getattr(self._dev, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+    def __array__(self, dtype=None):
+        import numpy as np
+
+        time.sleep(self._delay)
+        a = np.asarray(self._dev)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def slow_prepare(real_prepare, delay: float):
+    """Wrap AsyncBatchVerifier._prepare so every kernel result rides a
+    SlowReadback — the kernel itself (and its donation/transfer path)
+    runs unchanged; only the readback is slowed."""
+
+    def prep(entries):
+        f, args, rlc, bucket = real_prepare(entries)
+        return (lambda *xs: SlowReadback(f(*xs), delay)), args, rlc, bucket
+
+    return prep
+
+
+def drain_pool(pool, timeout: float = 5.0) -> None:
+    """Wait for every in-flight slot to return. The resolver completes a
+    batch's futures BEFORE releasing its pool slot, so a caller waking
+    from future.result() can observe in_flight briefly nonzero — tests
+    and the --overlap gate drain here before asserting leak-freedom."""
+    deadline = time.time() + timeout
+    while pool.in_flight() and time.time() < deadline:
+        time.sleep(0.01)

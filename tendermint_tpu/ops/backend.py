@@ -418,15 +418,32 @@ def prepare_batch_cached_device_hash(
     return args
 
 
-def cached_kernel(ep, device_hash: bool):
+@functools.lru_cache(maxsize=1)
+def donate_enabled() -> bool:
+    """Buffer donation default (ISSUE 7): ON for the TPU backend — donated
+    launches let XLA recycle the batch input pages instead of growing the
+    arena per launch — OFF elsewhere (CPU XLA ignores donation and warns
+    per executable, so tier-1 runs opt in explicitly). TM_TPU_DONATE=1/0
+    forces either way."""
+    env = os.environ.get("TM_TPU_DONATE")
+    if env is not None:
+        return env != "0"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def cached_kernel(ep, device_hash: bool, donate: bool = False):
     """Kernel closure for a warm epoch: resolves the entry's device
     tables at CALL time — the caller is the pipeline's single
     dispatch-owner thread, so the one-time table upload happens on the
-    only thread allowed to touch the relay."""
+    only thread allowed to touch the relay. The tables ride as the two
+    leading (never-donated) arguments; `donate` applies only to the
+    per-batch args."""
     if device_hash:
-        base = ed25519_verify.jitted_verify_cached_device_hash()
+        base = ed25519_verify.jitted_verify_cached_device_hash(donate)
     else:
-        base = ed25519_verify.jitted_verify_cached()
+        base = ed25519_verify.jitted_verify_cached(donate)
 
     def call(*args):
         tbl_limbs, tbl_sign = ep.xla_tables()
@@ -618,19 +635,23 @@ def verify_batch(entries) -> np.ndarray:
     while i < len(entries):
         chunk = entries[i : i + BUCKETS[-1]]
         bucket = _bucket_for(len(chunk))
+        # same donate flag as the pipeline's _prepare: the jitted-wrapper
+        # caches key on it, so defaulting here would compile every bucket
+        # twice (and de-warm warmup())
+        donate = donate_enabled()
         if ep is not None:
             # warm epoch: committee gathers from the device-resident
             # table, per-sig rows ship raw and unpack on device
-            kern = cached_kernel(ep, device_hash)
+            kern = cached_kernel(ep, device_hash, donate)
             if device_hash:
                 args = prepare_batch_cached_device_hash(chunk, bucket, ep)
             else:
                 args = prepare_batch_cached(chunk, bucket, ep)
         elif device_hash:
-            kern = ed25519_verify.jitted_verify_device_hash()
+            kern = ed25519_verify.jitted_verify_device_hash(donate)
             args = prepare_batch_device_hash(chunk, bucket)
         else:
-            kern = ed25519_verify.jitted_verify()
+            kern = ed25519_verify.jitted_verify(donate)
             args = prepare_batch(chunk, bucket)
         # dispatch vs wait split: jax dispatch returns before the device
         # finishes; the np.asarray blocks until the result materializes
@@ -746,4 +767,6 @@ def warmup(bucket: int = BUCKETS[0]) -> None:
     """Pre-compile the kernel for a bucket (first XLA compile is slow)."""
     verify_batch([])  # no-op; keeps import light
     args = prepare_batch([], bucket)
-    np.asarray(ed25519_verify.jitted_verify()(*args))
+    # the donate flag keys the jitted-wrapper cache — warm the variant
+    # the pipeline will actually launch
+    np.asarray(ed25519_verify.jitted_verify(donate_enabled())(*args))

@@ -317,21 +317,42 @@ def verify_kernel_device_hash(
     return verify_kernel(a_y, a_sign, r_y, r_sign, s_bits_t, k_bits_t, s_ok)
 
 
+# Donation (ISSUE 7): with donate=True the jitted wrapper donates every
+# PER-BATCH input buffer to XLA, so a launch consumes its inputs and their
+# pages return to the allocator for the next batch's device_put — the
+# "recycled device allocation" steady state the dispatcher's buffer pool
+# (ops/device_pool.py) bounds. The epoch-table arguments of the cached
+# kernels (argnums 0-1) are persistent device residents shared across
+# batches and are NEVER donated — donating them would invalidate the
+# cache entry after one launch.
+
+
 @functools.lru_cache(maxsize=None)
 def jitted_verify(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel, donate_argnums=tuple(range(7)))
     return jax.jit(verify_kernel)
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_verify_device_hash():
+def jitted_verify_device_hash(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel_device_hash,
+                       donate_argnums=tuple(range(9)))
     return jax.jit(verify_kernel_device_hash)
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_verify_cached():
+def jitted_verify_cached(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel_cached,
+                       donate_argnums=tuple(range(2, 7)))
     return jax.jit(verify_kernel_cached)
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_verify_cached_device_hash():
+def jitted_verify_cached_device_hash(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel_cached_device_hash,
+                       donate_argnums=tuple(range(2, 9)))
     return jax.jit(verify_kernel_cached_device_hash)

@@ -52,6 +52,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..observability import trace as _trace
+
+_span = _trace.span
+
 DEFAULT_DEPTH = 8
 
 _IDENT_ENC = np.zeros(32, dtype=np.uint8)
@@ -70,7 +74,14 @@ class EpochEntry:
 
     `pub_rows` is the (vp, 32) HOST snapshot — padded with identity rows —
     from which every device layout derives; layouts materialize lazily
-    (and upload exactly once) under the entry lock."""
+    (and upload exactly once) under the entry lock.
+
+    Donation exemption (ISSUE 7): these device arrays persist across
+    batches, so every cached kernel's donate_argnums EXCLUDES the table
+    arguments — a donated launch consumes only its per-batch buffers.
+    Uploads are span-traced (`pipeline.table_upload`) so the overlapped
+    dispatcher's transfer accounting can attribute the one-time cold-
+    epoch cost separately from steady-state H2D."""
 
     __slots__ = ("key", "n_vals", "vp", "pub_rows", "_mtx", "_dev")
 
@@ -105,7 +116,9 @@ class EpochEntry:
 
                 limbs = _pack_le_limbs(self.pub_rows)
                 sign = (self.pub_rows[:, 31] >> 7).astype(np.int32)
-                t = (jax.device_put(limbs), jax.device_put(sign))
+                with _span("pipeline.table_upload", layout="xla",
+                           vp=self.vp):
+                    t = (jax.device_put(limbs), jax.device_put(sign))
                 self._dev["xla"] = t
             return t
 
@@ -121,12 +134,14 @@ class EpochEntry:
             if t is None:
                 import jax
 
-                coords, ok = _coords_fn()(
-                    np.ascontiguousarray(self.pub_rows.T)
-                )
-                # block until materialized so the first cached dispatch
-                # is not racing the table build
-                coords.block_until_ready()
+                with _span("pipeline.table_upload", layout="coords",
+                           vp=self.vp):
+                    coords, ok = _coords_fn()(
+                        np.ascontiguousarray(self.pub_rows.T)
+                    )
+                    # block until materialized so the first cached
+                    # dispatch is not racing the table build
+                    coords.block_until_ready()
                 t = (coords, ok)
                 self._dev["coords"] = t
             return t

@@ -348,8 +348,11 @@ def plan_bucket(n: int, block: int = 0) -> tuple:
 
 @functools.lru_cache(maxsize=None)
 def _jitted_rlc_verify(g: int, block: int, interpret: bool,
-                       vma: frozenset | None = None):
-    """g lanes (g*M signatures), block lanes per kernel invocation."""
+                       vma: frozenset | None = None,
+                       donate: bool = False):
+    """g lanes (g*M signatures), block lanes per kernel invocation.
+    donate=True donates the per-batch inputs (ISSUE 7; see
+    ed25519_verify's donation note)."""
     if g % block:
         raise ValueError(
             f"lane count {g} not a multiple of block {block} (size buckets "
@@ -410,12 +413,15 @@ def _jitted_rlc_verify(g: int, block: int, interpret: bool,
         tbl = k2(coords)
         return k3(tbl, dig, coords, ok, sok_t)
 
+    if donate:
+        return jax.jit(pipeline, donate_argnums=(0, 1, 2, 3))
     return jax.jit(pipeline)
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_rlc_verify_cached(g: int, block: int, vp: int, interpret: bool,
-                              vma: frozenset | None = None):
+                              vma: frozenset | None = None,
+                              donate: bool = False):
     """The epoch-cached RLC pipeline: gathers the committee's
     decompressed coords from the persistent (4*32, vp) device table,
     rearranges them (and the raw row-major per-sig inputs) into the
@@ -491,13 +497,17 @@ def _jitted_rlc_verify_cached(g: int, block: int, vp: int, interpret: bool,
         tbl = k2(coords)
         return k3(tbl, dig, coords, ok, sok_t)
 
+    if donate:
+        # persistent epoch tables (argnums 0-1) are never donated
+        return jax.jit(pipeline, donate_argnums=(2, 3, 4, 5))
     return jax.jit(pipeline)
 
 
-def rlc_cached_fn(ep, g: int, block: int, interpret: bool):
+def rlc_cached_fn(ep, g: int, block: int, interpret: bool,
+                  donate: bool = False):
     """Kernel closure for the warm-epoch RLC pipeline; coords tables
     resolve at CALL time on the dispatch-owner thread."""
-    f = _jitted_rlc_verify_cached(g, block, ep.vp, interpret)
+    f = _jitted_rlc_verify_cached(g, block, ep.vp, interpret, donate=donate)
 
     def call(*args):
         coords_tbl, ok_tbl = ep.coords_tables()

@@ -372,7 +372,8 @@ def _k3_ladder_kernel(tbl_ref, sdig_ref, kdig_ref, coords_ref, ok_ref, sok_ref, 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_pallas_verify(n: int, block: int, interpret: bool,
-                          vma: frozenset | None = None):
+                          vma: frozenset | None = None,
+                          donate: bool = False):
     """Three chained pallas_calls (single-kernel fusion SIGABRTs Mosaic;
     see the kernel docstrings). Intermediates live in HBM between kernels
     — ~3 MB/block, negligible next to the in-kernel work. K2's block is
@@ -381,7 +382,10 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool,
 
     vma: varying-mesh-axes annotation for the kernel outputs — required
     when the pipeline runs inside a checked shard_map (ops.sharded), where
-    every output must declare which mesh axes it varies over."""
+    every output must declare which mesh axes it varies over.
+
+    donate: donate the per-batch input buffers to XLA so launches recycle
+    their pages (ISSUE 7; see ed25519_verify's donation note)."""
     k2_block = min(block, 256)
 
     def mkspec(b):
@@ -431,13 +435,16 @@ def _jitted_pallas_verify(n: int, block: int, interpret: bool,
         tbl = k2(coords)
         return k3(tbl, sdig, kdig, coords, ok, sok_t)
 
+    if donate:
+        return jax.jit(pipeline, donate_argnums=(0, 1, 2, 3, 4))
     return jax.jit(pipeline)
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_pallas_verify_cached(n: int, block: int, vp: int,
                                  interpret: bool,
-                                 vma: frozenset | None = None):
+                                 vma: frozenset | None = None,
+                                 donate: bool = False):
     """The epoch-cached 3-kernel pipeline: the jitted program GATHERS the
     committee's decompressed coordinates from the persistent device table
     ((4*32, vp) int32 + (1, vp) ok) and transposes the raw per-sig rows
@@ -494,6 +501,10 @@ def _jitted_pallas_verify_cached(n: int, block: int, vp: int,
         tbl = k2(coords)
         return k3(tbl, sdig, kdig, coords, ok, sok_t)
 
+    if donate:
+        # the persistent coords/ok epoch tables (argnums 0-1) are shared
+        # across batches — never donated
+        return jax.jit(pipeline, donate_argnums=(2, 3, 4, 5, 6))
     return jax.jit(pipeline)
 
 
@@ -515,11 +526,13 @@ def prepare_compact_cached(entries, bucket: int, ep):
     )
 
 
-def cached_compact_fn(ep, n: int, block: int, interpret: bool):
+def cached_compact_fn(ep, n: int, block: int, interpret: bool,
+                      donate: bool = False):
     """Kernel closure for the warm-epoch compact pipeline; the epoch's
     coords tables resolve at CALL time (dispatch-owner thread — the only
     thread allowed to issue the one-time upload)."""
-    f = _jitted_pallas_verify_cached(n, block, ep.vp, interpret)
+    f = _jitted_pallas_verify_cached(n, block, ep.vp, interpret,
+                                     donate=donate)
 
     def call(*args):
         coords_tbl, ok_tbl = ep.coords_tables()

@@ -18,6 +18,9 @@ Consumers:
 
 from __future__ import annotations
 
+import functools
+import logging
+import os
 import queue
 import threading
 import time
@@ -29,10 +32,58 @@ import numpy as np
 from ..observability import trace as _trace
 from ..types.validation import ErrNotEnoughVotingPowerSigned
 from . import backend as _backend
+from . import device_pool as _dpool
 from . import ed25519_verify as _kernel
 from .entry_block import EntryBlock, as_block
 
 _span = _trace.span
+
+_log = logging.getLogger("tendermint_tpu.ops.pipeline")
+
+
+@functools.lru_cache(maxsize=1)
+def _d2h_async_supported() -> bool:
+    """One-time capability probe (ISSUE 7 satellite): do this backend's
+    device arrays support copy_to_host_async()? Probed once at engine
+    init and logged — the old code wrapped every batch's call in a bare
+    `except AttributeError: pass`, so a missing capability silently cost
+    a full relay RTT per batch with nothing in the logs."""
+    import jax
+
+    try:
+        arr = jax.device_put(np.zeros(1, dtype=np.uint8))
+        supported = callable(getattr(arr, "copy_to_host_async", None))
+    except Exception as e:  # noqa: BLE001 — probe must never kill init
+        _log.warning("copy_to_host_async capability probe failed: %r", e)
+        return False
+    if supported:
+        _log.debug("device arrays support copy_to_host_async; verdict "
+                   "readback overlaps compute")
+    else:
+        _log.warning(
+            "device arrays lack copy_to_host_async(); verdict readback "
+            "will block on materialization (one extra relay RTT per batch)"
+        )
+    return supported
+
+
+class _Readback:
+    """Structured async verdict readback (ISSUE 7 tentpole piece 4): the
+    launched device result plus its D2H copy, started at construction
+    when the backend supports it — so the copy rides behind the batches
+    still computing. The resolver drains it via wait(); the depth
+    semaphore keeps bounding launched-but-unresolved batches exactly as
+    before."""
+
+    __slots__ = ("dev",)
+
+    def __init__(self, dev, start_async: bool):
+        self.dev = dev
+        if start_async:
+            dev.copy_to_host_async()
+
+    def wait(self) -> np.ndarray:
+        return np.asarray(self.dev)
 
 
 class DispatchError(RuntimeError):
@@ -85,10 +136,19 @@ class AsyncBatchVerifier:
 
     `depth` bounds launched-but-unresolved batches (device memory;
     2 = classic double buffering) via a semaphore between dispatcher and
-    resolver."""
+    resolver. `pool_depth` (default depth + 1, env TM_TPU_POOL_DEPTH)
+    bounds transferred-but-unresolved input-buffer sets per compiled
+    layout (ops/device_pool.py) — one deeper than the launch bound so
+    batch k+1's H2D copy can issue while the pipeline is full."""
 
-    def __init__(self, depth: int = 3):
+    def __init__(self, depth: int = 3, pool_depth: Optional[int] = None):
         self._depth = max(depth, 1)
+        if pool_depth is None:
+            pool_depth = int(
+                os.environ.get("TM_TPU_POOL_DEPTH", self._depth + 1)
+            )
+        self._pool = _dpool.DeviceBufferPool(pool_depth)
+        self._d2h_async = _d2h_async_supported()
         self._q: "queue.Queue[_Job]" = queue.Queue()
         # (spans, prep_future, t_enqueue, ready_box) | None sentinel
         self._dispatch_q: "queue.Queue" = queue.Queue()
@@ -179,6 +239,10 @@ class AsyncBatchVerifier:
         # by ValidatorSet.hash()) — prep ships only per-signature data
         # and the kernels gather cached A columns on device
         ep = _epoch.lookup(entries)
+        # donation (ISSUE 7): launches consume their per-batch inputs so
+        # XLA recycles the pages; epoch tables stay exempt in every
+        # kernel's donate_argnums
+        donate = _backend.donate_enabled()
         if _backend._use_pallas():
             import jax
 
@@ -196,10 +260,14 @@ class AsyncBatchVerifier:
                         args = pallas_rlc.prepare_rlc_cached(
                             entries, bucket, ep
                         )
-                        f = pallas_rlc.rlc_cached_fn(ep, g, block, interpret)
+                        f = pallas_rlc.rlc_cached_fn(
+                            ep, g, block, interpret, donate
+                        )
                     else:
                         args = pallas_rlc.prepare_rlc(entries, bucket)
-                        f = pallas_rlc._jitted_rlc_verify(g, block, interpret)
+                        f = pallas_rlc._jitted_rlc_verify(
+                            g, block, interpret, donate=donate
+                        )
                 _backend._note_device_batch(
                     len(entries), bucket, prep_s=time.perf_counter() - t0
                 )
@@ -214,12 +282,12 @@ class AsyncBatchVerifier:
                         entries, bucket, ep
                     )
                     f = pallas_verify.cached_compact_fn(
-                        ep, bucket, blk, interpret
+                        ep, bucket, blk, interpret, donate
                     )
                 else:
                     args = pallas_verify.prepare_compact(entries, bucket)
                     f = pallas_verify._jitted_pallas_verify(
-                        bucket, blk, interpret
+                        bucket, blk, interpret, donate=donate
                     )
             _backend._note_device_batch(
                 len(entries), bucket, prep_s=time.perf_counter() - t0
@@ -235,7 +303,7 @@ class AsyncBatchVerifier:
         with _span("pipeline.prep", n=len(entries), bucket=bucket,
                    cached=int(ep is not None)):
             if ep is not None:
-                kern = _backend.cached_kernel(ep, device_hash)
+                kern = _backend.cached_kernel(ep, device_hash, donate)
                 if device_hash:
                     args = _backend.prepare_batch_cached_device_hash(
                         entries, bucket, ep
@@ -244,10 +312,10 @@ class AsyncBatchVerifier:
                     args = _backend.prepare_batch_cached(entries, bucket, ep)
             elif device_hash:
                 args = _backend.prepare_batch_device_hash(entries, bucket)
-                kern = _kernel.jitted_verify_device_hash()
+                kern = _kernel.jitted_verify_device_hash(donate)
             else:
                 args = _backend.prepare_batch(entries, bucket)
-                kern = _kernel.jitted_verify()
+                kern = _kernel.jitted_verify(donate)
         _backend._note_device_batch(len(entries), bucket)
         return kern, args, None, bucket
 
@@ -258,18 +326,24 @@ class AsyncBatchVerifier:
         race the done-callback machinery."""
         return cls._prepare(entries), time.perf_counter()
 
-    def _dispatch(self, entries):
-        """Synchronous prep + async device dispatch (kept for callers and
-        tests that bypass the worker's prep pool)."""
-        f, args, rlc_entries, _bucket = self._prepare(entries)
-        return f(*args), rlc_entries
-
     @staticmethod
     def _resolve(spans, dev, rlc_entries=None, t_dispatch: float = 0.0,
                  bucket: int = 0) -> None:
         try:
             with _span("pipeline.device_wait"):
-                arr = np.asarray(dev)
+                # dev is a _Readback from the dispatcher (async D2H copy
+                # already in flight) or a bare device array from direct
+                # callers — both materialize here
+                arr = dev.wait() if isinstance(dev, _Readback) else np.asarray(dev)
+            if not arr.flags.owndata:
+                # np.asarray of a device array is a zero-copy VIEW of the
+                # XLA output buffer on the CPU backend. Under donation the
+                # output aliases a donated input page, and once the jax
+                # handles drop that page is recycled and overwritten by a
+                # later batch — mutating verdicts already delivered to
+                # callers. Futures must resolve to host-OWNED memory; the
+                # verdict row is ≤ bucket bytes, so the copy is free.
+                arr = np.array(arr, copy=True)
             if t_dispatch:
                 # dispatch-to-materialized: the device+transfer time this
                 # batch actually cost the pipeline
@@ -395,43 +469,54 @@ class AsyncBatchVerifier:
             prep_pool.shutdown(wait=False)
 
     def _dispatcher(self) -> None:
-        """The dispatch-owner: the ONLY thread that launches kernels (and
-        with them the host->device transfers). Prepared batches arrive
-        FIFO; the `pipeline.queue_wait` span records prepared-to-launched
-        time (including depth backpressure) so span_summary separates
-        queue-wait from relay time (`pipeline.dispatch`)."""
+        """The dispatch-owner: the ONLY thread that touches the relay —
+        it issues the host->device transfers AND launches the kernels,
+        interleaved as two stages of one loop (ISSUE 7 tentpole): batch
+        k+1's `device_put` is issued BEFORE blocking on the depth
+        semaphore, so its H2D copy rides behind kernel k's compute
+        instead of serializing in front of its own launch. Timeline at
+        steady state:
+
+            transfer k+1  ||  kernel k  ||  readback k-1 (resolver)
+
+        Prepared batches arrive FIFO; `pipeline.transfer` records the
+        copy issue (with hidden=1 when a kernel was in flight — the
+        transfer_overlap_ratio source) and `pipeline.queue_wait` now
+        records PURE depth backpressure (transferred-to-launched), so
+        span_summary separates wait from relay time (`pipeline.dispatch`).
+        The buffer pool bounds transferred-but-unresolved input sets and
+        counts recycled vs minted slots."""
         m = _backend._ops_m()
-        # occupancy is WINDOWED (reset every ~2s): a cumulative-since-
-        # start average would read near zero forever after a long idle
-        # stretch, hiding relay saturation from /status
-        win_start = time.perf_counter()
-        win_busy = 0.0
+        # occupancy/overlap are WINDOWED (reset every ~2s): a cumulative-
+        # since-start average would read near zero forever after a long
+        # idle stretch, hiding relay saturation from /status
+        busy = _dpool.WindowedRatio(m.dispatch_busy_ratio, wall=True)
+        overlap = _dpool.WindowedRatio(m.transfer_overlap_ratio, wall=False)
         while True:
             try:
                 item = self._dispatch_q.get(timeout=2.0)
             except queue.Empty:
-                # idle tick: decay the occupancy window so the gauge
-                # reads ~0 when no traffic flows instead of sticking at
-                # the last busy value
-                now = time.perf_counter()
-                elapsed = now - win_start
-                if elapsed >= 2.0:
-                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
-                    win_start, win_busy = now, 0.0
+                # idle tick: decay both windows so the gauges read ~0
+                # when no traffic flows instead of sticking at the last
+                # busy/overlap value
+                busy.tick()
+                overlap.tick()
                 continue
             if item is None:
                 self._resolve_q.put(None)
                 break
             spans, fut, t_enq = item
             # Dispatcher survival invariant: NOTHING a single batch does —
-            # prep failure, metrics accounting, epoch-table upload inside
-            # the kernel closure, the launch itself — may kill or wedge
-            # this thread. A poisoned batch fails ONLY its own futures
-            # (wrapped in DispatchError with epoch/bucket context) and the
-            # loop moves to the next item with the depth semaphore intact
-            # (sem_held tracks the permit so even the last-resort handler
-            # cannot leak a depth slot).
+            # prep failure, metrics accounting, the transfer, epoch-table
+            # upload inside the kernel closure, the launch itself — may
+            # kill or wedge this thread. A poisoned batch fails ONLY its
+            # own futures (wrapped in DispatchError with epoch/bucket
+            # context) and the loop moves to the next item with the depth
+            # semaphore AND its pool slot intact (sem_held/slot track
+            # both so even the last-resort handler leaks neither).
             sem_held = False
+            slot = None
+            bucket = 0
             try:
                 m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 try:
@@ -449,33 +534,61 @@ class AsyncBatchVerifier:
                     )
                 except Exception:  # noqa: BLE001 — accounting never fatal
                     pass
+                self.dispatch_thread_idents.add(threading.get_ident())
+                # -- stage 1: transfer (before the depth block) ----------
+                try:
+                    slot = self._pool.acquire(
+                        _dpool.layout_key(bucket, args),
+                        abort=self._stopped.is_set,
+                    )
+                    hidden = self._inflight > 0
+                    t_x0 = time.perf_counter()
+                    dev_args = _dpool.transfer(args)
+                    t_x1 = time.perf_counter()
+                    if slot is not None:
+                        slot.arrays = dev_args
+                    if _trace.TRACER.enabled:
+                        _trace.TRACER.record(
+                            "pipeline.transfer", t_x0, t_x1,
+                            {"bucket": bucket, "hidden": int(hidden)},
+                        )
+                    overlap.add(t_x1 - t_x0 if hidden else 0.0, t_x1 - t_x0)
+                    busy.add(t_x1 - t_x0)
+                except Exception as e:  # noqa: BLE001
+                    self._pool.release(slot)
+                    slot = None
+                    self._fail_spans(spans, self._wrap_dispatch_err(
+                        "batch transfer failed", e, bucket, spans))
+                    continue
+                # -- stage 2: launch (behind the depth semaphore) --------
+                t_xfer_done = time.perf_counter()
                 self._sem.acquire()  # depth: launched-but-unresolved bound
                 sem_held = True
                 t0 = time.perf_counter()
                 if _trace.TRACER.enabled:
                     _trace.TRACER.record(
-                        "pipeline.queue_wait", max(t_enq, t_ready), t0,
+                        "pipeline.queue_wait",
+                        max(t_enq, t_ready, t_xfer_done), t0,
                         {"bucket": bucket},
                     )
-                self.dispatch_thread_idents.add(threading.get_ident())
                 try:
                     with _span("pipeline.dispatch", bucket=bucket):
-                        dev = f(*args)
+                        dev = f(*dev_args)
                     # start the device->host copy NOW: a blocking fetch
-                    # through the relay costs a full ~65ms RTT, but an async
-                    # copy rides behind the compute, so the later np.asarray
-                    # in _resolve returns in microseconds (measured:
-                    # sustained 152k -> 286k sigs/s)
-                    try:
-                        dev.copy_to_host_async()
-                    except AttributeError:
-                        pass
+                    # through the relay costs a full RTT (~65 ms, PERF_r05),
+                    # but an async copy rides behind the compute so the
+                    # later wait() in _resolve finds the bytes already
+                    # host-side. Capability probed ONCE at init
+                    # (_d2h_async_supported) — no silent per-batch except.
+                    rb = _Readback(dev, self._d2h_async)
                 except Exception as e:  # noqa: BLE001
                     # epoch-table upload (lazy, inside the cached-kernel
                     # closure) or the launch itself blew up: release the
-                    # depth slot and fail this batch alone, with context
+                    # depth slot + buffer slot and fail this batch alone
                     self._sem.release()
                     sem_held = False
+                    self._pool.release(slot)
+                    slot = None
                     self._fail_spans(spans, self._wrap_dispatch_err(
                         "kernel dispatch failed", e, bucket, spans))
                     continue
@@ -483,22 +596,18 @@ class AsyncBatchVerifier:
                     self._inflight += 1
                     m.pipeline_inflight.set(self._inflight)
                 now = time.perf_counter()
-                win_busy += now - t0
-                elapsed = now - win_start
-                if elapsed >= 2.0:
-                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
-                    win_start, win_busy = now, 0.0
-                elif elapsed > 0:
-                    m.dispatch_busy_ratio.set(min(win_busy / elapsed, 1.0))
+                busy.add(now - t0)
                 self._resolve_q.put(
-                    (spans, dev, rlc_entries, now, bucket)
+                    (spans, rb, rlc_entries, now, bucket, slot)
                 )
                 sem_held = False  # resolver now owns the release
+                slot = None       # (semaphore and pool slot both)
             except Exception as e:  # noqa: BLE001 — last-resort isolation
                 if sem_held:
                     self._sem.release()
+                self._pool.release(slot)
                 self._fail_spans(spans, self._wrap_dispatch_err(
-                    "dispatch bookkeeping failed", e, 0, spans))
+                    "dispatch bookkeeping failed", e, bucket, spans))
 
     @staticmethod
     def _wrap_dispatch_err(msg, e, bucket, spans) -> "DispatchError":
@@ -519,15 +628,19 @@ class AsyncBatchVerifier:
 
     def _resolver(self) -> None:
         """Completes futures: blocks on device materialization so neither
-        the coalescer nor the dispatch-owner ever waits on a result."""
+        the coalescer nor the dispatch-owner ever waits on a result. Also
+        returns each batch's buffer-pool slot — the input buffers' flight
+        ends when the verdicts are read back (or the batch fails)."""
         m = _backend._ops_m()
         while True:
             item = self._resolve_q.get()
             if item is None:
                 break
+            spans, rb, rlc_entries, t_dispatch, bucket, slot = item
             try:
-                self._resolve(*item)
+                self._resolve(spans, rb, rlc_entries, t_dispatch, bucket)
             finally:
+                self._pool.release(slot)
                 with self._mtx:
                     self._inflight -= 1
                     m.pipeline_inflight.set(self._inflight)

@@ -132,7 +132,10 @@ def verify_commit_sharded(
     fn, _ = _jitted_for(mesh)
     with _span("sharded.device", n=n, bucket=bucket):
         valid, lanes, all_valid = fn(*args, pw, live)
-        valid = np.asarray(valid)
+        # np.array, not asarray: on the CPU backend the latter is a
+        # zero-copy view of the XLA output buffer, and with donation on
+        # a later launch can recycle that page under the caller's slice
+        valid = np.array(valid)
     return (
         valid[:n],
         join_power(lanes),
@@ -197,10 +200,16 @@ def _commit_step_cached(tbl_limbs, tbl_sign, idx, r_enc, s_enc, k_enc,
     return valid, lanes, all_valid
 
 
-def sharded_commit_verifier_cached(mesh: Mesh):
+def sharded_commit_verifier_cached(mesh: Mesh, donate: bool = False):
     """Jitted mesh-sharded commit verification over a device-resident
     epoch table: tables replicated (P(None, ...)), per-signature inputs
-    sharded on the batch axis."""
+    sharded on the batch axis.
+
+    donate=True donates ONLY the per-signature batch args (argnums 2+,
+    fresh host arrays every call) — the replicated epoch tables (argnums
+    0-1) live in _shard_tbl_cache across calls and donating them would
+    invalidate every later call's table reference (ISSUE 7: the
+    donation-safety rule under the replicated-table path)."""
     from jax import shard_map
 
     fn = shard_map(
@@ -213,6 +222,8 @@ def sharded_commit_verifier_cached(mesh: Mesh):
         ),
         out_specs=(P(AXIS), P(), P()),
     )
+    if donate:
+        return jax.jit(fn, donate_argnums=tuple(range(2, 9)))
     return jax.jit(fn)
 
 
@@ -244,12 +255,16 @@ def verify_commit_sharded_cached(
         pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
         pw[:n] = split_power(np.asarray(powers[:n]))
     tbl = epoch_tables_sharded(ep, mesh)
-    key = ("cached", tuple(d.id for d in mesh.devices.flat))
+    donate = _backend.donate_enabled()
+    key = ("cached", tuple(d.id for d in mesh.devices.flat), donate)
     if key not in _mesh_cache:
-        _mesh_cache[key] = sharded_commit_verifier_cached(mesh)
+        _mesh_cache[key] = sharded_commit_verifier_cached(mesh, donate)
     with _span("sharded.device", n=n, bucket=bucket, cached=1):
         valid, lanes, all_valid = _mesh_cache[key](*tbl, *args, pw, live)
-        valid = np.asarray(valid)
+        # np.array, not asarray: on the CPU backend the latter is a
+        # zero-copy view of the XLA output buffer, and with donation on
+        # a later launch can recycle that page under the caller's slice
+        valid = np.array(valid)
     return (
         valid[:n],
         join_power(lanes),
@@ -351,7 +366,10 @@ def verify_commit_sharded_pallas(
         valid, lanes, all_valid = _mesh_cache[key](
             a_t, r_t, s_t, k_t, sok_t, pw, live
         )
-        valid = np.asarray(valid)
+        # np.array, not asarray: on the CPU backend the latter is a
+        # zero-copy view of the XLA output buffer, and with donation on
+        # a later launch can recycle that page under the caller's slice
+        valid = np.array(valid)
     return (
         valid[:n],
         join_power(lanes),

@@ -39,6 +39,7 @@ if "--native" not in sys.argv:
 FUSED_SPEEDUP_GATE = 1.3  # --fused: decode->kernel-args vs the PR-4 path
 TRANSFER_RATIO_GATE = 0.5  # --transfer: warm-epoch H2D vs cold-epoch H2D
 TRANSFER_SPEEDUP_GATE = 1.3  # --transfer: cached prep vs the PR-4 prep
+OVERLAP_POOL_DEPTH = 2  # --overlap: double-buffered input slots
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -296,6 +297,157 @@ def run_transfer(args) -> int:
     return rc
 
 
+def run_overlap(args) -> int:
+    """--overlap: the round-8 overlapped-relay gate, an on-CPU proxy for
+    the transfer/compute pipelining ISSUE 7 adds to the dispatcher.
+
+    The device is mocked SLOW on the readback side only (a proxy result
+    whose materialization sleeps ~150 ms — the resolver blocks exactly
+    like a relay-attached TPU's D2H wait), so the dispatcher's loop
+    structure is what decides whether batch k+1's H2D transfer is issued
+    while batch k computes. Asserts, over a stream of single-job batches
+    at depth 1:
+
+      split    every batch's `pipeline.transfer` span closes before its
+               `pipeline.dispatch` span opens (transfer split from launch)
+      overlap  transfer k+1 is issued BEFORE batch k resolves (span-order
+               check transfer[k+1].start < device_wait[k].end, and the
+               dispatcher's own hidden=1 marking agrees) — the serial
+               prep->transfer->launch->wait loop this PR removed fails
+               this deterministically
+      pool     steady-state allocations are FLAT: the buffer pool mints
+               at most OVERLAP_POOL_DEPTH slots for the whole stream
+               (misses == depth, every later acquire is a recycled hit)
+               and leaks nothing (in_flight == 0 once drained)
+      owner    transfers and launches all ran on ONE thread (the relay
+               single-owner invariant extends to the transfer stage)
+    """
+    import numpy as np
+
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import backend, pipeline as pl
+
+    n = 96
+    n_batches = 6
+    resolve_delay = 0.15
+
+    rng = np.random.RandomState(7)
+
+    def batch(tag: int):
+        # structurally-valid random entries: the overlap timing being
+        # gated does not depend on signature validity
+        return [
+            (
+                rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+                b"overlap-%d-%d" % (tag, i),
+                rng.randint(0, 256, 64, dtype=np.uint8).tobytes(),
+            )
+            for i in range(n)
+        ]
+
+    # one submitted job == one device batch (the coalescer would fuse
+    # the whole stream into a single launch otherwise); the slow-readback
+    # mock is shared with tests/test_overlap.py (ops/_testing.py)
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+
+    backend.max_coalesce = lambda: n
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(pl.AsyncBatchVerifier._prepare, resolve_delay)
+    )
+
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    v = pl.AsyncBatchVerifier(depth=1, pool_depth=OVERLAP_POOL_DEPTH)
+    try:
+        v.submit(batch(99)).result(timeout=600)  # warm: compile the shape
+        futs = [v.submit(batch(t)) for t in range(n_batches)]
+        for f in futs:
+            f.result(timeout=600)
+        # the resolver completes futures BEFORE releasing the slot —
+        # drain so the leak check does not race the last release
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+    finally:
+        tr.configure(enabled=False)
+        v.close()
+
+    evs = {"pipeline.transfer": [], "pipeline.dispatch": [],
+           "pipeline.device_wait": []}
+    tids = set()
+    for name, start, end, tid, sargs in tr.TRACER.events():
+        if name in evs:
+            evs[name].append((start, end, sargs or {}))
+        if name in ("pipeline.transfer", "pipeline.dispatch"):
+            tids.add(tid)
+    for k in evs:
+        evs[k].sort()
+    xfers = evs["pipeline.transfer"][1:]        # drop the warmup batch
+    dispatches = evs["pipeline.dispatch"][1:]
+    waits = evs["pipeline.device_wait"][1:]
+
+    print(
+        f"prep_bench --overlap: n={n} batches={n_batches} depth=1 "
+        f"pool_depth={OVERLAP_POOL_DEPTH} resolve_delay={resolve_delay}s"
+    )
+    rc = 0
+    if not (len(xfers) == len(dispatches) == len(waits) == n_batches):
+        print(
+            f"  FAIL: expected {n_batches} transfer/dispatch/wait span "
+            f"triples, got {len(xfers)}/{len(dispatches)}/{len(waits)}",
+            file=sys.stderr,
+        )
+        return 2
+    split_ok = all(x[1] <= d[0] for x, d in zip(xfers, dispatches))
+    overlapped = sum(
+        1 for i in range(1, n_batches) if xfers[i][0] < waits[i - 1][1]
+    )
+    hidden = sum(1 for x in xfers if x[2].get("hidden"))
+    print(f"  transfer-before-launch split : {'OK' if split_ok else 'BROKEN'}")
+    print(f"  transfer k+1 < resolve k     : {overlapped}/{n_batches - 1}")
+    print(f"  dispatcher-marked hidden     : {hidden}/{n_batches}")
+    print(f"  pool                         : {pool}")
+    print(f"  transfer+dispatch threads    : {len(tids)}")
+    if not split_ok:
+        print("  FAIL: a transfer span closed after its launch span opened",
+              file=sys.stderr)
+        rc = 1
+    if overlapped < n_batches - 2:
+        print(
+            f"  FAIL: only {overlapped}/{n_batches - 1} transfers were "
+            "issued before the previous batch resolved (dispatcher is "
+            "serial again?)",
+            file=sys.stderr,
+        )
+        rc = 1
+    if hidden < n_batches - 1:
+        print(
+            f"  FAIL: dispatcher marked only {hidden}/{n_batches} "
+            "transfers hidden behind in-flight compute",
+            file=sys.stderr,
+        )
+        rc = 1
+    if pool["minted"] > OVERLAP_POOL_DEPTH:
+        print(
+            f"  FAIL: pool minted {pool['minted']} slots for one layout "
+            f"(> depth {OVERLAP_POOL_DEPTH}) — steady-state allocations "
+            "are not flat",
+            file=sys.stderr,
+        )
+        rc = 1
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    if len(tids) != 1:
+        print(
+            f"  FAIL: transfers/launches ran on {len(tids)} threads "
+            "(single relay owner violated)",
+            file=sys.stderr,
+        )
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -318,11 +470,20 @@ def main() -> int:
         help="round-7 gate: warm-epoch H2D bytes <= 0.5x cold-epoch and "
         "cached per-signature prep >= 1.3x the PR-4 prep",
     )
+    ap.add_argument(
+        "--overlap",
+        action="store_true",
+        help="round-8 gate: dispatcher issues batch k+1's H2D transfer "
+        "before blocking on kernel k (span-order proxy with a slow mock "
+        "readback) and the buffer pool keeps steady-state allocations flat",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
     if args.transfer:
         return run_transfer(args)
+    if args.overlap:
+        return run_overlap(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline
