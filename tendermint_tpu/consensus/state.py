@@ -230,15 +230,24 @@ class ConsensusState(BaseService):
     def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
         """Block until the committed chain reaches `height` — on a
         condition signalled per commit, not a sleep-poll."""
-        deadline = _time.time() + timeout
+        # injected-clock reads (not _time.time()): under simnet the
+        # deadline must advance with VIRTUAL time or a replay would hang
+        # on machine speed (tmlint simnet-determinism). Condition.wait's
+        # timeout is REAL time though, so a monotonic deadline backstops
+        # the loop — a wedged virtual clock (remaining frozen at
+        # `timeout` forever) must still surface as TimeoutError instead
+        # of re-waiting indefinitely.
+        deadline = self._now() + timeout
+        real_deadline = _time.monotonic() + timeout
         with self._commit_cond:
             while self._state.last_block_height < height:
-                remaining = deadline - _time.time()
-                if remaining <= 0:
+                remaining = deadline - self._now()
+                real_remaining = real_deadline - _time.monotonic()
+                if remaining <= 0 or real_remaining <= 0:
                     raise TimeoutError(
                         f"height {height} not reached; at {self._state.last_block_height}"
                     )
-                self._commit_cond.wait(remaining)
+                self._commit_cond.wait(min(remaining, real_remaining))
 
     @property
     def committed_state(self) -> State:

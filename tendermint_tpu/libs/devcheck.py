@@ -1,0 +1,471 @@
+"""devcheck — runtime invariant checkers for the device pipeline (ISSUE 8).
+
+The runtime twin of tools/tmlint: where tmlint flags call SITES, devcheck
+asserts the invariants while the pipeline actually runs. Env-gated —
+``TM_TPU_DEVCHECK=1`` (or ``devcheck.enable()`` from a test) turns it on;
+off (the default) every hook is a single boolean check, no allocation, no
+locking, so production paths pay nothing.
+
+Three checkers:
+
+1. **Relay-thread assertions** — the dispatch-owner thread (the ONLY
+   thread allowed to touch the relay, PERF_r05 §2) claims ownership via
+   ``claim_relay()``; the launch/transfer/table-upload entry points call
+   ``note_relay_touch()``, which raises (and records) when any OTHER
+   thread reaches them. ``exempt()`` marks the sanctioned direct paths
+   (oversized-batch fallback, warmup) so they do not false-positive.
+
+2. **Lock-order cycle detector** — ``devcheck.lock(name)`` /
+   ``rlock(name)`` wrap the coalescer/dispatcher/resolver/metrics locks
+   when devcheck is on at CREATION time (plain ``threading.Lock`` when
+   off — zero overhead). Each acquisition records an edge held→acquired
+   in a process-wide lock-ORDER graph keyed by lock *name* (order classes,
+   not instances); the first edge that closes a cycle raises with the
+   offending path. A cycle in the order graph is a deadlock waiting for
+   the right interleaving, even if this run never hit it.
+
+3. **Write-after-resolve canary** — the resolver registers every verdict
+   array it delivers (``canary_register``) with a byte snapshot;
+   subsequent sweeps (next resolve, pool-slot release, pipeline close)
+   verify the delivered bytes are still identical. A future resolved with
+   a zero-copy view of a donated XLA buffer — the PR-7 bug — trips the
+   canary the moment a later launch recycles the page. On slot release
+   the checker also best-effort poisons the slot's device buffers
+   (backends that expose writable host views get 0xAB scribbles, making
+   any lingering alias detectable immediately; backends that do not still
+   get the byte-stability verification).
+
+Violations are recorded in a process-wide list (``violations()``) and —
+for the relay and lock checkers, where the failing stack IS the bug —
+also raised as ``DevcheckViolation`` at the offending call site. The
+canary records without raising (the mutation is detected asynchronously,
+on a thread that did nothing wrong); drive ``check()`` from tests.
+
+Test seams: ``TM_TPU_INJECT_LINTBUG=alias|owner`` re-introduces the PR-7
+readback aliasing / a resolver-thread relay touch inside ops/pipeline.py
+(mirroring simnet's ``--inject-bug``), so tier-1 proves each checker
+actually fires (tests/test_devcheck.py).
+
+Stdlib + numpy only; importable without jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+_ON = os.environ.get("TM_TPU_DEVCHECK", "") == "1"
+
+_mtx = threading.Lock()  # guards all devcheck global state below
+_violations: List[dict] = []
+_counts: Dict[str, int] = {"relay_touches": 0, "lock_acquires": 0,
+                           "canary_checks": 0, "canary_registered": 0}
+_relay_owners: Set[int] = set()
+_lock_edges: Dict[str, Set[str]] = {}
+_tls = threading.local()  # .held: list of lock names; .exempt: int depth
+
+_CANARY_RING = 64
+_canaries: "OrderedDict[int, tuple]" = OrderedDict()  # id -> (tag, arr, snap)
+
+
+class DevcheckViolation(RuntimeError):
+    """A devcheck invariant failed; the message carries the context."""
+
+
+# ---------------------------------------------------------------------------
+# enable / disable / reporting
+
+
+def enabled() -> bool:
+    return _ON
+
+
+def enable(reset: bool = False) -> None:
+    """Turn the checkers on (tests; production uses TM_TPU_DEVCHECK=1 so
+    import-time lock creation is instrumented too)."""
+    global _ON
+    if reset:
+        reset_state()
+    _ON = True
+
+
+def disable() -> None:
+    global _ON
+    _ON = False
+
+
+def reset_state() -> None:
+    with _mtx:
+        _violations.clear()
+        _relay_owners.clear()
+        _lock_edges.clear()
+        _canaries.clear()
+        for k in _counts:
+            _counts[k] = 0
+
+
+def _violate(kind: str, message: str) -> dict:
+    rec = {
+        "kind": kind,
+        "message": message,
+        "thread": threading.current_thread().name,
+    }
+    with _mtx:
+        _violations.append(rec)
+    return rec
+
+
+def violations() -> List[dict]:
+    with _mtx:
+        return list(_violations)
+
+
+def check() -> None:
+    """Raise if any violation has been recorded (test teardown hook)."""
+    v = violations()
+    if v:
+        lines = "\n".join(f"  [{r['kind']}] {r['message']} "
+                          f"(thread {r['thread']})" for r in v)
+        raise DevcheckViolation(f"{len(v)} devcheck violation(s):\n{lines}")
+
+
+def report() -> dict:
+    """JSON-embeddable snapshot (tools/simnet_run.py --devcheck)."""
+    with _mtx:
+        return {
+            "enabled": _ON,
+            "violations": list(_violations),
+            "counts": dict(_counts),
+            "lock_order_edges": int(sum(len(v) for v in _lock_edges.values())),
+        }
+
+
+def _bump(key: str) -> None:
+    with _mtx:
+        _counts[key] += 1
+
+
+# ---------------------------------------------------------------------------
+# 1) relay-thread assertions
+
+
+def claim_relay(name: str = "") -> None:
+    """The dispatch-owner thread claims the relay. Multiple verifiers may
+    each claim (one dispatcher per instance); any NON-claimed thread
+    reaching a relay entry point afterwards is a violation."""
+    if not _ON:
+        return
+    with _mtx:
+        _relay_owners.add(threading.get_ident())
+
+
+def clear_relay() -> None:
+    with _mtx:
+        _relay_owners.clear()
+
+
+def unclaim_relay(idents) -> None:
+    """Drop specific thread idents from the owner set — a closing
+    verifier retires its dispatcher's claim so (a) later standalone
+    direct use stays legal and (b) OS thread-ident reuse cannot hand a
+    dead owner's pass to an arbitrary new thread. Safe with devcheck
+    off (the set is empty)."""
+    with _mtx:
+        _relay_owners.difference_update(idents)
+
+
+class _Exempt:
+    def __enter__(self):
+        _tls.exempt = getattr(_tls, "exempt", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.exempt -= 1
+        return False
+
+
+def exempt() -> _Exempt:
+    """Context manager marking a sanctioned direct relay path (oversized
+    fallback, warmup) on the current thread."""
+    return _Exempt()
+
+
+def note_relay_touch(what: str) -> None:
+    """Assert the current thread may touch the relay. No-op until a
+    dispatcher has claimed ownership (standalone/direct use stays legal);
+    afterwards only owner threads and exempt() scopes pass."""
+    if not _ON:
+        return
+    _bump("relay_touches")
+    if getattr(_tls, "exempt", 0):
+        return
+    with _mtx:
+        owners = set(_relay_owners)
+    if not owners:
+        return
+    ident = threading.get_ident()
+    if ident not in owners:
+        rec = _violate(
+            "relay-ownership",
+            f"{what}: relay touched from thread "
+            f"{threading.current_thread().name!r} (ident {ident}) but the "
+            f"relay is owned by dispatcher ident(s) {sorted(owners)} — "
+            f"exactly ONE dispatch-owner thread may launch/transfer",
+        )
+        raise DevcheckViolation(rec["message"])
+
+
+# ---------------------------------------------------------------------------
+# 2) lock-order cycle detector
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _reaches(src: str, dst: str, edges: Dict[str, Set[str]]) -> Optional[list]:
+    """DFS path src -> dst in the order graph, or None."""
+    stack = [(src, [src])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in edges.get(node, ()):
+            stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_intent(name: str) -> Optional[list]:
+    """Record the prospective order edge BEFORE the blocking acquire and
+    return the cycle path if this edge closes one (None otherwise).
+    Intent-time recording is what lets a CONTESTED inversion be reported
+    instead of hanging: edge insertion + cycle check serialize under
+    _mtx, so of two threads deadlocking each other at first contact, the
+    second one's check must see the first one's edge and raise before
+    ever blocking."""
+    _bump("lock_acquires")
+    held = _held()
+    if not held or held[-1] == name:
+        return None
+    holder = held[-1]
+    with _mtx:
+        fwd = _lock_edges.setdefault(holder, set())
+        new_edge = name not in fwd
+        fwd.add(name)
+        return _reaches(name, holder, _lock_edges) if new_edge else None
+
+
+def _note_released(name: str) -> None:
+    held = _held()
+    # release order may differ from acquire order (handoffs); remove the
+    # most recent matching entry
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+def _redepth() -> dict:
+    d = getattr(_tls, "redepth", None)
+    if d is None:
+        d = _tls.redepth = {}
+    return d
+
+
+class DevLock:
+    """A named threading.Lock/RLock wrapper feeding the order graph.
+    Supports the full lock protocol (with-statement, Condition wrapping,
+    timeout/blocking acquire). Reentrant acquisitions of the same RLock
+    do not re-record (per-thread depth counter, so the stack pop pairs
+    with the OUTERMOST acquire).
+
+    Stack bookkeeping is deliberately NOT gated on the live _ON flag at
+    release time: a test disabling devcheck between an acquire and its
+    release must still pop the armed-time push, or the stale entry
+    manufactures phantom order edges (and false cycles) for every later
+    acquisition on that thread."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._l = threading.RLock() if reentrant else threading.Lock()
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        """The order edge is recorded (and the cycle check runs) BEFORE
+        the blocking acquire — a contested AB/BA inversion raises on one
+        of the two threads instead of wedging both with no diagnostic.
+
+        On a detected cycle: try a NON-blocking acquire first. If it
+        succeeds the violation raises with the lock HELD (what both a
+        bare acquire() caller and Condition._acquire_restore — cv.wait's
+        re-acquire, whose enclosing `with cv:` later releases — expect);
+        if the lock is contended, that IS the live deadlock, and the
+        violation raises WITHOUT the lock (hanging is the alternative).
+        The exception's `lock_held` attribute says which happened;
+        __enter__ uses it to release only what was taken."""
+        if _ON:
+            if self._reentrant and _redepth().get(self.name, 0) > 0:
+                ok = self._l.acquire(blocking, timeout)
+                if ok:
+                    _redepth()[self.name] += 1
+                return ok  # re-entry: no new order edge, no push
+            back = _note_intent(self.name)
+            if back is not None:
+                got = self._l.acquire(False)
+                rec = _violate(
+                    "lock-order",
+                    f"acquiring {self.name!r} while holding {back[-1]!r} "
+                    f"closes a cycle in the lock-order graph: "
+                    f"{' -> '.join(back)} -> {self.name} — a deadlock "
+                    f"under the right interleaving"
+                    + ("" if got else " (lock contended: a LIVE deadlock "
+                                      "was avoided; lock NOT acquired)"),
+                )
+                e = DevcheckViolation(rec["message"])
+                e.lock_held = bool(got)
+                raise e
+        ok = self._l.acquire(blocking, timeout)
+        if ok and _ON:
+            if self._reentrant:
+                _redepth()[self.name] = 1
+            _held().append(self.name)
+        return ok
+
+    def release(self) -> None:
+        if self._reentrant:
+            d = _redepth()
+            n = d.get(self.name, 0)
+            if n > 1:
+                d[self.name] = n - 1
+                self._l.release()
+                return
+            d.pop(self.name, None)
+        _note_released(self.name)  # unconditional: pairs any armed push
+        self._l.release()
+
+    def __enter__(self):
+        try:
+            self.acquire()  # tmlint: disable=lock-discipline — this IS the context manager
+        except DevcheckViolation as e:
+            # __exit__ never runs when __enter__ raises — release here
+            # (when the violation path actually took the lock) or the
+            # reported POTENTIAL deadlock becomes a real one
+            if getattr(e, "lock_held", True):
+                self._l.release()
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:  # Lock protocol completeness
+        locked = getattr(self._l, "locked", None)
+        return locked() if locked is not None else False
+
+
+def lock(name: str):
+    """A lock for `name`: instrumented when devcheck is on at creation
+    time, a plain threading.Lock otherwise (zero overhead off)."""
+    return DevLock(name) if _ON else threading.Lock()
+
+
+def rlock(name: str):
+    return DevLock(name, reentrant=True) if _ON else threading.RLock()
+
+
+# ---------------------------------------------------------------------------
+# 3) write-after-resolve canary
+
+
+def canary_register(arr, tag: str = "") -> None:
+    """Snapshot a delivered verdict array; later sweeps verify the bytes
+    never change. Ring-bounded (the last _CANARY_RING resolutions)."""
+    if not _ON or not isinstance(arr, np.ndarray):
+        return
+    snap = arr.tobytes()
+    with _mtx:
+        _counts["canary_registered"] += 1
+        _canaries[id(arr)] = (tag, arr, snap)
+        while len(_canaries) > _CANARY_RING:
+            _canaries.popitem(last=False)
+
+
+def canary_sweep(where: str) -> int:
+    """Verify every registered verdict array is byte-stable. Returns the
+    number of violations found (each registered once, then dropped).
+    Records without raising — the sweeping thread is not the culprit."""
+    if not _ON:
+        return 0
+    with _mtx:
+        items = list(_canaries.items())
+    bad = []
+    for key, (tag, arr, snap) in items:
+        _bump("canary_checks")
+        try:
+            now = arr.tobytes()
+        except Exception:  # noqa: BLE001 — a freed buffer IS the finding
+            now = None
+        if now != snap:
+            bad.append(key)
+            _violate(
+                "write-after-resolve",
+                f"verdict array ({tag}) mutated AFTER resolution "
+                f"(detected at {where}) — a future was resolved with a "
+                f"non-owning view of a recycled device buffer (the PR-7 "
+                f"donation-aliasing class); resolve with np.array/.copy()",
+            )
+    if bad:
+        with _mtx:
+            for k in bad:
+                _canaries.pop(k, None)
+    return len(bad)
+
+
+def canary_clear() -> None:
+    with _mtx:
+        _canaries.clear()
+
+
+def on_slot_release(arrays) -> None:
+    """Pool-slot return hook: sweep the canaries, then poison the slot's
+    buffers where the backend exposes writable host views (0xAB scribble)
+    so any alias still pointing at them fails the NEXT sweep loudly."""
+    if not _ON:
+        return
+    canary_sweep("pool.release")
+    if not arrays:
+        return
+    for a in arrays:
+        if isinstance(a, np.ndarray):
+            continue  # host array passthrough — may be shared, never poison
+        try:
+            v = np.asarray(a)
+            if v.flags.writeable:
+                v.fill(0xAB)
+        except Exception:  # noqa: BLE001 — poisoning is best-effort
+            pass
+
+
+# ---------------------------------------------------------------------------
+# injected-bug seams (tests only; mirrors simnet's --inject-bug pattern)
+
+
+def inject_lintbug(kind: str) -> bool:
+    """True when TM_TPU_INJECT_LINTBUG names this seam AND devcheck is
+    armed. The devcheck gate is load-bearing: the seams deliberately
+    corrupt verdicts / touch the relay cross-thread, so a stale env
+    export with the checkers off must stay inert. Read per call so tests
+    can flip it via monkeypatch.setenv without reimporting."""
+    return _ON and os.environ.get("TM_TPU_INJECT_LINTBUG", "") == kind

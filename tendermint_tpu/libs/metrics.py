@@ -19,6 +19,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import devcheck as _devcheck
+
 
 def _escape_label_value(v: str) -> str:
     """Prometheus text-format label value escaping: backslash, quote, LF."""
@@ -36,7 +38,10 @@ class _Metric:
         self.help = help_
         self.type = typ
         self._values: Dict[Tuple, float] = {}
-        self._mtx = threading.Lock()
+        # devcheck-instrumented under TM_TPU_DEVCHECK=1 (plain Lock off):
+        # metric locks sit at the BOTTOM of the lock-order graph — any
+        # acquisition of another lock while holding one is a cycle risk
+        self._mtx = _devcheck.lock("metrics.metric")
 
     def _key(self, labels: Dict[str, str]) -> Tuple:
         return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -183,7 +188,7 @@ class Registry:
         self.namespace = namespace
         self._metrics: List[_Metric] = []
         self._collect_hooks: List[Callable[[], None]] = []
-        self._mtx = threading.Lock()
+        self._mtx = _devcheck.lock("metrics.registry")
 
     def counter(self, subsystem: str, name: str, help_: str = "") -> Counter:
         m = Counter(f"{self.namespace}_{subsystem}_{name}", help_)

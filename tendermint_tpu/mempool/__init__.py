@@ -237,7 +237,10 @@ class TxMempool:
     # -- consensus integration ------------------------------------------
 
     def lock(self) -> None:
-        self._mtx.acquire()
+        # cross-method Lock/Unlock API mirroring the reference's
+        # Mempool.Lock (consensus holds it across ReapMaxBytes + Update);
+        # a with-block cannot span the two calls
+        self._mtx.acquire()  # tmlint: disable=lock-discipline — reference API shape
 
     def unlock(self) -> None:
         self._mtx.release()

@@ -53,6 +53,24 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+try:
+    from ..libs import devcheck as _devcheck
+except ImportError:  # pragma: no cover — standalone file load (tests on
+    # crypto-less containers exec this module by path, outside the
+    # package); devcheck is stdlib+numpy so it loads the same way
+    import importlib.util as _ilu
+    import os as _os
+
+    _dc_path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        _os.pardir, "libs", "devcheck.py",
+    )
+    _dc_spec = _ilu.spec_from_file_location(
+        "_tm_tpu_devcheck_standalone", _dc_path
+    )
+    _devcheck = _ilu.module_from_spec(_dc_spec)
+    _dc_spec.loader.exec_module(_devcheck)
+
 LayoutKey = Tuple
 
 
@@ -73,6 +91,9 @@ def transfer(args) -> tuple:
     device arrays in place of numpy ones. The call returns once the
     copies are *enqueued*; completion ordering against the kernel's reads
     is the runtime's job."""
+    # devcheck relay assertion (ISSUE 8): transfers are relay touches —
+    # once a dispatcher has claimed the relay, only it may issue them
+    _devcheck.note_relay_touch("device_pool.transfer")
     import jax
 
     return tuple(
@@ -103,7 +124,7 @@ class DeviceBufferPool:
 
     def __init__(self, depth: int = 3):
         self.depth = max(int(depth), 1)
-        self._mtx = threading.Lock()
+        self._mtx = _devcheck.lock("pool.slots")
         self._cv = threading.Condition(self._mtx)
         self._free: Dict[LayoutKey, List[PoolSlot]] = {}
         self._minted: Dict[LayoutKey, int] = {}
@@ -141,6 +162,12 @@ class DeviceBufferPool:
         can release unconditionally."""
         if slot is None:
             return
+        if _devcheck.enabled():
+            # write-after-resolve canary: the slot's flight is over — all
+            # previously delivered verdicts must still be byte-stable,
+            # and the returned device buffers get poisoned where the
+            # backend exposes writable host views
+            _devcheck.on_slot_release(slot.arrays)
         slot.arrays = None
         with self._cv:
             self._in_flight -= 1

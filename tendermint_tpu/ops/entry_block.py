@@ -149,7 +149,7 @@ class EntryBlock:
         per-lane re-verify, not a bulk conversion."""
         return self.pub[i].tobytes(), self.msg(i), self.sig[i].tobytes()
 
-    def iter_entries(self) -> Iterator[Entry]:
+    def iter_entries(self) -> Iterator[Entry]:  # tmlint: fallback — tuple-compat shim, blame/debug path only
         for i in range(self.n):
             yield self.entry(i)
 

@@ -52,6 +52,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..libs import devcheck as _devcheck
 from ..observability import trace as _trace
 
 _span = _trace.span
@@ -97,7 +98,7 @@ class EpochEntry:
         self.n_vals = v
         self.vp = vp
         self.pub_rows = rows
-        self._mtx = threading.Lock()
+        self._mtx = _devcheck.lock("epoch.entry")
         self._dev: dict = {}
 
     # -- device layouts (device_put ONCE per layout, lock-protected) -----
@@ -110,6 +111,9 @@ class EpochEntry:
         with self._mtx:
             t = self._dev.get("xla")
             if t is None:
+                # relay touch: table uploads run on the dispatch-owner
+                # thread (lazy, inside the kernel closure) — assert it
+                _devcheck.note_relay_touch("epoch_cache.xla_tables")
                 import jax
 
                 from .backend import _pack_le_limbs
@@ -132,6 +136,7 @@ class EpochEntry:
         with self._mtx:
             t = self._dev.get("coords")
             if t is None:
+                _devcheck.note_relay_touch("epoch_cache.coords_tables")
                 import jax
 
                 with _span("pipeline.table_upload", layout="coords",
@@ -178,7 +183,7 @@ class EpochCache:
 
     def __init__(self, depth: int):
         self.depth = depth
-        self._mtx = threading.Lock()
+        self._mtx = _devcheck.lock("epoch.lru")
         self._entries: "OrderedDict[bytes, EpochEntry]" = OrderedDict()
 
     def __len__(self) -> int:
@@ -229,7 +234,7 @@ def _ops():
 
 
 _cache: Optional[EpochCache] = None
-_cache_mtx = threading.Lock()
+_cache_mtx = _devcheck.lock("epoch.cache")
 
 
 def _depth_from_env() -> int:

@@ -42,7 +42,7 @@ def _sr_compile_timeout() -> float:
 
 
 def _host_sr_batch(entries) -> np.ndarray:
-    return np.asarray(_sr.verify_batch(list(entries)), dtype=bool)
+    return np.array(_sr.verify_batch(list(entries)), dtype=bool)
 
 
 def _sr_device_enabled() -> bool:

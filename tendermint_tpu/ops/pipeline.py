@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..libs import devcheck as _devcheck
 from ..observability import trace as _trace
 from ..types.validation import ErrNotEnoughVotingPowerSigned
 from . import backend as _backend
@@ -83,7 +84,26 @@ class _Readback:
             dev.copy_to_host_async()
 
     def wait(self) -> np.ndarray:
-        return np.asarray(self.dev)
+        # _resolve applies the owndata guard (copies before delivery);
+        # wait() itself hands back the raw materialization
+        return np.asarray(self.dev)  # tmlint: disable=donation-aliasing — consumer copies
+
+
+_alias_scratch: dict = {}
+
+
+def _alias_view(arr: np.ndarray) -> np.ndarray:
+    """TM_TPU_INJECT_LINTBUG=alias (test seam, ISSUE 8): re-introduce the
+    PR-7 readback-aliasing bug DETERMINISTICALLY on any backend — the
+    verdict is delivered as a view of one per-shape scratch buffer that
+    the next batch's resolve overwrites, exactly the recycled-donated-
+    page mechanics devcheck's write-after-resolve canary must catch."""
+    key = (arr.shape, str(arr.dtype))
+    buf = _alias_scratch.get(key)
+    if buf is None:
+        buf = _alias_scratch[key] = np.empty_like(arr)
+    np.copyto(buf, arr)
+    return buf[:]  # non-owning view of the shared scratch
 
 
 class DispatchError(RuntimeError):
@@ -155,7 +175,7 @@ class AsyncBatchVerifier:
         self._resolve_q: "queue.Queue" = queue.Queue()
         self._stopped = threading.Event()
         self._sem = threading.Semaphore(self._depth)
-        self._mtx = threading.Lock()
+        self._mtx = _devcheck.lock("pipeline.inflight")
         self._inflight = 0
         # thread idents that ever launched a kernel — asserted single-
         # element by tests/test_commit_block.py::TestDispatchOwnerThread
@@ -219,6 +239,12 @@ class AsyncBatchVerifier:
         self._thread.join(timeout=5)
         self._dispatch_thread.join(timeout=5)
         self._resolve_thread.join(timeout=5)
+        # retire this verifier's relay claim (no-op set op when devcheck
+        # never armed) — stale idents would outlaw later direct use and
+        # can be recycled by the OS onto unrelated threads
+        _devcheck.unclaim_relay(self.dispatch_thread_idents)
+        if _devcheck.enabled():
+            _devcheck.canary_sweep("pipeline.close")
 
     # -- worker ----------------------------------------------------------
 
@@ -357,6 +383,19 @@ class AsyncBatchVerifier:
                 from . import pallas_rlc
 
                 arr = pallas_rlc.expand_lanes(arr, rlc_entries)
+            if _devcheck.inject_lintbug("alias"):
+                # AFTER the 2-D/RLC reductions (they mint fresh owned
+                # arrays that would neutralize the seam): the DELIVERED
+                # verdict becomes the recycled-scratch view
+                arr = _alias_view(arr)
+            if _devcheck.enabled():
+                # canary: earlier batches' delivered verdicts must still
+                # be byte-stable now; this batch's verdict row registers
+                # for the NEXT sweep (resolve / slot release / close)
+                _devcheck.canary_sweep("pipeline.resolve")
+                _devcheck.canary_register(
+                    arr, tag=f"bucket={bucket or arr.shape[-1]}"
+                )
         except Exception as e:  # noqa: BLE001
             for job, _, _ in spans:
                 job.future.set_exception(e)
@@ -535,6 +574,10 @@ class AsyncBatchVerifier:
                 except Exception:  # noqa: BLE001 — accounting never fatal
                     pass
                 self.dispatch_thread_idents.add(threading.get_ident())
+                # devcheck relay ownership (ISSUE 8): this thread claims
+                # the relay; any transfer/upload from another thread now
+                # asserts (no-op when TM_TPU_DEVCHECK is off)
+                _devcheck.claim_relay("verify-dispatch")
                 # -- stage 1: transfer (before the depth block) ----------
                 try:
                     slot = self._pool.acquire(
@@ -637,6 +680,13 @@ class AsyncBatchVerifier:
             if item is None:
                 break
             spans, rb, rlc_entries, t_dispatch, bucket, slot = item
+            if _devcheck.inject_lintbug("owner"):
+                # test seam (ISSUE 8): touch the relay from the resolver
+                # thread — devcheck's ownership assertion must fire
+                try:
+                    _dpool.transfer((np.zeros(1, dtype=np.uint8),))
+                except _devcheck.DevcheckViolation:
+                    pass  # recorded; the injected run continues
             try:
                 self._resolve(spans, rb, rlc_entries, t_dispatch, bucket)
             finally:

@@ -25,9 +25,27 @@ except ModuleNotFoundError:
         allow_module_level=True,
     )
 
+from tendermint_tpu.libs import devcheck
 from tendermint_tpu.observability import trace as _tr
 from tendermint_tpu.ops import backend, device_pool, epoch_cache
 from tendermint_tpu.ops import ed25519_verify as ev
+
+
+@pytest.fixture(autouse=True)
+def _devcheck_armed():
+    """ISSUE 8: the overlap suite runs with the runtime invariant
+    checkers on (relay assertions, lock-order cycles, write-after-
+    resolve canary); a violation fails the offending test at teardown.
+    Direct kernel launches by parity tests stay legal — the relay
+    assertion only gates transfer/table-upload entry points once a
+    dispatcher has claimed ownership."""
+    devcheck.enable(reset=True)
+    yield
+    try:
+        devcheck.check()
+    finally:
+        devcheck.reset_state()
+        devcheck.disable()
 from tendermint_tpu.ops import pipeline as pl
 from tendermint_tpu.ops._testing import drain_pool, slow_prepare
 from tendermint_tpu.ops.entry_block import EntryBlock

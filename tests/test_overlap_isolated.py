@@ -240,6 +240,11 @@ def test_overlap_under_purepy_fallback():
     except ModuleNotFoundError:
         pass
     here = os.path.dirname(os.path.abspath(__file__))
+    # TM_TPU_DEVCHECK=1 at process start (ISSUE 8): import-time lock
+    # creation (metrics registries, epoch cache) is instrumented too, so
+    # the overlap suite's autouse devcheck fixture sees the full lock-
+    # order graph, not just locks created after enable()
+    env = dict(_purepy_env(), TM_TPU_DEVCHECK="1")
     r = subprocess.run(
         [
             sys.executable, "-m", "pytest",
@@ -247,7 +252,7 @@ def test_overlap_under_purepy_fallback():
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         capture_output=True,
-        env=_purepy_env(),
+        env=env,
         cwd=_repo_root(),
         timeout=800,
     )

@@ -7,8 +7,24 @@ import numpy as np
 import pytest
 
 from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.libs import devcheck
 from tendermint_tpu.ops import pipeline as pl
 from tests.test_types import CHAIN_ID, build_commit, make_validators
+
+
+@pytest.fixture(autouse=True)
+def _devcheck_armed():
+    """ISSUE 8: the whole pipeline suite runs with the runtime invariant
+    checkers on — relay-thread assertions, lock-order cycle detection,
+    and the write-after-resolve canary. Any violation fails the test
+    that caused it at teardown."""
+    devcheck.enable(reset=True)
+    yield
+    try:
+        devcheck.check()
+    finally:
+        devcheck.reset_state()
+        devcheck.disable()
 
 
 def _entries(n, tag=0, bad=()):

@@ -439,6 +439,34 @@ def test_smoke_cli_partition_heal_crash_restart():
     assert "partition" in verdict["faults"] and "crash" in verdict["faults"]
 
 
+def test_devcheck_smoke_partition_heal_clean():
+    """ISSUE 8 satellite: the 4-node partition+heal preset runs with the
+    TM_TPU_DEVCHECK runtime checkers armed (relay-thread assertions,
+    lock-order cycle detection, write-after-resolve canary, instrumented
+    from process start via --devcheck) and must come back devcheck-clean
+    — zero violations, with the lock instrumentation demonstrably live."""
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "simnet_run.py"),
+            "--preset", "partition_heal", "--height", "10", "--devcheck",
+        ],
+        capture_output=True,
+        env=_purepy_env(),
+        cwd=REPO,
+        timeout=120,
+    )
+    out = (r.stdout or b"").decode(errors="replace")
+    assert r.returncode == 0, f"devcheck smoke failed:\n{out[-3000:]}"
+    verdict = json.loads(out)
+    assert verdict["ok"] is True
+    assert verdict["height"] >= 10
+    dc = verdict["devcheck"]
+    assert dc["enabled"] is True
+    assert dc["violations"] == []
+    # the checkers must have actually been exercised, not just enabled
+    assert dc["counts"]["lock_acquires"] > 0
+
+
 # keep the importable surface honest: these names must exist without any
 # crypto wheel for the unit layer above to be tier-1-safe
 assert importlib.util.find_spec("tendermint_tpu.simnet.clock") is not None

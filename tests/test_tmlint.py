@@ -1,0 +1,595 @@
+"""tmlint framework + rule tests (ISSUE 8).
+
+Pure-AST layer: everything here runs without jax, numpy, or the crypto
+wheel — fixture snippets per rule (positive / negative / suppressed /
+baselined), suppression-comment parsing, baseline round-trip, the CLI
+exit-code contract, and THE tier-1 gate: tmlint over the real tree must
+report zero non-baselined findings.
+
+The positive fixtures double as the static half of the seeded-regression
+requirement: `PR7_ALIAS_BUG` re-introduces the exact readback-aliasing
+shape PR 7 shipped and fixed, and `SINGLE_OWNER_BUG` a relay launch
+outside the dispatcher — each pass must flag its bug class.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.tmlint import core, run_source  # noqa: E402
+from tools.tmlint.rules import ALL_RULES, RULES_BY_NAME  # noqa: E402
+
+OPS_PATH = "tendermint_tpu/ops/fake_mod.py"
+SIMNET_PATH = "tendermint_tpu/simnet/fake_mod.py"
+REACTOR_PATH = "tendermint_tpu/blocksync/fake_mod.py"
+HOT_PATH = "tendermint_tpu/ops/entry_block.py"
+
+
+def lint(src: str, path: str, rule: str = None):
+    rules = [RULES_BY_NAME[rule]] if rule else ALL_RULES
+    return run_source(textwrap.dedent(src), path, rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the seeded-regression fixtures: each checker's bug class, re-introduced
+
+
+PR7_ALIAS_BUG = """
+    import numpy as np
+
+    def _resolve(spans, dev):
+        arr = np.asarray(dev)          # zero-copy view of the XLA buffer
+        for job, off, n in spans:
+            job.future.set_result(arr[off : off + n])
+"""
+
+PR7_ALIAS_FIXED = """
+    import numpy as np
+
+    def _resolve(spans, dev):
+        arr = np.asarray(dev)
+        if not arr.flags.owndata:
+            arr = np.array(arr, copy=True)
+        for job, off, n in spans:
+            job.future.set_result(arr[off : off + n])
+"""
+
+SINGLE_OWNER_BUG = """
+    import jax
+
+    def sneaky_verify(args):
+        return jax.device_put(args)    # relay touch outside the dispatcher
+"""
+
+
+class TestSeededRegressions:
+    def test_pr7_alias_bug_is_flagged(self):
+        fs = lint(PR7_ALIAS_BUG, OPS_PATH, "donation-aliasing")
+        assert fs, "the PR-7 readback-aliasing bug class must be flagged"
+        assert "set_result" in fs[0].message.lower() or "escapes" in fs[0].message
+
+    def test_pr7_fixed_shape_is_clean(self):
+        assert not lint(PR7_ALIAS_FIXED, OPS_PATH, "donation-aliasing")
+
+    def test_single_owner_violation_is_flagged(self):
+        fs = lint(SINGLE_OWNER_BUG, REACTOR_PATH, "relay-ownership")
+        assert fs and fs[0].rule == "relay-ownership"
+
+    def test_single_owner_ok_inside_dispatcher(self):
+        assert not lint(
+            SINGLE_OWNER_BUG, "tendermint_tpu/ops/pipeline.py",
+            "relay-ownership",
+        )
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive / negative / suppressed / baselined
+
+
+class TestDonationAliasing:
+    def test_positive_return_asarray(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                return np.asarray(dev)
+        """
+        assert rules_of(lint(src, OPS_PATH)) == ["donation-aliasing"]
+
+    def test_positive_tainted_slice_append(self):
+        src = """
+            import numpy as np
+            def f(devs):
+                out = []
+                for d in devs:
+                    res = np.asarray(d)[:4]
+                    out.append(res)
+                return out
+        """
+        assert "donation-aliasing" in rules_of(lint(src, OPS_PATH))
+
+    def test_positive_annotated_assignment(self):
+        # review fix: a type annotation must not launder the taint
+        src = """
+            import numpy as np
+            def f(dev):
+                res: np.ndarray = np.asarray(dev)
+                return res
+        """
+        assert rules_of(lint(src, OPS_PATH)) == ["donation-aliasing"]
+
+    def test_positive_walrus_assignment(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                if (res := np.asarray(dev)) is not None:
+                    return res
+        """
+        assert rules_of(lint(src, OPS_PATH)) == ["donation-aliasing"]
+
+    def test_positive_tuple_assignment(self):
+        src = """
+            import numpy as np
+            def f(dev, other):
+                a, b = np.asarray(dev), other
+                return a
+        """
+        assert rules_of(lint(src, OPS_PATH)) == ["donation-aliasing"]
+
+    def test_negative_owned_copy(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                return np.asarray(dev)[:4].copy()
+        """
+        assert not lint(src, OPS_PATH, "donation-aliasing")
+
+    def test_positive_owned_init_overwritten_by_view(self):
+        # review fix: last binding per name wins — an owned init must not
+        # launder a later device-view reassignment (the PR-7 shape)
+        src = """
+            import numpy as np
+            def f(dev, n):
+                out = np.zeros(n)
+                out = np.asarray(dev)[:n]
+                return out
+        """
+        assert rules_of(lint(src, OPS_PATH)) == ["donation-aliasing"]
+
+    def test_negative_owndata_guard_pattern(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                arr = np.asarray(dev)
+                arr = np.array(arr, copy=True)
+                return arr[:3]
+        """
+        assert not lint(src, OPS_PATH, "donation-aliasing")
+
+    def test_negative_outside_ops(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                return np.asarray(dev)
+        """
+        assert not lint(src, "tendermint_tpu/light/client.py",
+                        "donation-aliasing")
+
+    def test_suppressed(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                return np.asarray(dev)  # tmlint: disable=donation-aliasing — consumer copies
+        """
+        assert not lint(src, OPS_PATH, "donation-aliasing")
+
+
+class TestRelayOwnership:
+    def test_positive_entry_points(self):
+        src = """
+            def f(backend, args):
+                k = backend.cached_kernel(None, True, True)
+                return k(*args)
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["relay-ownership"]
+
+    def test_positive_qualified_transfer(self):
+        src = """
+            def f(_dpool, args):
+                return _dpool.transfer(args)
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["relay-ownership"]
+
+    def test_negative_bare_transfer_is_not_flagged(self):
+        src = """
+            def f(conn, data):
+                return conn.transfer(data)
+        """
+        assert not lint(src, REACTOR_PATH, "relay-ownership")
+
+    def test_negative_whitelisted_module(self):
+        src = """
+            import jax
+            def f(x):
+                return jax.device_put(x)
+        """
+        assert not lint(src, "tendermint_tpu/ops/device_pool.py",
+                        "relay-ownership")
+
+    def test_suppressed_next_line_comment(self):
+        src = """
+            import jax
+            def f(x):
+                # tmlint: disable=relay-ownership — sanctioned one-off
+                return jax.device_put(x)
+        """
+        assert not lint(src, REACTOR_PATH, "relay-ownership")
+
+
+class TestSimnetDeterminism:
+    def test_positive_wall_clock(self):
+        src = """
+            import time
+            def f():
+                return time.time()
+        """
+        assert rules_of(lint(src, SIMNET_PATH)) == ["simnet-determinism"]
+
+    def test_positive_global_rng_and_entropy(self):
+        src = """
+            import os, random
+            def f():
+                return random.random() + len(os.urandom(8))
+        """
+        assert rules_of(lint(src, SIMNET_PATH)) == [
+            "simnet-determinism", "simnet-determinism"
+        ]
+
+    def test_positive_unseeded_random_instance(self):
+        src = """
+            import random
+            def f():
+                return random.Random()
+        """
+        assert lint(src, SIMNET_PATH, "simnet-determinism")
+
+    def test_negative_seeded_rng_and_injected_clock(self):
+        src = """
+            import random
+            def f(self, seed):
+                rng = random.Random(seed)
+                return rng.random() + self._now()
+        """
+        assert not lint(src, SIMNET_PATH, "simnet-determinism")
+
+    def test_positive_set_iteration(self):
+        src = """
+            def f(peers):
+                live = set(peers)
+                for p in live:
+                    p.poke()
+        """
+        assert lint(src, SIMNET_PATH, "simnet-determinism")
+
+    def test_negative_sorted_set_iteration(self):
+        src = """
+            def f(peers):
+                for p in sorted(set(peers)):
+                    p.poke()
+        """
+        assert not lint(src, SIMNET_PATH, "simnet-determinism")
+
+    def test_negative_outside_scope(self):
+        src = """
+            import time
+            def f():
+                return time.time()
+        """
+        assert not lint(src, "tendermint_tpu/rpc/fake.py",
+                        "simnet-determinism")
+
+    def test_suppressed(self):
+        src = """
+            import time
+            def f():
+                return time.time()  # tmlint: disable=simnet-determinism — wall budget only
+        """
+        assert not lint(src, SIMNET_PATH, "simnet-determinism")
+
+
+class TestHotPathPurity:
+    def test_positive_per_element_loop(self):
+        src = """
+            def f(xs, out):
+                for i in range(len(xs)):
+                    out.append(xs[i])
+        """
+        assert rules_of(lint(src, HOT_PATH)) == ["hot-path-purity"]
+
+    def test_positive_entries_loop(self):
+        src = """
+            def f(entries):
+                acc = []
+                for e in entries:
+                    acc.append(e[0])
+                return acc
+        """
+        assert lint(src, HOT_PATH, "hot-path-purity")
+
+    def test_negative_grouped_loop(self):
+        src = """
+            import numpy as np
+            def f(lens, buf):
+                groups = []
+                for length in np.unique(lens):
+                    groups.append((length, buf))
+                return groups
+        """
+        assert not lint(src, HOT_PATH, "hot-path-purity")
+
+    def test_negative_other_module(self):
+        src = """
+            def f(xs, out):
+                for i in range(len(xs)):
+                    out.append(xs[i])
+        """
+        assert not lint(src, "tendermint_tpu/ops/backend.py",
+                        "hot-path-purity")
+
+    def test_fallback_marker_covers_function(self):
+        src = """
+            def f(xs):  # tmlint: fallback — object-path composer
+                out = []
+                for i in range(len(xs)):
+                    out.append(xs[i])
+                return out
+        """
+        assert not lint(src, HOT_PATH, "hot-path-purity")
+
+
+class TestLockDiscipline:
+    def test_positive_bare_acquire(self):
+        src = """
+            def f(self):
+                self._mtx.acquire()
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["lock-discipline"]
+
+    def test_negative_semaphore_and_with(self):
+        src = """
+            def f(self):
+                self._sem.acquire()
+                with self._mtx:
+                    pass
+        """
+        assert not lint(src, REACTOR_PATH, "lock-discipline")
+
+    def test_negative_assigned_acquire_result(self):
+        src = """
+            def f(self):
+                slot = self._pool.acquire(("k",))
+                return slot
+        """
+        assert not lint(src, REACTOR_PATH, "lock-discipline")
+
+    def test_positive_lambda_thread_target(self):
+        src = """
+            import threading
+            def f():
+                t = threading.Thread(target=lambda: None)
+                t.start()
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["lock-discipline"]
+
+    def test_positive_relay_touching_thread_target(self):
+        src = """
+            import threading, jax
+            def worker(x):
+                jax.device_put(x)
+            def f():
+                threading.Thread(target=worker).start()
+        """
+        fs = lint(src, REACTOR_PATH)
+        # the worker body also trips relay-ownership; the thread-target
+        # finding is the lock-discipline one
+        assert "lock-discipline" in rules_of(fs)
+
+    def test_suppressed(self):
+        src = """
+            def f(self):
+                self._mtx.acquire()  # tmlint: disable=lock-discipline — paired API
+        """
+        assert not lint(src, REACTOR_PATH, "lock-discipline")
+
+
+# ---------------------------------------------------------------------------
+# framework mechanics
+
+
+class TestSuppressionParsing:
+    def test_multi_rule_and_justification(self):
+        sup = core.Suppressions.scan(
+            "x = 1  # tmlint: disable=a,b — because reasons\n"
+        )
+        assert sup.by_line[1] == {"a", "b"}
+
+    def test_comment_only_line_covers_next(self):
+        sup = core.Suppressions.scan(
+            "# tmlint: disable=r\nx = 1\n"
+        )
+        assert sup.suppressed("r", 1) and sup.suppressed("r", 2)
+
+    def test_disable_file(self):
+        sup = core.Suppressions.scan("# tmlint: disable-file=r\nx = 1\n")
+        assert sup.suppressed("r", 99)
+
+    def test_disable_all(self):
+        sup = core.Suppressions.scan("x = 1  # tmlint: disable=all\n")
+        assert sup.suppressed("anything", 1)
+
+    def test_unrelated_comments_ignored(self):
+        sup = core.Suppressions.scan("x = 1  # a normal comment\n")
+        assert not sup.by_line and not sup.file_wide
+
+    def test_def_line_suppression_spans_body(self):
+        src = textwrap.dedent("""
+            import numpy as np
+            def f(dev):  # tmlint: disable=donation-aliasing — whole fn
+                a = np.asarray(dev)
+                return a
+        """)
+        assert not run_source(
+            src, OPS_PATH, [RULES_BY_NAME["donation-aliasing"]]
+        )
+
+
+class TestBaseline:
+    SRC = """
+        import numpy as np
+        def f(dev):
+            return np.asarray(dev)
+    """
+
+    def _findings(self, pad=0):
+        return lint("\n" * pad + textwrap.dedent(self.SRC), OPS_PATH,
+                    "donation-aliasing")
+
+    def test_fingerprints_survive_line_drift(self):
+        a = core.fingerprint_findings(self._findings(pad=0))
+        b = core.fingerprint_findings(self._findings(pad=7))
+        assert a == b and len(a) == 1
+
+    def test_round_trip_and_gate(self, tmp_path):
+        fs = self._findings()
+        path = str(tmp_path / "BASE.json")
+        core.write_baseline(path, fs)
+        base = core.load_baseline(path)
+        new, old = core.apply_baseline(fs, base)
+        assert not new and len(old) == 1
+        # a NEW finding (different source text) is not covered
+        fs2 = lint(
+            """
+            import numpy as np
+            def g(dev):
+                return np.asarray(dev)[:2]
+            """,
+            OPS_PATH, "donation-aliasing",
+        )
+        new2, _ = core.apply_baseline(fs2, base)
+        assert len(new2) == 1
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert core.load_baseline(str(tmp_path / "nope.json")) == set()
+
+    def test_duplicate_lines_disambiguate_by_occurrence(self):
+        src = """
+            import numpy as np
+            def f(dev):
+                return np.asarray(dev)
+            def g(dev):
+                return np.asarray(dev)
+        """
+        fps = core.fingerprint_findings(lint(src, OPS_PATH,
+                                             "donation-aliasing"))
+        assert len(fps) == 2 and fps[0] != fps[1]
+
+    def test_parse_error_is_a_finding(self):
+        fs = run_source("def broken(:\n", OPS_PATH, ALL_RULES)
+        assert rules_of(fs) == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# THE gate + CLI contract
+
+
+class TestTreeGate:
+    def test_tree_has_zero_nonbaselined_findings(self):
+        """Tier-1 gate: tmlint over the real tree, with the committed
+        baseline, must be clean — a new finding fails the build."""
+        findings = core.run_paths(["tendermint_tpu"], REPO_ROOT, ALL_RULES)
+        baseline = core.load_baseline(
+            os.path.join(REPO_ROOT, "LINT_BASELINE.json")
+        )
+        new, _ = core.apply_baseline(findings, baseline)
+        assert not new, "new tmlint findings:\n" + "\n".join(
+            f"  {f!r}" for f in new
+        )
+
+    def test_baseline_has_no_stale_entries(self):
+        """The committed baseline only shrinks: every fingerprint in it
+        must still correspond to a real finding (delete fixed ones)."""
+        findings = core.run_paths(["tendermint_tpu"], REPO_ROOT, ALL_RULES)
+        live = set(core.fingerprint_findings(findings))
+        baseline = core.load_baseline(
+            os.path.join(REPO_ROOT, "LINT_BASELINE.json")
+        )
+        assert baseline <= live, f"stale baseline entries: {baseline - live}"
+
+
+class TestCLI:
+    def _run(self, *args, cwd=REPO_ROOT):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.tmlint", *args],
+            capture_output=True, text=True, cwd=cwd, timeout=120,
+        )
+
+    def test_exit_0_on_clean_tree(self):
+        r = self._run()
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_exit_1_on_finding_and_json_output(self, tmp_path):
+        mod = tmp_path / "tendermint_tpu" / "ops"
+        mod.mkdir(parents=True)
+        (mod / "bad.py").write_text(textwrap.dedent(PR7_ALIAS_BUG))
+        r = self._run("tendermint_tpu", "--root", str(tmp_path),
+                      "--no-baseline", "--json")
+        assert r.returncode == 1, r.stdout + r.stderr
+        data = json.loads(r.stdout)
+        assert not data["ok"] and data["new"]
+        assert data["new"][0]["rule"] == "donation-aliasing"
+
+    def test_exit_2_on_unknown_rule(self):
+        r = self._run("--rules", "no-such-rule")
+        assert r.returncode == 2
+
+    def test_exit_2_on_missing_path(self):
+        r = self._run("no/such/dir")
+        assert r.returncode == 2
+
+    def test_write_baseline_refuses_rule_or_path_subset(self):
+        # review fix: a subset-scoped rewrite would drop every other
+        # rule's grandfathered fingerprints
+        r = self._run("--write-baseline", "--rules", "donation-aliasing")
+        assert r.returncode == 2
+        r = self._run("tendermint_tpu/ops", "--write-baseline")
+        assert r.returncode == 2
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        mod = tmp_path / "tendermint_tpu" / "ops"
+        mod.mkdir(parents=True)
+        (mod / "bad.py").write_text(textwrap.dedent(PR7_ALIAS_BUG))
+        r1 = self._run("tendermint_tpu", "--root", str(tmp_path),
+                       "--write-baseline")
+        assert r1.returncode == 0, r1.stdout + r1.stderr
+        assert (tmp_path / "LINT_BASELINE.json").exists()
+        r2 = self._run("tendermint_tpu", "--root", str(tmp_path))
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    def test_list_rules_names_all_five(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for name in ("donation-aliasing", "relay-ownership",
+                     "simnet-determinism", "hot-path-purity",
+                     "lock-discipline"):
+            assert name in r.stdout

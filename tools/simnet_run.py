@@ -132,6 +132,20 @@ def _wall_budget(args, mode_default):
     return args.max_wall_s or None
 
 
+def _attach_devcheck(verdict: dict) -> None:
+    """Embed the runtime-checker report; any violation fails the run."""
+    from tendermint_tpu.libs import devcheck
+
+    rep = devcheck.report()
+    verdict["devcheck"] = rep
+    if rep["violations"]:
+        verdict["ok"] = False
+        verdict["reason"] = (
+            f"{len(rep['violations'])} devcheck violation(s): "
+            + "; ".join(v["message"] for v in rep["violations"][:3])
+        )
+
+
 def parse_seed_range(spec: str):
     """"a:b" -> range(a, b); "3,7,9" -> [3, 7, 9]; "12" -> [12]."""
     if ":" in spec:
@@ -178,8 +192,10 @@ def run_search(args) -> int:
     out["wall_total_s"] = round(time.monotonic() - t0, 3)
     out["seeds"] = seeds
     out["generators"] = generators
+    if args.devcheck:
+        _attach_devcheck(out)
     print(json.dumps(out, indent=2, default=str))
-    return 0 if res.ok else 1
+    return 0 if out.get("ok", res.ok) else 1
 
 
 def run_scenario(args) -> int:
@@ -206,8 +222,16 @@ def run_scenario(args) -> int:
     out["scenario"] = args.scenario
     out["inconclusive"] = inconclusive
     out["wall_total_s"] = round(time.monotonic() - t0, 3)
+    if args.devcheck:
+        _attach_devcheck(out)
     print(json.dumps(out, indent=2, default=str))
-    return 0 if rep.ok else (3 if inconclusive else 1)
+    if args.devcheck and out["devcheck"]["violations"]:
+        # a recorded checker violation is conclusive evidence regardless
+        # of whether the wall budget cut the run short — never exit 3
+        return 1
+    if not rep.ok and inconclusive:
+        return 3
+    return 0 if out["ok"] else 1
 
 
 def main() -> int:
@@ -298,8 +322,21 @@ def main() -> int:
         help="re-introduce a known-fixed gossip bug (TM_TPU_GOSSIP_BUG_* "
         "seam) so the search demonstrably rediscovers and shrinks it",
     )
+    ap.add_argument(
+        "--devcheck",
+        action="store_true",
+        help="run with the TM_TPU_DEVCHECK runtime invariant checkers on "
+        "(relay-thread assertions, lock-order cycle detection, write-"
+        "after-resolve canary); the verdict embeds the devcheck report "
+        "and any violation fails the run",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+
+    if args.devcheck:
+        # before any tendermint_tpu import: import-time lock creation
+        # (metrics registries, epoch cache) is then instrumented too
+        os.environ["TM_TPU_DEVCHECK"] = "1"
 
     if args.inject_bug == "catchup":
         # must land before tendermint_tpu.consensus.peer_state is imported
@@ -338,6 +375,8 @@ def main() -> int:
         verdict["ok"] = False
         verdict["reason"] = "same-seed runs diverged (replay exactness broken)"
     verdict["faults"] = [f.kind for f in faults]
+    if args.devcheck:
+        _attach_devcheck(verdict)
 
     if args.trace:
         path = _trace.TRACER.dump(args.trace)

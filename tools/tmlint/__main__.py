@@ -1,0 +1,143 @@
+"""tmlint CLI.
+
+    python -m tools.tmlint [paths...] [--json] [--baseline FILE]
+                           [--write-baseline] [--rules r1,r2] [--list-rules]
+
+Exit-code contract (the tier-1 gate and CI key on this):
+    0  no non-baselined findings
+    1  at least one new (non-baselined) finding
+    2  usage or internal error (unknown rule, unreadable baseline, ...)
+
+Default scan root is the repo root (parent of tools/); default paths are
+the tendermint_tpu/ tree; the default baseline is LINT_BASELINE.json at
+the repo root when it exists. `--no-baseline` gates on everything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import ALL_RULES, run_paths
+from .core import apply_baseline, load_baseline, write_baseline
+from .rules import RULES_BY_NAME
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_PATHS = ["tendermint_tpu"]
+DEFAULT_BASELINE = "LINT_BASELINE.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tmlint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: tendermint_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} at "
+                         f"the repo root when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline; gate on every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--rules", default="",
+                    help="comma list of rule names to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:22s} {r.description}")
+        return 0
+
+    if args.write_baseline and (
+        args.rules or (args.paths and list(args.paths) != DEFAULT_PATHS)
+    ):
+        # a baseline written from a rule/path SUBSET would silently drop
+        # every other rule's grandfathered fingerprints — the next full
+        # run then fails on findings that were supposed to be baselined
+        print("error: --write-baseline requires a full run (no --rules, "
+              "no path subset) so the baseline stays complete",
+              file=sys.stderr)
+        return 2
+
+    rules = ALL_RULES
+    if args.rules:
+        try:
+            rules = [RULES_BY_NAME[n.strip()]
+                     for n in args.rules.split(",") if n.strip()]
+        except KeyError as e:
+            print(f"error: unknown rule {e.args[0]!r}; available: "
+                  f"{sorted(RULES_BY_NAME)}", file=sys.stderr)
+            return 2
+        if not rules:
+            print("error: --rules selected nothing", file=sys.stderr)
+            return 2
+
+    paths = args.paths or DEFAULT_PATHS
+    for p in paths:
+        ap_ = p if os.path.isabs(p) else os.path.join(args.root, p)
+        if not os.path.exists(ap_):
+            print(f"error: no such path {p!r}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run_paths(paths, args.root, rules)
+    except Exception as e:  # noqa: BLE001 — internal errors are exit 2
+        print(f"error: lint run failed: {e!r}", file=sys.stderr)
+        return 2
+
+    baseline_path = os.path.join(
+        args.root, args.baseline or DEFAULT_BASELINE
+    ) if not os.path.isabs(args.baseline or "") else args.baseline
+
+    if args.write_baseline:
+        data = write_baseline(baseline_path, findings)
+        print(f"wrote {len(data['fingerprints'])} fingerprint(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = set()
+    if not args.no_baseline:
+        if args.baseline is not None and not os.path.exists(baseline_path):
+            print(f"error: baseline {args.baseline!r} not found",
+                  file=sys.stderr)
+            return 2
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"error: unreadable baseline {baseline_path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    new, grandfathered = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "rules": [r.name for r in rules],
+            "ok": not new,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.message}")
+            if f.source_line:
+                print(f"    {f.source_line}")
+        tail = (f"{len(new)} finding(s)"
+                + (f", {len(grandfathered)} baselined" if grandfathered
+                   else ""))
+        print(("FAIL: " if new else "OK: ") + tail)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

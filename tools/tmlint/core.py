@@ -1,0 +1,311 @@
+"""tmlint core: findings, suppression comments, baselines, the runner.
+
+Pure stdlib (ast + tokenize + json) — importable and runnable without
+jax, numpy, or the package under analysis, so the lint gate rides the
+fast tier-1 path and works in any container.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+class Finding:
+    """One rule violation at one source location.
+
+    `fingerprint` is line-number INDEPENDENT (rule + path + the stripped
+    source text of the flagged line + occurrence index among identical
+    lines) so a committed baseline survives unrelated edits above the
+    finding."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "source_line")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, source_line: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.source_line = source_line.strip()
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "source_line": self.source_line,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+def fingerprint_findings(findings: Sequence[Finding]) -> List[str]:
+    """Stable fingerprints, one per finding (order-preserving). Identical
+    (rule, path, source text) findings disambiguate by occurrence index
+    in file order."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.source_line)
+        i = seen.get(key, 0)
+        seen[key] = i + 1
+        out.append(f"{f.rule}:{f.path}:{i}:{f.source_line}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+_MARK = "tmlint:"
+
+
+class Suppressions:
+    """Parsed `# tmlint:` comments for one file.
+
+    - `# tmlint: disable=rule1,rule2` on a code line suppresses those
+      rules for that line; on a comment-only line, for the next line too.
+    - a suppression landing on a `def`/`class` line covers the whole
+      definition span (computed by the runner from the AST).
+    - `# tmlint: fallback` is shorthand for disable=hot-path-purity.
+    - `# tmlint: disable-file=rule` suppresses the rule file-wide.
+    """
+
+    def __init__(self) -> None:
+        self.by_line: Dict[int, set] = {}
+        self.file_wide: set = set()
+        self.spans: List[Tuple[int, int, set]] = []  # (lo, hi, rules)
+
+    @staticmethod
+    def _parse_comment(text: str) -> Tuple[Optional[str], set]:
+        """-> (kind, rules) where kind is 'line'/'file'/None."""
+        body = text.lstrip("#").strip()
+        if not body.startswith(_MARK):
+            return None, set()
+        body = body[len(_MARK):].strip()
+        # allow a trailing justification after an em/en dash or ';'
+        for sep in ("—", "–", ";", " -- "):
+            if sep in body:
+                body = body.split(sep, 1)[0].strip()
+        if body.startswith("disable-file="):
+            rules = body[len("disable-file="):]
+            return "file", {r.strip() for r in rules.split(",") if r.strip()}
+        if body.startswith("disable="):
+            rules = body[len("disable="):]
+            # "disable=all" is spelled literally and matches every rule
+            return "line", {r.strip() for r in rules.split(",") if r.strip()}
+        if body.split()[0:1] == ["fallback"]:
+            return "line", {"hot-path-purity"}
+        return None, set()
+
+    @classmethod
+    def scan(cls, source: str) -> "Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                kind, rules = cls._parse_comment(tok.string)
+                if not rules:
+                    continue
+                if kind == "file":
+                    sup.file_wide |= rules
+                    continue
+                line = tok.start[0]
+                sup.by_line.setdefault(line, set()).update(rules)
+                # comment-only line: applies to the following line as well
+                prefix = tok.line[: tok.start[1]]
+                if prefix.strip() == "":
+                    sup.by_line.setdefault(line + 1, set()).update(rules)
+        except tokenize.TokenError:
+            pass
+        return sup
+
+    def add_span(self, lo: int, hi: int, rules: set) -> None:
+        self.spans.append((lo, hi, rules))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "all" in self.file_wide:
+            return True
+        rules = self.by_line.get(line)
+        if rules and (rule in rules or "all" in rules):
+            return True
+        for lo, hi, rs in self.spans:
+            if lo <= line <= hi and (rule in rs or "all" in rs):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# rule base + file context
+
+
+class FileContext:
+    """Everything a rule needs for one file: the AST, raw lines, the
+    repo-relative path, and the parsed suppressions."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.AST,
+                 suppressions: Suppressions):
+        self.path = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions = suppressions
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.path, line, col, message,
+                       self.line_text(line))
+
+
+class Rule:
+    """A lint pass. Subclasses set `name`, `description`, and an optional
+    `scope` (path-prefix / filename filter) and implement visit()."""
+
+    name = "rule"
+    description = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+def _function_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            spans.append((node.lineno, end))
+    return spans
+
+
+def _promote_def_suppressions(tree: ast.AST, sup: Suppressions) -> None:
+    """A suppression on (or immediately above) a def/class line covers the
+    whole definition body."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            continue
+        rules = set(sup.by_line.get(node.lineno, ()))
+        if rules:
+            end = getattr(node, "end_lineno", node.lineno)
+            sup.add_span(node.lineno, end, rules)
+
+
+def run_source(source: str, relpath: str,
+               rules: Sequence[Rule]) -> List[Finding]:
+    """Lint one in-memory file. Unparsable sources yield a single
+    `parse-error` finding rather than crashing the run."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("parse-error", relpath, e.lineno or 1, 0,
+                        f"could not parse: {e.msg}")]
+    sup = Suppressions.scan(source)
+    _promote_def_suppressions(tree, sup)
+    ctx = FileContext(relpath, source, tree, sup)
+    out: List[Finding] = []
+    seen = set()  # rules that scan per-function revisit nested defs
+    for rule in rules:
+        if not rule.applies_to(relpath):
+            continue
+        for f in rule.visit(ctx):
+            key = (f.rule, f.line, f.col)
+            if key in seen or sup.suppressed(f.rule, f.line):
+                continue
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> Iterator[Tuple[str, str]]:
+    """-> (abspath, root-relative path with forward slashes)."""
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    yield full, os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def run_paths(paths: Sequence[str], root: str,
+              rules: Sequence[Rule]) -> List[Finding]:
+    out: List[Finding] = []
+    for ap, rel in iter_py_files(paths, root):
+        with open(ap, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        out.extend(run_source(src, rel, rules))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str) -> set:
+    """-> the set of grandfathered fingerprints (empty for a missing
+    file, so a fresh checkout gates on everything)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
+    data = {
+        "comment": (
+            "tmlint grandfathered findings. Entries here are pre-existing "
+            "audit items, not approvals — shrink this file, never grow it. "
+            "Regenerate with `python -m tools.tmlint --write-baseline`."
+        ),
+        "fingerprints": fingerprint_findings(findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return data
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: set) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new, grandfathered) split by fingerprint."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f, fp in zip(findings, fingerprint_findings(findings)):
+        (old if fp in baseline else new).append(f)
+    return new, old

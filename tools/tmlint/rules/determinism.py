@@ -1,0 +1,138 @@
+"""simnet-determinism — wall clocks / global RNG / unordered iteration.
+
+simnet's whole value is replay exactness: same seed ⇒ byte-identical run
+fingerprint (PR 3), which is what makes a failing fault-schedule a repro
+and lets the property-based search shrink schedules (PR 6). That breaks
+the moment any simnet-reachable code path reads the wall clock
+(`time.time`, `datetime.now`), draws from the process-global RNG
+(`random.random()` — as opposed to a seeded `random.Random(seed)`
+instance), reads OS entropy (`os.urandom`, `uuid.uuid4`, `secrets`), or
+lets a Python `set`'s hash-order feed a scheduling decision.
+
+Scope: tendermint_tpu/simnet/ and tendermint_tpu/consensus/ (the modules
+the simnet harness drives). The injection seams are the allowlist: clocks
+ride `self._now` / injected `clock` objects, randomness rides seeded
+`random.Random` instances — neither matches these patterns, so correctly
+injected code lints clean by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import FileContext, Finding, Rule
+from . import func_name, iter_functions, receiver_name
+
+_TIME_RECEIVERS = {"time", "_time"}
+_TIME_FNS = {"time", "time_ns"}
+_DATETIME_RECEIVERS = {"datetime", "date"}
+_DATETIME_FNS = {"now", "utcnow", "today"}
+_ENTROPY = {
+    ("os", "urandom"),
+    ("uuid", "uuid1"),
+    ("uuid", "uuid4"),
+}
+
+
+class SimnetDeterminismRule(Rule):
+    name = "simnet-determinism"
+    description = (
+        "no wall clock, global RNG, OS entropy, or unordered-set iteration "
+        "in simnet-reachable code — replay exactness depends on it"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(
+            ("tendermint_tpu/simnet/", "tendermint_tpu/consensus/")
+        )
+
+    # -- call patterns ---------------------------------------------------
+
+    def _bad_call(self, node: ast.Call) -> str:
+        name = func_name(node)
+        recv = receiver_name(node)
+        if recv in _TIME_RECEIVERS and name in _TIME_FNS:
+            return (f"wall-clock read `{recv}.{name}()` — use the injected "
+                    f"clock (self._now / SimClock) so replays stay exact")
+        if recv in _DATETIME_RECEIVERS and name in _DATETIME_FNS:
+            return (f"wall-clock read `{recv}.{name}()` — derive timestamps "
+                    f"from the injected clock")
+        if (recv, name) in _ENTROPY or recv == "secrets":
+            return (f"OS entropy `{recv}.{name}()` — draw from the seeded "
+                    f"run RNG instead")
+        if recv == "random":
+            # the MODULE-level (process-global) RNG; a seeded
+            # random.Random(seed) instance is the sanctioned pattern
+            if name == "Random":
+                if not node.args and not node.keywords:
+                    return ("unseeded random.Random() — pass an explicit "
+                            "seed so the run replays")
+                return ""
+            return (f"process-global RNG `random.{name}()` — use a seeded "
+                    f"random.Random instance threaded from the run seed")
+        return ""
+
+    # -- set iteration ---------------------------------------------------
+
+    @staticmethod
+    def _set_names(fn: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Set, ast.SetComp)
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif (isinstance(node, ast.Assign)
+                  and isinstance(node.value, ast.Call)
+                  and func_name(node.value) == "set"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    def _is_set_expr(self, node: ast.AST, set_names: Set[str]) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and func_name(node) == "set":
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in set_names
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)
+        ):
+            return (self._is_set_expr(node.left, set_names)
+                    or self._is_set_expr(node.right, set_names))
+        return False
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                msg = self._bad_call(node)
+                if msg:
+                    yield ctx.finding(self.name, node, msg)
+        # unordered iteration: a `for` (or comprehension) directly over a
+        # set expression — hash order feeds whatever the loop decides.
+        # `sorted(set(...))` / `list(sorted(...))` wrappers are fine and
+        # do not match (the iterable is the sorted() call).
+        for fn in iter_functions(ctx.tree):
+            set_names = self._set_names(fn)
+            for node in ast.walk(fn):
+                iters = []
+                if isinstance(node, ast.For):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    if self._is_set_expr(it, set_names):
+                        yield ctx.finding(
+                            self.name, node,
+                            "iteration over an unordered set — hash order "
+                            "varies across processes and feeds scheduling; "
+                            "iterate a list/dict (insertion-ordered) or "
+                            "wrap in sorted()",
+                        )
+                        break

@@ -1,0 +1,189 @@
+"""donation-aliasing — non-owning verdict memory escaping ops/ functions.
+
+The PR-7 incident: futures were resolved with `np.asarray(device_result)`
+— on the CPU backend a ZERO-COPY view of the XLA output buffer. With
+buffer donation on, a later launch recycles that page and mutates
+verdicts already delivered to callers (a [0,1,...] verdict row flipped to
+all-ones after resolution). The fix discipline: anything that ESCAPES a
+function (return / Future.set_result / accumulator .append) must be
+host-OWNED memory — `np.array(x)`, `x.copy()`, `.astype(...)`, or a
+concatenate — never a bare `np.asarray(...)` or a slice of one.
+
+Intra-procedural, flow-insensitive: a name is tainted if it is ever
+assigned a non-owning producer and NEVER assigned an owning one (so the
+`if not arr.flags.owndata: arr = np.array(arr, copy=True)` guard pattern
+clears the taint). Slices of tainted names stay tainted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import FileContext, Finding, Rule
+from . import func_name, iter_functions
+
+_OWNING_CALLS = {
+    "array", "copy", "astype", "concatenate", "stack", "empty", "zeros",
+    "ones", "full", "frombuffer", "fromiter", "repeat", "tolist",
+}
+_ESCAPE_SETTERS = {"set_result"}
+_ACCUMULATORS = {"append", "extend"}
+
+
+def _is_asarray(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call) and func_name(node) == "asarray")
+
+
+def _is_owning_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and func_name(node) in _OWNING_CALLS
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body: collect tainted/owned names, then flag escapes."""
+
+    def __init__(self, ctx: FileContext, rule_name: str):
+        self.ctx = ctx
+        self.rule = rule_name
+        self.tainted: Set[str] = set()
+        self.owned: Set[str] = set()
+        self.findings = []
+
+    # -- taint collection (first pass) -----------------------------------
+
+    def _value_taints_vs(self, v: ast.AST, tainted: Set[str]) -> bool:
+        if _is_asarray(v):
+            return True
+        if isinstance(v, ast.Subscript):
+            return self._value_taints_vs(v.value, tainted)
+        if isinstance(v, ast.IfExp):
+            return (self._value_taints_vs(v.body, tainted)
+                    or self._value_taints_vs(v.orelse, tainted))
+        if isinstance(v, ast.Name):
+            return v.id in tainted
+        return False
+
+    @staticmethod
+    def _bindings(node: ast.AST):
+        """(name, value) pairs from every assignment form: plain Assign
+        (incl. element-wise tuple targets), AnnAssign (`res: T = ...` —
+        an annotation must not launder taint), and walrus NamedExpr."""
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    yield tgt.id, node.value
+                elif (isinstance(tgt, ast.Tuple)
+                      and isinstance(node.value, ast.Tuple)
+                      and len(tgt.elts) == len(node.value.elts)):
+                    for t, v in zip(tgt.elts, node.value.elts):
+                        if isinstance(t, ast.Name):
+                            yield t.id, v
+        elif (isinstance(node, ast.AnnAssign) and node.value is not None
+              and isinstance(node.target, ast.Name)):
+            yield node.target.id, node.value
+        elif (isinstance(node, ast.NamedExpr)
+              and isinstance(node.target, ast.Name)):
+            yield node.target.id, node.value
+
+    def collect(self, fn: ast.AST) -> None:
+        """Fold bindings in SOURCE order, last binding per name wins: the
+        owndata-guard (`arr = np.array(arr, copy=True)` after the
+        asarray) clears the taint because it comes later, while an
+        owned init OVERWRITTEN by a device view (`out = np.zeros(n);
+        out = np.asarray(dev)[:n]`) stays tainted — order-insensitive
+        ever-owned-wins let that exact PR-7 shape through. Branches fold
+        by source position (known flow-insensitivity; the guard idiom
+        puts the owning reassign last). Two sweeps so `b = a[:n]` sees
+        a's final taint regardless of binding interleavings; unknown
+        producers clear taint (true reassignment)."""
+        binds = sorted(
+            ((getattr(n, "lineno", 0), getattr(n, "col_offset", 0), nm, v)
+             for n in ast.walk(fn) for nm, v in self._bindings(n)),
+            key=lambda t: (t[0], t[1]),
+        )
+        for _ in range(2):
+            tainted: Set[str] = set()
+            owned: Set[str] = set()
+            for _, _, name, value in binds:
+                if _is_owning_call(value):
+                    owned.add(name)
+                    tainted.discard(name)
+                elif self._value_taints_vs(value, self.tainted | tainted):
+                    tainted.add(name)
+                    owned.discard(name)
+                else:
+                    # unknown producer: a real reassignment — the old
+                    # binding (tainted or owned) is gone
+                    tainted.discard(name)
+                    owned.discard(name)
+            self.tainted, self.owned = tainted, owned
+
+    # -- escape checks (second pass) -------------------------------------
+
+    def _expr_escapes(self, v: ast.AST) -> bool:
+        """Is this expression non-owning memory (directly or via taint)?"""
+        if _is_asarray(v):
+            return True
+        if isinstance(v, ast.Name):
+            return v.id in self.tainted
+        if isinstance(v, ast.Subscript):
+            return self._expr_escapes(v.value)
+        if isinstance(v, ast.IfExp):
+            return self._expr_escapes(v.body) or self._expr_escapes(v.orelse)
+        return False
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            self.rule, node,
+            f"{what} escapes with non-owning array memory (zero-copy view "
+            f"of a device/XLA buffer; a donated later launch can mutate it "
+            f"after delivery) — wrap in np.array(...)/.copy()",
+        ))
+
+    def check(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                vals = (node.value.elts
+                        if isinstance(node.value, ast.Tuple)
+                        else [node.value])
+                for v in vals:
+                    if self._expr_escapes(v):
+                        self._flag(node, "return value")
+                        break
+            elif isinstance(node, ast.Call):
+                name = func_name(node)
+                if name in _ESCAPE_SETTERS:
+                    for a in node.args:
+                        if self._expr_escapes(a):
+                            self._flag(node, "Future.set_result argument")
+                            break
+                elif name in _ACCUMULATORS:
+                    for a in node.args:
+                        # bare asarray(x) append is common and benign
+                        # (e.g. collecting already-owned future results);
+                        # the bug shape is a SLICE of a device result or
+                        # a tainted name accumulated across launches
+                        if (isinstance(a, ast.Subscript)
+                                and self._expr_escapes(a)) or (
+                                isinstance(a, ast.Name)
+                                and a.id in self.tainted):
+                            self._flag(node, "accumulator argument")
+                            break
+
+
+class DonationAliasingRule(Rule):
+    name = "donation-aliasing"
+    description = (
+        "non-owning device-result views (np.asarray / slices of it) must "
+        "not escape ops/ functions — the PR-7 write-after-resolve bug class"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tendermint_tpu/ops/")
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in iter_functions(ctx.tree):
+            scan = _FnScan(ctx, self.name)
+            scan.collect(fn)
+            scan.check(fn)
+            yield from scan.findings

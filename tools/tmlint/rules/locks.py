@@ -1,0 +1,114 @@
+"""lock-discipline — bare acquisitions and unauditable thread targets.
+
+Two shapes this repo has been burned by:
+
+1. Bare `lock.acquire()` as a statement. A `with lock:` block releases on
+   every exit path; a bare acquire leaks the lock on any exception
+   between acquire and release (the PR-1 metrics self-deadlock was this
+   family). Semaphores are exempt — the pipeline's depth semaphore is
+   deliberately acquired and released on DIFFERENT threads (dispatcher /
+   resolver), which a context manager cannot express; receivers with
+   "sem" in the name do not match. Cross-method Lock/Unlock APIs that
+   mirror the Go reference (mempool.Mempool.Lock) carry an explicit
+   suppression with justification.
+
+2. `threading.Thread(target=...)` where the target is a lambda (nothing
+   to audit) or, outside the relay whitelist, a same-module function
+   whose body calls relay entry points — a thread that would touch the
+   device without being the dispatch-owner. The runtime twin of this
+   check is devcheck's relay-thread assertion.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator
+
+from ..core import FileContext, Finding, Rule
+from . import func_name, receiver_name
+from .relay import ENTRY_POINTS, WHITELIST
+
+
+def _terminal_receiver(call: ast.Call) -> str:
+    """self._mtx.acquire() -> '_mtx' (the attr nearest the call)."""
+    if isinstance(call.func, ast.Attribute):
+        inner = call.func.value
+        if isinstance(inner, ast.Attribute):
+            return inner.attr
+        if isinstance(inner, ast.Name):
+            return inner.id
+    return ""
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "locks are acquired via context managers (semaphores exempt); "
+        "thread targets must be auditable and relay-clean"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tendermint_tpu/")
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _local_functions(tree: ast.AST) -> Dict[str, ast.AST]:
+        fns: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+        return fns
+
+    @staticmethod
+    def _touches_relay(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and func_name(node) in ENTRY_POINTS:
+                return True
+        return False
+
+    # -- visit -----------------------------------------------------------
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:
+        local_fns = self._local_functions(ctx.tree)
+        whitelisted = ctx.path in WHITELIST
+        for node in ast.walk(ctx.tree):
+            # 1) bare `x.acquire()` as a statement
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and func_name(node.value) == "acquire"):
+                recv = _terminal_receiver(node.value)
+                if "sem" not in recv.lower():
+                    yield ctx.finding(
+                        self.name, node,
+                        f"bare `{recv or '<expr>'}.acquire()` — use "
+                        f"`with {recv or 'lock'}:` so every exit path "
+                        f"releases (cross-thread handoffs are what "
+                        f"semaphores are for)",
+                    )
+            # 2) thread targets
+            if isinstance(node, ast.Call) and func_name(node) == "Thread":
+                if receiver_name(node) not in ("threading", ""):
+                    continue
+                target = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None:
+                    continue
+                if isinstance(target, ast.Lambda):
+                    yield ctx.finding(
+                        self.name, node,
+                        "thread target is a lambda — name the function so "
+                        "its lock/relay behavior is auditable",
+                    )
+                elif not whitelisted and isinstance(target, ast.Name):
+                    fn = local_fns.get(target.id)
+                    if fn is not None and self._touches_relay(fn):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"thread target `{target.id}` calls relay entry "
+                            f"points outside the dispatcher whitelist — "
+                            f"only ops/pipeline.py's dispatch-owner thread "
+                            f"may touch the device",
+                        )

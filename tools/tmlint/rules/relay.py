@@ -1,0 +1,88 @@
+"""relay-ownership — device-touching entry points outside the dispatcher.
+
+PERF_r05 §2: the TPU relay is ONE serial command channel. Transfers
+neither overlap execution nor tolerate concurrency, so exactly one thread
+— the pipeline's dispatch-owner — may launch kernels, issue device_put
+transfers, or upload epoch tables. The module whitelist below is the full
+set of modules architecturally sanctioned to hold relay-touching code
+(the dispatcher itself, the transfer/table implementations, the kernel
+definitions, and the direct-path fallbacks in ops/backend.py). A call to
+any launch/transfer entry point from ANY other module is a structural
+violation: route it through ops.pipeline.AsyncBatchVerifier instead.
+
+The runtime half of this invariant is libs/devcheck.py's relay-thread
+assertion (TM_TPU_DEVCHECK=1); this pass catches the call SITES the
+runtime hooks would only catch when exercised.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule
+from . import func_name, receiver_name
+
+# modules allowed to contain relay-touching calls (repo-relative)
+WHITELIST = frozenset({
+    "tendermint_tpu/ops/pipeline.py",      # the dispatch-owner thread
+    "tendermint_tpu/ops/device_pool.py",   # transfer() implementation
+    "tendermint_tpu/ops/epoch_cache.py",   # lazy table upload (dispatcher-run)
+    "tendermint_tpu/ops/backend.py",       # sanctioned direct path + warmup
+    "tendermint_tpu/ops/ed25519_verify.py",
+    "tendermint_tpu/ops/pallas_verify.py",
+    "tendermint_tpu/ops/pallas_rlc.py",
+    "tendermint_tpu/ops/pallas_sr25519.py",
+    "tendermint_tpu/ops/sharded.py",
+    "tendermint_tpu/ops/mixed.py",
+    "tendermint_tpu/ops/_testing.py",      # test scaffolding, not production
+})
+
+# launch / transfer / upload entry points (terminal callee names)
+ENTRY_POINTS = frozenset({
+    "device_put",
+    "copy_to_host_async",
+    "block_until_ready",
+    "jitted_verify",
+    "jitted_verify_device_hash",
+    "cached_kernel",
+    "rlc_cached_fn",
+    "cached_compact_fn",
+    "_jitted_rlc_verify",
+    "_jitted_pallas_verify",
+    "verify_kernel_cached",
+    "xla_tables",
+    "coords_tables",
+})
+
+# `transfer` is a common word; only flag it on a device_pool-ish receiver
+_QUALIFIED = {"transfer": ("_dpool", "device_pool", "dpool", "pool")}
+
+
+class RelayOwnershipRule(Rule):
+    name = "relay-ownership"
+    description = (
+        "kernel-launch / device_put / epoch-table-upload call sites are "
+        "only legal inside the dispatcher module whitelist"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("tendermint_tpu/")
+                and relpath not in WHITELIST)
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = func_name(node)
+            hit = name in ENTRY_POINTS
+            if not hit and name in _QUALIFIED:
+                hit = receiver_name(node) in _QUALIFIED[name]
+            if hit:
+                yield ctx.finding(
+                    self.name, node,
+                    f"relay entry point `{name}()` called outside the "
+                    f"dispatcher whitelist — only the single dispatch-owner "
+                    f"thread (ops/pipeline.py) may touch the device; submit "
+                    f"through AsyncBatchVerifier instead",
+                )
