@@ -399,6 +399,20 @@ class OpsMetrics:
             "ops", "buffer_pool_misses_total",
             "Device input-buffer slot acquires that minted a new slot.",
         )
+        # mesh dispatcher (ops/mesh.py + ops/pipeline.py _worker_mesh):
+        # lane packing efficiency of the last superbatch launch —
+        # occupancy = live signatures / (lanes x lane_bucket), pad waste
+        # = identity padding rows / total rows (occupancy + pad = 1; the
+        # two gauges are published separately so dashboards can alert on
+        # either without arithmetic)
+        self.mesh_lane_occupancy = registry.gauge(
+            "ops", "mesh_lane_occupancy",
+            "Live-signature fraction of the last mesh superbatch's lanes.",
+        )
+        self.mesh_pad_waste_ratio = registry.gauge(
+            "ops", "mesh_pad_waste_ratio",
+            "Identity-padding fraction of the last mesh superbatch.",
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +476,8 @@ def ops_stats() -> dict:
         "transfer_overlap_ratio": float(m.transfer_overlap_ratio.value()),
         "buffer_pool_hits": int(m.buffer_pool_hits.total()),
         "buffer_pool_misses": int(m.buffer_pool_misses.total()),
+        "mesh_lane_occupancy": float(m.mesh_lane_occupancy.value()),
+        "mesh_pad_waste_ratio": float(m.mesh_pad_waste_ratio.value()),
     }
 
 

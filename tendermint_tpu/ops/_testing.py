@@ -50,6 +50,46 @@ def slow_prepare(real_prepare, delay: float):
     return prep
 
 
+def slow_mesh_prepare(real_prepare, delay: float):
+    """Mesh-mode twin of slow_prepare: wrap AsyncBatchVerifier's
+    `_prepare_mesh` so every superbatch's kernel result rides a
+    SlowReadback — the REAL packing, prep, transfer shardings and kernel
+    run unchanged; only the readback is slowed (the `tools/prep_bench.py
+    --mesh` gate's relay-RTT proxy)."""
+
+    def prep(block, plan):
+        res = real_prepare(block, plan)
+        f, args, rlc, bucket = res[:4]
+        return (
+            (lambda *xs: SlowReadback(f(*xs), delay)), args, rlc, bucket,
+        ) + tuple(res[4:])
+
+    return prep
+
+
+def mock_mesh_prepare(real_prepare, rtt_s: float):
+    """Fully-mocked mesh DEVICE for `bench.py multichip`'s simulated-lane
+    curve: the real lane packing, host prep and H2D transfer run
+    unchanged, but the launch returns an all-accept verdict row behind a
+    fixed relay RTT instead of running the kernel — modeling an L-device
+    mesh (per-lane compute parallel across devices, one relay command
+    per superbatch) on a box with one physical device. The curve then
+    measures exactly what the mesh dispatcher adds: signatures packed
+    per relay command vs the dispatcher's own serial host costs."""
+    import numpy as np
+
+    def prep(block, plan):
+        res = real_prepare(block, plan)
+        _f, args, rlc, bucket = res[:4]
+
+        def launch(*_xs):
+            return SlowReadback(np.ones((bucket,), dtype=bool), rtt_s)
+
+        return (launch, args, rlc, bucket) + tuple(res[4:])
+
+    return prep
+
+
 def drain_pool(pool, timeout: float = 5.0) -> None:
     """Wait for every in-flight slot to return. The resolver completes a
     batch's futures BEFORE releasing its pool slot, so a caller waking

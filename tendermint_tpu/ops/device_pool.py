@@ -84,20 +84,36 @@ def layout_key(bucket: int, args) -> LayoutKey:
     )
 
 
-def transfer(args) -> tuple:
+def transfer(args, shardings=None) -> tuple:
     """Issue the H2D copy of a prepared argument tuple: ``device_put``
     every host array (jax Arrays — none on current paths, but e.g. a
     pre-resolved table — pass through untouched). Returns the tuple with
     device arrays in place of numpy ones. The call returns once the
     copies are *enqueued*; completion ordering against the kernel's reads
-    is the runtime's job."""
+    is the runtime's job.
+
+    ``shardings`` (ISSUE 9: mesh superbatches) is an optional per-arg
+    sequence of jax Shardings — each array's copy is placed
+    lane-per-device across the dispatcher's mesh instead of on the
+    default device, so batch k+1's distributed H2D rides behind mesh
+    kernel k exactly like the single-device overlap path."""
     # devcheck relay assertion (ISSUE 8): transfers are relay touches —
     # once a dispatcher has claimed the relay, only it may issue them
     _devcheck.note_relay_touch("device_pool.transfer")
     import jax
 
+    if shardings is None:
+        return tuple(
+            jax.device_put(a) if isinstance(a, np.ndarray) else a
+            for a in args
+        )
+    if len(shardings) != len(args):
+        raise ValueError(
+            f"{len(args)} args but {len(shardings)} transfer shardings"
+        )
     return tuple(
-        jax.device_put(a) if isinstance(a, np.ndarray) else a for a in args
+        jax.device_put(a, s) if isinstance(a, np.ndarray) else a
+        for a, s in zip(args, shardings)
     )
 
 

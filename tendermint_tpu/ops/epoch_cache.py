@@ -151,6 +151,38 @@ class EpochEntry:
                 self._dev["coords"] = t
             return t
 
+    def sharded_xla_tables(self, mesh) -> Tuple:
+        """The xla_tables layout REPLICATED over a jax device mesh
+        (ISSUE 9 (b)): one resident copy per device, keyed inside this
+        entry's layout dict by the mesh's device ids — so the epoch LRU
+        owns the mesh replicas' lifetime exactly as it owns the
+        single-device layouts (eviction drops them all), replacing the
+        old module-level side cache in ops/sharded.py. Limbs are packed
+        by the SAME _pack_le_limbs as the uncached prep, so mesh-cached
+        vs single-device kernel inputs stay bit-identical."""
+        key = ("xla_sharded", tuple(d.id for d in mesh.devices.flat))
+        with self._mtx:
+            t = self._dev.get(key)
+            if t is None:
+                # relay touch: replication is an upload fanned across the
+                # mesh — dispatch-owner thread only, like every layout
+                _devcheck.note_relay_touch("epoch_cache.sharded_tables")
+                import jax
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P
+
+                from .backend import _pack_le_limbs
+
+                limbs = _pack_le_limbs(self.pub_rows)
+                sign = (self.pub_rows[:, 31] >> 7).astype(np.int32)
+                repl = NamedSharding(mesh, _P())
+                with _span("pipeline.table_upload", layout="xla_sharded",
+                           vp=self.vp):
+                    t = (jax.device_put(limbs, repl),
+                         jax.device_put(sign, repl))
+                self._dev[key] = t
+            return t
+
     def nbytes_host(self) -> int:
         """Host bytes a FULL table upload ships (every layout the kernels
         consume) — the cold-epoch H2D cost the --transfer gate accounts."""

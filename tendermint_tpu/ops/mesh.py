@@ -1,0 +1,426 @@
+"""Mesh-aware dispatcher lane packing — multichip scale-out serving.
+
+ISSUE 9 tentpole / ROADMAP §2: after PRs 4-7 the single-device path is
+pipelined, epoch-cached and overlapped; the binding constraint is
+device-count. `ops/sharded.py` proves the sharded kernels compile, but
+nothing *feeds* a mesh with concurrent work — every queued commit still
+serializes through one device's lanes. This module turns the pipeline's
+coalescer into a **mesh dispatcher**: many commits in flight (many
+chains / many heights — the millions-of-users shape) are bin-packed into
+per-shard **lanes** of one `(n_lanes, lane_bucket)` superbatch per
+launch, so one relay command carries every device's work for the step.
+
+Packing model (committee-scale batching, arXiv 2302.00418):
+
+    lane        one device shard's contiguous `lane_bucket` rows of the
+                superbatch. A lane holds whole jobs (EntryBlocks) that
+                share ONE epoch key — same-epoch blocks gather from the
+                same device-resident table; mixed epochs land in
+                DIFFERENT lanes, never mixed within one.
+    pad rows    short lanes are completed with identity rows (A = R =
+                the identity encoding, s = 0 — verify trivially under
+                any challenge, exactly `_pack_rows`' padding lanes), so
+                every lane is a full compiled shard.
+    superbatch  lanes concatenated on the batch axis: `n_lanes *
+                lane_bucket` rows, `n_lanes` rounded up to a power of
+                two (compiled-shape discipline — shapes stay in
+                {1,2,4,8,...} x BUCKETS). With `jax.shard_map` available
+                the batch axis shards lane-per-device over the mesh
+                (ops/sharded.mesh_valid_fn); otherwise the SAME
+                superbatch launches through the plain jitted kernel —
+                bit-identical verdicts, "simulated lanes" (the tier-1 /
+                CPU face, and the warn-once fallback of ISSUE 9's first
+                satellite).
+    demux       per-job verdict spans are global row ranges
+                (lane_idx * lane_bucket + offset) into the one verdict
+                row — readback stays a single slice per job, blame
+                indices unchanged.
+
+The packing itself is pure host bookkeeping (numpy + EntryBlock — no
+jax, no crypto), importable standalone the way ops/device_pool.py is;
+`prepare_superbatch` is the only device-facing function and defers every
+heavy import. Uploads and launches remain the property of the
+pipeline's single dispatch-owner thread: this module builds plans and
+argument tuples, the dispatcher transfers and launches them (the relay
+single-owner invariant, tmlint relay-ownership + devcheck).
+
+Knobs:
+    TM_TPU_MESH              lane count: 0/unset = disabled (classic
+                             single-lane dispatch), N = pack up to N
+                             lanes per launch, "auto" = one lane per
+                             visible jax device.
+    TM_TPU_MESH_LANE_BUCKET  per-lane signature capacity cap (default:
+                             the largest single-device bucket).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+try:
+    from .entry_block import EntryBlock
+except ImportError:  # pragma: no cover — standalone file load (crypto-less
+    # containers exec this module by path for the jax-free packing tests;
+    # entry_block is numpy-only and loads the same way)
+    import importlib.util as _ilu
+    import os as _os
+
+    _eb_path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "entry_block.py"
+    )
+    _eb_spec = _ilu.spec_from_file_location(
+        "_tm_tpu_entry_block_standalone", _eb_path
+    )
+    _eb = _ilu.module_from_spec(_eb_spec)
+    _eb_spec.loader.exec_module(_eb)
+    EntryBlock = _eb.EntryBlock
+
+# single-device bucket ladder (ops/backend.BUCKETS, duplicated here so the
+# packing layer stays importable without the device stack; backend asserts
+# they agree at prepare_superbatch time)
+_BUCKETS = (128, 1024, 10240)
+
+
+def lanes_from_env() -> int:
+    """TM_TPU_MESH -> lane count (0 = mesh dispatch disabled)."""
+    env = os.environ.get("TM_TPU_MESH", "").strip().lower()
+    if not env or env == "0":
+        return 0
+    if env == "auto":
+        try:
+            import jax
+
+            return max(len(jax.devices()), 1)
+        except Exception:  # noqa: BLE001 — no jax: mesh mode off
+            return 0
+    try:
+        return max(int(env), 0)
+    except ValueError:
+        return 0
+
+
+def lane_cap() -> int:
+    """Max signatures one lane may hold (whole jobs only — submit()
+    chunks oversized jobs at this bound in mesh mode). Clamped into the
+    bucket ladder: a lane larger than the top bucket would let a lane
+    outgrow every compiled shape."""
+    env = os.environ.get("TM_TPU_MESH_LANE_BUCKET")
+    if env:
+        try:
+            return min(max(int(env), _BUCKETS[0]), _BUCKETS[-1])
+        except ValueError:
+            pass
+    return _BUCKETS[-1]
+
+
+def _bucket_for(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+class Lane:
+    """One shard's worth of packed jobs: single epoch key, whole jobs,
+    live rows <= the plan's lane_bucket."""
+
+    __slots__ = ("key", "jobs", "n")
+
+    def __init__(self, key: Optional[bytes]):
+        self.key = key
+        self.jobs: List = []  # objects with an `.entries` EntryBlock
+        self.n = 0
+
+    def add(self, job) -> None:
+        self.jobs.append(job)
+        self.n += len(job.entries)
+
+
+class MeshPlan:
+    """A packed superbatch: `lanes` live lanes (possibly fewer than
+    `n_lanes` — the rest are pure identity padding), each padded to
+    `lane_bucket` rows; `empty_jobs` resolve as zero-width spans without
+    occupying a lane. `bucket` is the launch shape in signatures."""
+
+    __slots__ = ("lanes", "lane_bucket", "n_lanes", "empty_jobs")
+
+    def __init__(self, lanes: List[Lane], max_lanes: int,
+                 lane_bucket: Optional[int] = None):
+        self.lanes = lanes
+        self.empty_jobs: List = []
+        self.lane_bucket = lane_bucket or _bucket_for(
+            max((l.n for l in lanes), default=1)
+        )
+        # power-of-two lane count keeps the compiled-shape set small:
+        # {1,2,4,...} x the bucket ladder — a non-pow2 TM_TPU_MESH is
+        # floored (pack_jobs applies the same floor, so the plan always
+        # has room for every lane it packed)
+        self.n_lanes = min(
+            _next_pow2(max(len(lanes), 1)),
+            _pow2_floor(max(max_lanes, 1)),
+        )
+
+    @property
+    def bucket(self) -> int:
+        return self.n_lanes * self.lane_bucket
+
+    @property
+    def live(self) -> int:
+        return sum(l.n for l in self.lanes)
+
+    @property
+    def pad(self) -> int:
+        return self.bucket - self.live
+
+    def occupancy(self) -> float:
+        """Mean live fraction across the superbatch's lanes (a pure-pad
+        lane contributes 0)."""
+        return self.live / self.bucket if self.bucket else 0.0
+
+    def pad_ratio(self) -> float:
+        return self.pad / self.bucket if self.bucket else 0.0
+
+    def epoch_key(self) -> Optional[bytes]:
+        """The superbatch's single epoch key, or None when lanes mix
+        epochs (mixed packs ride the uncached prep — pubs ship with the
+        batch, exactly EntryBlock.concat's mixed-key fallback)."""
+        keys = {l.key for l in self.lanes}
+        if len(keys) == 1:
+            return next(iter(keys))
+        return None
+
+
+def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
+              ) -> Tuple[MeshPlan, List]:
+    """First-fit bin-pack `jobs` (each with an `.entries` EntryBlock)
+    into at most `max_lanes` single-epoch lanes of `cap` signatures.
+    Jobs that fit nowhere are returned as held-over for the next
+    superbatch (exactly the coalescer's bucket-overflow hold). A job
+    larger than `cap` raises — submit() must chunk first."""
+    cap = cap or lane_cap()
+    # pow2 lane-count discipline (see MeshPlan): never pack more lanes
+    # than the plan will have room for
+    max_lanes = _pow2_floor(max(max_lanes, 1))
+    lanes: List[Lane] = []
+    held: List = []
+    empty: List = []
+    for job in jobs:
+        n = len(job.entries)
+        if n > cap:
+            raise ValueError(
+                f"job of {n} sigs exceeds the {cap}-sig lane capacity"
+            )
+        if n == 0:
+            # empty submissions resolve as zero-width spans without
+            # pinning a lane (an empty job's key must not demote a
+            # same-warm-epoch pack to the uncached prep)
+            empty.append(job)
+            continue
+        key = job.entries.epoch_key
+
+        def _fits(l, n=n, key=key):
+            # bucket-aware fit (the classic coalescer's peel rule, as a
+            # pack-time predicate): fusing must not push the lane into a
+            # BIGGER ladder bucket unless the fused total nearly fills
+            # it — e.g. two 600-sig jobs stay separate 1024-bucket lanes
+            # instead of one 1200-live lane quantized to 10240 rows
+            if l.key != key or l.n + n > cap:
+                return False
+            b = _bucket_for(l.n + n)
+            if b == _bucket_for(l.n):
+                return True
+            return b - (l.n + n) <= max(b // 8, 1024)
+
+        lane = next((l for l in lanes if _fits(l)), None)
+        if lane is None:
+            if len(lanes) < max_lanes:
+                lane = Lane(key)
+                lanes.append(lane)
+            else:
+                held.append(job)
+                continue
+        lane.add(job)
+    plan = MeshPlan(lanes, max_lanes)
+    plan.empty_jobs = empty
+    return plan, held
+
+
+def pad_block(n: int, ep=None) -> EntryBlock:
+    """`n` identity padding rows as an EntryBlock: A = R = the identity
+    encoding (y = 1), s = 0, empty message — verifies trivially under
+    any challenge scalar (the `_pack_rows` padding-lane construction).
+    With a warm epoch entry `ep`, rows carry the table's identity-row
+    gather index (vp - 1) and the epoch key, so a cached superbatch's
+    padding gathers the table's own identity row."""
+    pub = np.zeros((n, 32), dtype=np.uint8)
+    sig = np.zeros((n, 64), dtype=np.uint8)
+    if n:
+        pub[:, 0] = 1
+        sig[:, 0] = 1  # R = identity encoding; s stays 0
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    val_idx = epoch_key = None
+    if ep is not None:
+        val_idx = np.full((n,), ep.vp - 1, dtype=np.int32)
+        epoch_key = ep.key
+    return EntryBlock(pub, sig, b"", offsets,
+                      val_idx=val_idx, epoch_key=epoch_key)
+
+
+def _warm_entry(plan: MeshPlan):
+    """The plan's epoch-cache entry iff every lane shares one WARM key
+    (lazy import — the cache layer is jax-free but lives behind the ops
+    package; standalone loads only exercise the packing half)."""
+    key = plan.epoch_key()
+    if key is None:
+        return None
+    try:
+        from . import epoch_cache as _epoch
+    except ImportError:  # pragma: no cover — standalone file load
+        return None
+    # lookup keys off the (epoch_key, val_idx) attrs; probe via a stub
+    class _Probe:
+        epoch_key = key
+        val_idx = True
+
+    return _epoch.lookup(_Probe())
+
+
+def build_superblock(plan: MeshPlan) -> Tuple[EntryBlock, List[Tuple]]:
+    """Materialize the plan: one EntryBlock of exactly `plan.bucket`
+    rows (live jobs + per-lane identity padding + pure-pad lanes) and
+    the global demux spans [(job, row_offset, n), ...]. Column concat is
+    one np.concatenate per column — no per-signature Python."""
+    ep = _warm_entry(plan)
+    lb = plan.lane_bucket
+    pieces: List[EntryBlock] = []
+    spans: List[Tuple] = []
+    for li in range(plan.n_lanes):
+        base = li * lb
+        if li < len(plan.lanes):
+            lane = plan.lanes[li]
+            off = 0
+            for job in lane.jobs:
+                n = len(job.entries)
+                spans.append((job, base + off, n))
+                if n:
+                    pieces.append(job.entries)
+                off += n
+            if off < lb:
+                pieces.append(pad_block(lb - off, ep))
+        else:
+            # pure identity-padding lane (lane count rounded up to pow2)
+            pieces.append(pad_block(lb, ep))
+    for job in plan.empty_jobs:
+        spans.append((job, 0, 0))
+    return EntryBlock.concat(pieces), spans
+
+
+# ---------------------------------------------------------------------------
+# Device-facing half: superbatch prep + kernel selection. Runs on the
+# pipeline's prep pool; the returned launch fn runs ONLY on the
+# dispatch-owner thread (which also owns the transfer and any lazy
+# epoch-table upload inside the cached closures).
+# ---------------------------------------------------------------------------
+
+
+def prepare_superbatch(block: EntryBlock, plan: MeshPlan):
+    """prep for a mesh superbatch. Same contract as the pipeline's
+    `_prepare` plus transfer shardings:
+
+        (launch_fn, args, None, bucket, shardings)
+
+    `shardings` is a per-arg NamedSharding tuple when the superbatch
+    launches through a real shard_map mesh (the dispatcher's
+    `device_pool.transfer` places each array lane-per-device), or None
+    on the single-device / simulated-lanes fallback.
+
+    Kernel selection mirrors `_prepare`: pallas compact on the pallas
+    backend (uncached — per-mesh coords tables are follow-up work, a
+    warm pack ships pubs); otherwise the XLA family with the same
+    device-hash choice `_prepare` makes (short messages hash on-chip)
+    and the cached gather prep when the WHOLE pack shares one warm
+    epoch. The RLC fast-accept kernel is per-lane-group incompatible
+    with row demux and stays single-device (ops/pallas_rlc)."""
+    from . import backend as _backend
+    from . import sharded as _sharded
+
+    assert _BUCKETS == _backend.BUCKETS, "bucket ladders diverged"
+    bucket = plan.bucket
+    if len(block) != bucket:
+        raise ValueError(
+            f"superblock is {len(block)} rows, plan says {bucket}"
+        )
+    donate = _backend.donate_enabled()
+    ep = _warm_entry(plan) if block.epoch_key is not None else None
+    use_mesh = plan.n_lanes > 1 and _sharded.mesh_ready(plan.n_lanes)
+    if _backend._use_pallas():
+        import jax
+
+        from . import pallas_verify as _pv
+
+        interpret = jax.default_backend() != "tpu"
+        blk = _pv.pick_block(plan.lane_bucket)
+        args = _pv.prepare_compact(block, bucket)
+        if use_mesh:
+            m = _sharded.dispatch_mesh(plan.n_lanes)
+            fn = _sharded.mesh_pallas_valid_fn(
+                m, bucket // plan.n_lanes, blk, interpret
+            )
+            shardings = _sharded.mesh_arg_shardings(m, "pallas", len(args))
+            return fn, args, None, bucket, shardings
+        fn = _pv._jitted_pallas_verify(bucket, blk, interpret, donate=donate)
+        return fn, args, None, bucket, None
+    device_hash = (
+        not _backend.HOST_HASH
+        and _backend._max_msg_len(block) <= _backend.DEVICE_HASH_MAX_MSG
+    )
+    if ep is not None:
+        if device_hash:
+            args = _backend.prepare_batch_cached_device_hash(
+                block, bucket, ep
+            )
+            kind = "cached_device_hash"
+        else:
+            args = _backend.prepare_batch_cached(block, bucket, ep)
+            kind = "cached"
+        if use_mesh:
+            m = _sharded.dispatch_mesh(plan.n_lanes)
+            fn = _sharded.mesh_valid_fn_cached(m, ep, donate, device_hash)
+            shardings = _sharded.mesh_arg_shardings(m, kind, len(args))
+            return fn, args, None, bucket, shardings
+        return (_backend.cached_kernel(ep, device_hash, donate), args,
+                None, bucket, None)
+    if device_hash:
+        args = _backend.prepare_batch_device_hash(block, bucket)
+        kind = "device_hash"
+    else:
+        args = _backend.prepare_batch(block, bucket)
+        kind = "host_hash"
+    if use_mesh:
+        m = _sharded.dispatch_mesh(plan.n_lanes)
+        fn = _sharded.mesh_valid_fn(m, donate, device_hash)
+        shardings = _sharded.mesh_arg_shardings(m, kind, len(args))
+        return fn, args, None, bucket, shardings
+    from . import ed25519_verify as _kernel
+
+    if device_hash:
+        return (_kernel.jitted_verify_device_hash(donate), args, None,
+                bucket, None)
+    return _kernel.jitted_verify(donate), args, None, bucket, None

@@ -73,6 +73,17 @@ if BLOCK <= 0 or 10240 % BLOCK:
     )
 
 
+def pick_block(n: int) -> int:
+    """Largest kernel block size dividing an n-lane (per-shard) batch —
+    the one candidate ladder shared by every sharded/mesh call site, so
+    the grid shape for a given per-shard size can never drift between
+    paths."""
+    for cand in (BLOCK, 256, 128, 64, 32, 16, 8):
+        if n % cand == 0:
+            return cand
+    return n
+
+
 # -- point ops (limb-major; mirrors ops.ed25519_verify) ---------------------
 
 

@@ -35,6 +35,7 @@ from ..types.validation import ErrNotEnoughVotingPowerSigned
 from . import backend as _backend
 from . import device_pool as _dpool
 from . import ed25519_verify as _kernel
+from . import mesh as _mesh
 from .entry_block import EntryBlock, as_block
 
 _span = _trace.span
@@ -159,10 +160,25 @@ class AsyncBatchVerifier:
     resolver. `pool_depth` (default depth + 1, env TM_TPU_POOL_DEPTH)
     bounds transferred-but-unresolved input-buffer sets per compiled
     layout (ops/device_pool.py) — one deeper than the launch bound so
-    batch k+1's H2D copy can issue while the pipeline is full."""
+    batch k+1's H2D copy can issue while the pipeline is full.
 
-    def __init__(self, depth: int = 3, pool_depth: Optional[int] = None):
+    `mesh_lanes` >= 1 (default: TM_TPU_MESH, see ops/mesh.py) switches
+    the coalescer into MESH-DISPATCHER mode (ISSUE 9): queued jobs are
+    bin-packed into per-shard lanes of one (lanes x lane_bucket)
+    superbatch per launch — same-epoch jobs share a lane, short lanes
+    pad with identity rows, verdicts demux per job on readback. The
+    dispatcher/resolver stages are UNCHANGED: a superbatch transfers,
+    launches (sharded over the mesh when jax.shard_map + devices allow,
+    simulated lanes otherwise) and reads back through the same
+    single-owner overlap machinery as a single-device batch."""
+
+    def __init__(self, depth: int = 3, pool_depth: Optional[int] = None,
+                 mesh_lanes: Optional[int] = None):
         self._depth = max(depth, 1)
+        self._mesh_lanes = (
+            _mesh.lanes_from_env() if mesh_lanes is None
+            else max(int(mesh_lanes), 0)
+        )
         if pool_depth is None:
             pool_depth = int(
                 os.environ.get("TM_TPU_POOL_DEPTH", self._depth + 1)
@@ -182,7 +198,8 @@ class AsyncBatchVerifier:
         # (the relay-ownership invariant)
         self.dispatch_thread_idents: set = set()
         self._thread = threading.Thread(
-            target=self._worker, daemon=True, name="verify-coalesce"
+            target=self._worker_mesh if self._mesh_lanes else self._worker,
+            daemon=True, name="verify-coalesce",
         )
         self._dispatch_thread = threading.Thread(
             target=self._dispatcher, daemon=True, name="verify-dispatch"
@@ -199,6 +216,10 @@ class AsyncBatchVerifier:
             raise RuntimeError("verifier is closed")
         block = as_block(entries)
         max_b = _backend.max_coalesce()
+        if self._mesh_lanes:
+            # mesh mode packs WHOLE jobs into lanes — chunk oversized
+            # submissions at the lane capacity so every chunk fits one
+            max_b = min(max_b, _mesh.lane_cap())
         if len(block) > max_b:
             return self._submit_chunked(block, max_b)
         job = _Job(block)
@@ -351,6 +372,26 @@ class AsyncBatchVerifier:
         future's value so the dispatcher's queue-wait measurement cannot
         race the done-callback machinery."""
         return cls._prepare(entries), time.perf_counter()
+
+    @staticmethod
+    def _prepare_mesh(block, plan):
+        """Host prep for a mesh superbatch (ISSUE 9): delegate to
+        ops/mesh.prepare_superbatch — same return contract as _prepare
+        plus the per-arg transfer shardings (None on simulated lanes).
+        Pad accounting uses the plan's LIVE count so pad_waste metrics
+        see the identity rows the packer added."""
+        with _span("pipeline.prep", n=plan.live, bucket=plan.bucket,
+                   lanes=plan.n_lanes,
+                   cached=int(block.epoch_key is not None)):
+            res = _mesh.prepare_superbatch(block, plan)
+        # prep timing histograms are recorded inside prepare_batch*; the
+        # dispatch counters note the LIVE rows against the full bucket
+        _backend._note_device_batch(plan.live, plan.bucket)
+        return res
+
+    @classmethod
+    def _prepare_mesh_timed(cls, block, plan):
+        return cls._prepare_mesh(block, plan), time.perf_counter()
 
     @staticmethod
     def _resolve(spans, dev, rlc_entries=None, t_dispatch: float = 0.0,
@@ -507,6 +548,102 @@ class AsyncBatchVerifier:
             self._dispatch_q.put(None)
             prep_pool.shutdown(wait=False)
 
+    def _worker_mesh(self) -> None:
+        """Mesh-dispatcher coalescer (ISSUE 9 tentpole): drain queued
+        jobs up to the full mesh capacity (lanes x lane capacity), then
+        bin-pack them into single-epoch lanes of ONE superbatch launch
+        (ops/mesh.pack_jobs). Unlike the single-lane worker there is no
+        epoch-key gate on draining — differing epochs land in different
+        LANES of the same launch instead of serializing into separate
+        launches. Jobs that fit no lane are held for the next superbatch
+        (the bucket-overflow hold, generalized). This thread never
+        touches the device; the dispatcher/resolver stages downstream
+        are shared with the single-lane mode unchanged."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        prep_pool = ThreadPoolExecutor(3, thread_name_prefix="verify-prep")
+        held: List[_Job] = []
+        max_lanes = self._mesh_lanes
+        m = _backend._ops_m()
+        try:
+            while True:
+                jobs = held
+                held = []
+                if not jobs:
+                    try:
+                        jobs = [self._q.get(timeout=0.05)]
+                    except queue.Empty:
+                        if self._stopped.is_set() and self._q.empty():
+                            break
+                        continue
+                # cap re-read per superbatch: submit() reads it per call,
+                # so a knob change mid-run must not strand a job that was
+                # legal when it was accepted
+                cap = _mesh.lane_cap()
+                total = sum(len(j.entries) for j in jobs)
+                budget = max_lanes * cap
+                # same coalescing-window rationale as _worker: while the
+                # pipeline is busy a short linger fuses stragglers into
+                # fuller lanes for free
+                busy = self._inflight > 0 or self._dispatch_q.qsize() > 0
+                deadline = time.monotonic() + 0.008 if busy else 0.0
+                while total < budget:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        wait = deadline - time.monotonic()
+                        if wait <= 0:
+                            break
+                        try:
+                            nxt = self._q.get(timeout=wait)
+                        except queue.Empty:
+                            break
+                    jobs.append(nxt)
+                    total += len(nxt.entries)
+                # Coalescer survival invariant (the dispatcher's PR-6
+                # rule extended to the new packing stage): a poisoned
+                # pack fails ONLY the drained jobs' futures — the worker
+                # thread itself never dies on a batch's account.
+                try:
+                    plan, held = _mesh.pack_jobs(jobs, max_lanes, cap)
+                    if not plan.lanes:
+                        # nothing live: empty submissions resolve right
+                        # here, no launch
+                        for j in plan.empty_jobs:
+                            if not j.future.done():
+                                j.future.set_result(
+                                    np.zeros(0, dtype=bool)
+                                )
+                        continue
+                    m.pipeline_coalesced_jobs.observe(
+                        sum(len(l.jobs) for l in plan.lanes)
+                    )
+                    with _span("pipeline.mesh_pack", lanes=plan.n_lanes,
+                               lane_bucket=plan.lane_bucket,
+                               live=plan.live, pad=plan.pad):
+                        block, spans = _mesh.build_superblock(plan)
+                    m.mesh_lane_occupancy.set(plan.occupancy())
+                    m.mesh_pad_waste_ratio.set(plan.pad_ratio())
+                    fut = prep_pool.submit(
+                        self._prepare_mesh_timed, block, plan
+                    )
+                except Exception as e:  # noqa: BLE001 — pack isolation
+                    self._fail_spans(
+                        [(j, 0, len(j.entries)) for j in jobs],
+                        self._wrap_dispatch_err(
+                            "mesh pack failed", e, 0,
+                            [(j, 0, 0) for j in jobs],
+                        ),
+                    )
+                    held = []
+                    continue
+                self._dispatch_q.put((spans, fut, time.perf_counter()))
+                m.dispatch_queue_depth.set(self._dispatch_q.qsize())
+                m.pipeline_queue_depth.set(self._q.qsize())
+        finally:
+            self._dispatch_q.put(None)
+            prep_pool.shutdown(wait=False)
+
     def _dispatcher(self) -> None:
         """The dispatch-owner: the ONLY thread that touches the relay —
         it issues the host->device transfers AND launches the kernels,
@@ -559,7 +696,12 @@ class AsyncBatchVerifier:
             try:
                 m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 try:
-                    (f, args, rlc_entries, bucket), t_ready = fut.result()
+                    prep, t_ready = fut.result()
+                    # mesh preps append per-arg transfer shardings as a
+                    # 5th element (lane-per-device placement); classic
+                    # preps stay 4-tuples
+                    shardings = prep[4] if len(prep) > 4 else None
+                    f, args, rlc_entries, bucket = prep[:4]
                 except Exception as e:  # noqa: BLE001 — prep-stage failure
                     self._fail_spans(spans, self._wrap_dispatch_err(
                         "batch prep failed", e, 0, spans))
@@ -586,7 +728,13 @@ class AsyncBatchVerifier:
                     )
                     hidden = self._inflight > 0
                     t_x0 = time.perf_counter()
-                    dev_args = _dpool.transfer(args)
+                    # positional call when unsharded: test doubles (and
+                    # any older transfer impl) keep their (args)-only
+                    # signature working
+                    if shardings is None:
+                        dev_args = _dpool.transfer(args)
+                    else:
+                        dev_args = _dpool.transfer(args, shardings=shardings)
                     t_x1 = time.perf_counter()
                     if slot is not None:
                         slot.arrays = dev_args

@@ -15,6 +15,8 @@ shape the driver's `dryrun_multichip` exercises.
 
 from __future__ import annotations
 
+import functools
+import logging
 from typing import List, Tuple
 
 import jax
@@ -28,7 +30,53 @@ from . import ed25519_verify as _kernel
 
 _span = _trace.span
 
+_log = logging.getLogger("tendermint_tpu.ops.sharded")
+
 AXIS = "dp"
+
+
+@functools.lru_cache(maxsize=1)
+def shard_map_available() -> bool:
+    """ONE-TIME capability probe (ISSUE 9 satellite): does this jax ship
+    `jax.shard_map`? Older versions (e.g. 0.4.37 in some containers)
+    don't, and the sharded builders used to re-raise the ImportError on
+    EVERY warm block that auto-dispatched here — the probe result is
+    cached so the fallback decision costs one boolean test per batch."""
+    try:
+        from jax import shard_map  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+_fallback_warned: set = set()
+
+
+def _warn_fallback(where: str) -> None:
+    """Warn ONCE per entry point when the sharded path degrades to
+    single-device dispatch (jax.shard_map unavailable, or fewer devices
+    than requested lanes) — not once per batch."""
+    if where in _fallback_warned:
+        return
+    _fallback_warned.add(where)
+    _log.warning(
+        "%s: jax.shard_map unavailable on this jax version — falling "
+        "back to single-device dispatch of the same superbatch "
+        "(bit-identical verdicts, no mesh parallelism). Logged once.",
+        where,
+    )
+
+
+def _host_tally(valid: np.ndarray, pw: np.ndarray, live: np.ndarray,
+                n: int) -> Tuple[np.ndarray, int, bool]:
+    """The psum tally's host equivalent for the single-device fallback:
+    sum the base-2^16 power lanes of valid live rows, fold, and compute
+    the all-valid bit. `valid` must already be an owned bool array."""
+    ok = valid & live
+    lanes = pw[ok].sum(axis=0, dtype=np.int64)
+    all_valid = not bool((live & ~valid).any())
+    return valid[:n], join_power(lanes), all_valid
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -129,6 +177,14 @@ def verify_commit_sharded(
         live[:n] = True
         pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
         pw[:n] = split_power(np.asarray(powers[:n]))
+    if not shard_map_available():
+        # warn-once fallback (ISSUE 9 satellite): same kernel math over
+        # the same padded batch on one device, tally folded on the host
+        _warn_fallback("verify_commit_sharded")
+        with _span("sharded.device", n=n, bucket=bucket, fallback=1):
+            kern = _kernel.jitted_verify(_backend.donate_enabled())
+            valid = np.array(kern(*args)).astype(bool)
+        return _host_tally(valid, pw, live, n)
     fn, _ = _jitted_for(mesh)
     with _span("sharded.device", n=n, bucket=bucket):
         valid, lanes, all_valid = fn(*args, pw, live)
@@ -161,27 +217,19 @@ def _jitted_for(mesh: Mesh):
 # epoch cache). Table replication happens once per (epoch, mesh).
 # ---------------------------------------------------------------------------
 
-_shard_tbl_cache: dict = {}
-
 
 def epoch_tables_sharded(ep, mesh: Mesh):
     """The epoch's XLA limb/sign tables placed with a REPLICATED
     NamedSharding over `mesh` — per-shard residency, uploaded once per
-    (epoch key, mesh). Returns (limbs (vp, 20), sign (vp,)) jax Arrays."""
-    from . import backend as _b
+    (epoch key, mesh). Returns (limbs (vp, 20), sign (vp,)) jax Arrays.
 
-    key = (ep.key, tuple(d.id for d in mesh.devices.flat))
-    t = _shard_tbl_cache.get(key)
-    if t is None:
-        limbs = _b._pack_le_limbs(ep.pub_rows)
-        sign = (ep.pub_rows[:, 31] >> 7).astype(np.int32)
-        repl = NamedSharding(mesh, P())
-        t = (jax.device_put(limbs, repl), jax.device_put(sign, repl))
-        _shard_tbl_cache[key] = t
-        # bound growth: tables are small, but meshes*epochs churn in tests
-        while len(_shard_tbl_cache) > 16:
-            _shard_tbl_cache.pop(next(iter(_shard_tbl_cache)))
-    return t
+    ISSUE 9 (b): mesh-keyed tables live INSIDE the epoch's cache entry
+    (EpochEntry._dev, keyed ("xla_sharded", device ids)) instead of a
+    module-level side table, so the PR-5 LRU owns their lifetime — an
+    evicted epoch drops its mesh replicas with its single-device
+    layouts, and the upload runs under the entry lock on the dispatch-
+    owner thread (devcheck note_relay_touch covers it)."""
+    return ep.sharded_xla_tables(mesh)
 
 
 def _commit_step_cached(tbl_limbs, tbl_sign, idx, r_enc, s_enc, k_enc,
@@ -207,7 +255,7 @@ def sharded_commit_verifier_cached(mesh: Mesh, donate: bool = False):
 
     donate=True donates ONLY the per-signature batch args (argnums 2+,
     fresh host arrays every call) — the replicated epoch tables (argnums
-    0-1) live in _shard_tbl_cache across calls and donating them would
+    0-1) live in the epoch entry's mesh-keyed cache across calls and donating them would
     invalidate every later call's table reference (ISSUE 7: the
     donation-safety rule under the replicated-table path)."""
     from jax import shard_map
@@ -254,8 +302,19 @@ def verify_commit_sharded_cached(
         live[:n] = True
         pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
         pw[:n] = split_power(np.asarray(powers[:n]))
-    tbl = epoch_tables_sharded(ep, mesh)
     donate = _backend.donate_enabled()
+    if not shard_map_available():
+        # warn-once fallback (ISSUE 9 satellite): the warm block still
+        # rides the CACHED kernel (single-device table, device gather +
+        # unpack), host tally — previously every warm auto-dispatch
+        # re-raised the shard_map ImportError
+        _warn_fallback("verify_commit_sharded_cached")
+        with _span("sharded.device", n=n, bucket=bucket, cached=1,
+                   fallback=1):
+            kern = _backend.cached_kernel(ep, False, donate)
+            valid = np.array(kern(*args)).astype(bool)
+        return _host_tally(valid, pw, live, n)
+    tbl = epoch_tables_sharded(ep, mesh)
     key = ("cached", tuple(d.id for d in mesh.devices.flat), donate)
     if key not in _mesh_cache:
         _mesh_cache[key] = sharded_commit_verifier_cached(mesh, donate)
@@ -345,11 +404,7 @@ def verify_commit_sharded_pallas(
     if bucket % nd:
         bucket += nd - bucket % nd
     per_shard = bucket // nd
-    block = per_shard
-    for cand in (_pv.BLOCK, 256, 128, 64, 32, 16, 8):
-        if per_shard % cand == 0:
-            block = cand
-            break
+    block = _pv.pick_block(per_shard)
     interpret = jax.default_backend() != "tpu"
     with _span("sharded.host_prep", n=n, bucket=bucket):
         a_t, r_t, s_t, k_t, sok_t = _pv.prepare_compact(entries, bucket)
@@ -357,6 +412,12 @@ def verify_commit_sharded_pallas(
         live[:n] = True
         pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
         pw[:n] = split_power(np.asarray(powers[:n]))
+    if not shard_map_available():
+        _warn_fallback("verify_commit_sharded_pallas")
+        with _span("sharded.device", n=n, bucket=bucket, fallback=1):
+            kern = _pv._jitted_pallas_verify(bucket, block, interpret)
+            valid = np.array(kern(a_t, r_t, s_t, k_t, sok_t))[0].astype(bool)
+        return _host_tally(valid, pw, live, n)
     key = ("pallas", tuple(d.id for d in mesh.devices.flat), per_shard, block,
            interpret)
     if key not in _mesh_cache:
@@ -465,16 +526,30 @@ def verify_commit_sharded_rlc(
         pw = np.zeros((bucket, POWER_LANES), dtype=np.int32)
         pw[:n] = split_power(np.asarray(powers[:n]))
     interpret = jax.default_backend() != "tpu"
-    key = ("rlc", tuple(d.id for d in mesh.devices.flat), g_shard, block,
-           interpret)
-    if key not in _mesh_cache:
-        _mesh_cache[key] = sharded_rlc_verifier(mesh, g_shard, block, interpret)
-    with _span("sharded.device", n=n, bucket=bucket):
-        lane_valid, lanes_pw, all_valid = _mesh_cache[key](
-            a_t, r_t, scal_t, sok_t, pw, live
+    if not shard_map_available():
+        _warn_fallback("verify_commit_sharded_rlc")
+        with _span("sharded.device", n=n, bucket=bucket, fallback=1):
+            kern = _pr._jitted_rlc_verify(g, block, interpret)
+            lane_valid = np.array(
+                kern(a_t, r_t, scal_t, sok_t)
+            )[0].astype(bool)
+        sig_valid = np.repeat(lane_valid, m)
+        tallied = join_power(
+            pw[sig_valid & live].sum(axis=0, dtype=np.int64)
         )
-        lane_valid = np.asarray(lane_valid)
-    tallied = join_power(lanes_pw)
+        all_valid = not bool((live & ~sig_valid).any())
+    else:
+        key = ("rlc", tuple(d.id for d in mesh.devices.flat), g_shard, block,
+               interpret)
+        if key not in _mesh_cache:
+            _mesh_cache[key] = sharded_rlc_verifier(mesh, g_shard, block,
+                                                    interpret)
+        with _span("sharded.device", n=n, bucket=bucket):
+            lane_valid, lanes_pw, all_valid = _mesh_cache[key](
+                a_t, r_t, scal_t, sok_t, pw, live
+            )
+            lane_valid = np.asarray(lane_valid)
+        tallied = join_power(lanes_pw)
     # lane verdicts -> per-sig verdicts + host re-verify of rejected
     # lanes (shared with the single-chip path), then add the rescued
     # signatures' power back into the device tally
@@ -482,3 +557,180 @@ def verify_commit_sharded_rlc(
     rescued = per_sig & ~np.repeat(lane_valid, m)[:n]
     tallied += sum(int(powers[i]) for i in np.nonzero(rescued)[0])
     return per_sig, tallied, bool(per_sig.all()) if n else bool(all_valid)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-dispatcher kernels (ISSUE 9 tentpole): valid-bits-only variants of
+# the sharded verifiers for the pipeline's lane-packed superbatches. The
+# dispatcher needs the per-row verdict vector and nothing else — blame and
+# tallies are demuxed per job on the host — so these skip the psum
+# collectives entirely: each device verifies its lane(s), the output
+# shards back along the batch axis. Built once per (mesh, variant) in
+# _mesh_cache; called ONLY from the dispatch-owner thread.
+# ---------------------------------------------------------------------------
+
+
+def mesh_ready(n_lanes: int) -> bool:
+    """Can a real shard_map mesh serve `n_lanes` lanes? False degrades
+    the mesh dispatcher to simulated lanes (same superbatch, plain
+    kernel, warn-once) — the tier-1/CPU face."""
+    if not shard_map_available():
+        _warn_fallback("mesh_dispatch")
+        return False
+    if len(jax.devices()) < n_lanes:
+        if "mesh_dispatch_devices" not in _fallback_warned:
+            _fallback_warned.add("mesh_dispatch_devices")
+            _log.warning(
+                "mesh dispatcher asked for %d lanes but only %d devices "
+                "are visible — running simulated lanes on one device. "
+                "Logged once.", n_lanes, len(jax.devices()),
+            )
+        return False
+    return True
+
+
+_dispatch_meshes: dict = {}
+
+
+def dispatch_mesh(n_lanes: int) -> Mesh:
+    """The dispatcher's mesh over the first `n_lanes` devices (cached —
+    Mesh construction is cheap but the _mesh_cache keys off device ids,
+    so reusing the object keeps the jit caches warm)."""
+    m = _dispatch_meshes.get(n_lanes)
+    if m is None:
+        m = _dispatch_meshes[n_lanes] = make_mesh(n_lanes)
+    return m
+
+
+def mesh_valid_fn(mesh: Mesh, donate: bool = False,
+                  device_hash: bool = False):
+    """Jitted shard_map of the bare per-sig verify kernel: uncached args
+    sharded lane-per-device, (B,) bool verdicts out. `device_hash` picks
+    the on-chip-SHA kernel (R||A||M block rows ship instead of host
+    challenges — the same selection the classic `_prepare` makes)."""
+    key = ("mesh_valid", tuple(d.id for d in mesh.devices.flat), donate,
+           device_hash)
+    if key not in _mesh_cache:
+        from jax import shard_map
+
+        if device_hash:
+            body = _kernel.verify_kernel_device_hash
+            # a_limbs/sign, r_limbs/sign, s_bits, hi, lo, counts, s_ok —
+            # the SHA block rows are (B, NBLOCK, 16): batch axis leads
+            specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(None, AXIS),
+                     P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+            n_args = 9
+        else:
+            body = _kernel.verify_kernel
+            specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                     P(None, AXIS), P(None, AXIS), P(AXIS))
+            n_args = 7
+        fn = shard_map(body, mesh=mesh, in_specs=specs, out_specs=P(AXIS))
+        _mesh_cache[key] = (
+            jax.jit(fn, donate_argnums=tuple(range(n_args))) if donate
+            else jax.jit(fn)
+        )
+    return _mesh_cache[key]
+
+
+def mesh_valid_fn_cached(mesh: Mesh, ep, donate: bool = False,
+                         device_hash: bool = False):
+    """Cached-epoch mesh kernel closure: each shard gathers committee
+    rows from its replicated table copy (epoch_tables_sharded — resident
+    per device, owned by the epoch LRU) and unpacks the raw per-sig rows
+    on device. The table resolves at CALL time, on the dispatch-owner
+    thread, exactly like backend.cached_kernel."""
+    key = ("mesh_valid_cached",
+           tuple(d.id for d in mesh.devices.flat), donate, device_hash)
+    if key not in _mesh_cache:
+        from jax import shard_map
+
+        if device_hash:
+            body = _kernel.verify_kernel_cached_device_hash
+            # idx, r, s, hi (B, NB, 16), lo, counts, s_ok
+            specs = (P(None, None), P(None),
+                     P(AXIS), P(AXIS), P(AXIS),
+                     P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+            n_args = 9
+        else:
+            body = _kernel.verify_kernel_cached
+            specs = (P(None, None), P(None),              # tables
+                     P(AXIS), P(AXIS), P(AXIS), P(AXIS),  # idx, r, s, k
+                     P(AXIS))                             # s_ok
+            n_args = 7
+        fn = shard_map(body, mesh=mesh, in_specs=specs, out_specs=P(AXIS))
+        _mesh_cache[key] = (
+            jax.jit(fn, donate_argnums=tuple(range(2, n_args))) if donate
+            else jax.jit(fn)
+        )
+    base = _mesh_cache[key]
+
+    def call(*args):
+        tbl_limbs, tbl_sign = epoch_tables_sharded(ep, mesh)
+        return base(tbl_limbs, tbl_sign, *args)
+
+    return call
+
+
+def mesh_pallas_valid_fn(mesh: Mesh, n_per_shard: int, block: int,
+                         interpret: bool):
+    """Compact-pallas mesh kernel, valid bits only: batch-minor args
+    shard on their LAST axis (one lane per device), verdict row out."""
+    key = ("mesh_pallas_valid", tuple(d.id for d in mesh.devices.flat),
+           n_per_shard, block, interpret)
+    if key not in _mesh_cache:
+        from jax import shard_map
+
+        from . import pallas_verify as _pv
+
+        if interpret:
+            kern = _pv._jitted_pallas_verify(n_per_shard, block, interpret)
+        else:
+            kern = _pv._jitted_pallas_verify(
+                n_per_shard, block, interpret, vma=frozenset({AXIS})
+            )
+
+        def _step(a_t, r_t, s_t, k_t, sok_t):
+            return kern(a_t, r_t, s_t, k_t, sok_t)[0].astype(bool)
+
+        fn = shard_map(
+            _step,
+            mesh=mesh,
+            in_specs=(
+                P(None, AXIS), P(None, AXIS), P(None, AXIS),
+                P(None, AXIS), P(None, AXIS),
+            ),
+            out_specs=P(AXIS),
+            # same vma rationale as sharded_pallas_verifier above
+            check_vma=not interpret,
+        )
+        _mesh_cache[key] = jax.jit(fn)
+    return _mesh_cache[key]
+
+
+_MESH_SPECS = {
+    # host-hash uncached: limbs/sign/bits/s_ok (backend.prepare_batch)
+    "host_hash": (P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                  P(None, AXIS), P(None, AXIS), P(AXIS)),
+    # device-hash uncached: limbs/sign/s_bits + (B, NB, 16) SHA rows
+    "device_hash": (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(None, AXIS),
+                    P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+    # warm-epoch gather args: idx + raw r/s/k rows + s_ok
+    "cached": (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+    # warm-epoch device-hash: idx + raw r/s + SHA rows + s_ok
+    "cached_device_hash": (P(AXIS),) * 7,
+    # compact pallas: batch-minor, shard the last axis
+    "pallas": (P(None, AXIS),) * 5,
+}
+
+
+def mesh_arg_shardings(mesh: Mesh, kind: str, n_args: int):
+    """Per-arg NamedShardings for device_pool.transfer — batch k+1's H2D
+    copies land lane-per-device (overlapping the mesh kernel k exactly
+    like the single-device overlap path; ISSUE 9 tentpole piece c)."""
+    specs = _MESH_SPECS[kind]
+    if len(specs) != n_args:
+        raise ValueError(
+            f"{kind} superbatch has {n_args} args, specs cover {len(specs)}"
+        )
+    return tuple(NamedSharding(mesh, p) for p in specs)

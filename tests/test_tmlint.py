@@ -236,6 +236,48 @@ class TestRelayOwnership:
         """
         assert not lint(src, REACTOR_PATH, "relay-ownership")
 
+    def test_positive_mesh_launch_outside_whitelist(self):
+        """ISSUE 9 satellite: a non-whitelisted mesh superbatch launch —
+        building the mesh kernel or touching the replicated epoch
+        tables outside the dispatcher modules — is flagged."""
+        src = """
+            from tendermint_tpu.ops import sharded
+
+            def sneaky_mesh_verify(mesh, args):
+                fn = sharded.mesh_valid_fn(mesh, donate=True)
+                return fn(*args)
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["relay-ownership"]
+        src_tbl = """
+            def sneaky_tables(ep, mesh):
+                return ep.sharded_xla_tables(mesh)
+        """
+        assert rules_of(lint(src_tbl, REACTOR_PATH)) == ["relay-ownership"]
+        src_sh = """
+            from tendermint_tpu.ops.sharded import epoch_tables_sharded
+
+            def sneaky(ep, mesh):
+                return epoch_tables_sharded(ep, mesh)
+        """
+        assert rules_of(lint(src_sh, REACTOR_PATH)) == ["relay-ownership"]
+
+    def test_negative_mesh_module_is_whitelisted(self):
+        src = """
+            def prep(block, plan, _sharded, mesh):
+                fn = _sharded.mesh_valid_fn_cached(mesh, None)
+                return fn
+        """
+        assert not lint(src, "tendermint_tpu/ops/mesh.py",
+                        "relay-ownership")
+        # the packing entry point itself is an ENTRY_POINT elsewhere
+        src_prep = """
+            from tendermint_tpu.ops import mesh
+
+            def f(block, plan):
+                return mesh.prepare_superbatch(block, plan)
+        """
+        assert rules_of(lint(src_prep, REACTOR_PATH)) == ["relay-ownership"]
+
 
 class TestSimnetDeterminism:
     def test_positive_wall_clock(self):

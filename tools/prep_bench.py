@@ -448,6 +448,201 @@ def run_overlap(args) -> int:
     return rc
 
 
+def run_mesh(args) -> int:
+    """--mesh: the round-9 mesh-dispatcher gate, on a mocked 2-lane mesh
+    (this box has one device; lane packing + demux is exactly the
+    machinery that must be right WITHOUT mesh hardware). The kernel runs
+    for real — verdicts are live — behind a slow-readback mock so the
+    overlap stages engage like a relay-attached mesh. Asserts:
+
+      pack     deterministic plan shapes: 3 full jobs over a 4-lane plan
+               leave one PURE identity-padding lane; per-lane single-
+               epoch packing holds; spans tile the live rows exactly
+      parity   every job's mesh-packed verdict row is bit-identical to
+               the single-device path's (backend.verify_batch), and the
+               blame index (first invalid lane) of a tampered job
+               survives the demux
+      pool     zero slot leak once drained (in_flight == 0)
+      owner    transfers and launches all ran on ONE thread — the relay
+               single-owner invariant extends to the mesh superbatch
+      overlap  superbatch k+1's transfer is issued before batch k
+               resolves (the ISSUE 7 machinery generalized to lane-
+               packed launches)
+      gauges   mesh_lane_occupancy + mesh_pad_waste_ratio published and
+               complementary
+    """
+    import numpy as np
+
+    from tendermint_tpu.libs import jaxcache
+    from tendermint_tpu.libs.metrics import ops_stats
+
+    # persistent kernel cache: the 2-lane superbatch shape compiles once
+    # per machine, not once per gate run
+    import jax
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import backend, mesh as ms, pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_mesh_prepare
+    from tendermint_tpu.ops.entry_block import EntryBlock
+
+    os.environ["TM_TPU_MESH_LANE_BUCKET"] = "128"
+    resolve_delay = 0.15
+    rng = np.random.RandomState(11)
+
+    def rand_batch(n, tag):
+        """Structurally-valid random entries — pack/plan checks only."""
+        return EntryBlock.from_entries([
+            (
+                rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+                b"mesh-%d-%d" % (tag, i),
+                rng.randint(0, 256, 64, dtype=np.uint8).tobytes(),
+            )
+            for i in range(n)
+        ])
+
+    from tendermint_tpu.crypto import ed25519
+
+    def signed_batch(n, tag, bad=()):
+        """REAL signatures (parity and blame must see live verdicts),
+        with `bad` lane indices tampered."""
+        out = []
+        for i in range(n):
+            sk = ed25519.gen_priv_key(
+                (tag * 1000 + i + 1).to_bytes(32, "little")
+            )
+            m = b"mesh-%d-%d" % (tag, i)
+            sig = sk.sign(m) if i not in bad else b"\x07" * 64
+            out.append((sk.pub_key().bytes(), m, sig))
+        return EntryBlock.from_entries(out)
+
+    print("prep_bench --mesh: lanes=2 lane_bucket=128 "
+          f"resolve_delay={resolve_delay}s")
+    rc = 0
+
+    # -- pack determinism (no kernel): pure-pad lane + span tiling ------
+    class _J:
+        def __init__(self, blk):
+            self.entries = blk
+
+    plan, held = ms.pack_jobs(
+        [_J(rand_batch(128, 90)), _J(rand_batch(128, 91)),
+         _J(rand_batch(128, 92))], 4, 128
+    )
+    block, spans = ms.build_superblock(plan)
+    pure_pad = plan.n_lanes - len(plan.lanes)
+    rows = np.zeros(plan.bucket, dtype=bool)
+    for _, off, n in spans:
+        if rows[off:off + n].any():
+            print("  FAIL: demux spans overlap", file=sys.stderr)
+            rc = 1
+        rows[off:off + n] = True
+    pad_rows = block.pub[plan.live:]
+    pad_ok = bool(
+        (pad_rows[:, 0] == 1).all() and (pad_rows[:, 1:] == 0).all()
+    )
+    print(f"  plan: lanes={plan.n_lanes} (pure-pad={pure_pad}) "
+          f"live={plan.live} pad={plan.pad} span_rows={int(rows.sum())} "
+          f"identity_pad={'OK' if pad_ok else 'BROKEN'}")
+    if held or pure_pad != 1 or int(rows.sum()) != plan.live or not pad_ok:
+        print("  FAIL: 3 full jobs over 4 lanes must pack 3 live lanes + "
+              "1 pure identity-pad lane with exact span tiling",
+              file=sys.stderr)
+        rc = 1
+
+    # -- live pipeline: parity / blame / pool / owner / overlap ---------
+    # job 3 carries one tampered lane (row 17) so the demuxed blame
+    # index is checkable against live verdicts
+    jobs = [
+        signed_batch(n, t, bad=(17,) if t == 3 else ())
+        for t, n in enumerate((96, 31, 5, 128, 64, 7))
+    ]
+    pl.AsyncBatchVerifier._prepare_mesh = staticmethod(
+        slow_mesh_prepare(pl.AsyncBatchVerifier._prepare_mesh,
+                          resolve_delay)
+    )
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    v = pl.AsyncBatchVerifier(depth=1, pool_depth=OVERLAP_POOL_DEPTH,
+                              mesh_lanes=2)
+    try:
+        v.submit(jobs[0][0:16]).result(timeout=600)  # warm: compile
+        futs = [v.submit(j) for j in jobs]
+        res = [np.asarray(f.result(timeout=600)) for f in futs]
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+        stats = ops_stats()
+    finally:
+        tr.configure(enabled=False)
+        v.close()
+
+    mism = None
+    for i, (j, r) in enumerate(zip(jobs, res)):
+        want = backend.verify_batch(j)
+        if not np.array_equal(r, np.asarray(want)):
+            mism = i
+    # live-verdict blame: ONLY job 3's row 17 fails across the pack
+    blame_ok = bool(
+        not res[3][17] and res[3].sum() == len(res[3]) - 1
+        and all(r.all() for i, r in enumerate(res) if i != 3)
+    )
+    print(f"  verdict parity vs single-device: "
+          f"{'OK' if mism is None else f'MISMATCH job {mism}'}")
+    print(f"  tampered-lane blame demux       : "
+          f"{'OK' if blame_ok else 'LOST'}")
+    if mism is not None or not blame_ok:
+        rc = 1
+
+    evs = {"pipeline.transfer": [], "pipeline.dispatch": [],
+           "pipeline.device_wait": []}
+    tids = set()
+    for name, start, end, tid, sargs in tr.TRACER.events():
+        if name in evs:
+            evs[name].append((start, end, sargs or {}))
+        if name in ("pipeline.transfer", "pipeline.dispatch"):
+            tids.add(tid)
+    for k in evs:
+        evs[k].sort()
+    xfers = evs["pipeline.transfer"]
+    waits = evs["pipeline.device_wait"]
+    nb = len(xfers)
+    overlapped = sum(
+        1 for i in range(1, min(nb, len(waits)))
+        if xfers[i][0] < waits[i - 1][1]
+    )
+    print(f"  superbatches launched           : {nb}")
+    print(f"  transfer k+1 < resolve k        : {overlapped}/{max(nb-1, 0)}")
+    print(f"  transfer+dispatch threads       : {len(tids)}")
+    print(f"  pool                            : {pool}")
+    print(f"  mesh_lane_occupancy={stats['mesh_lane_occupancy']:.4f} "
+          f"mesh_pad_waste_ratio={stats['mesh_pad_waste_ratio']:.4f}")
+    if nb < 2:
+        print("  FAIL: expected >= 2 superbatch launches", file=sys.stderr)
+        rc = 2
+    elif overlapped < 1:
+        print("  FAIL: no superbatch transfer overlapped the previous "
+              "batch's resolve (mesh dispatcher is serial?)",
+              file=sys.stderr)
+        rc = 1
+    if len(tids) != 1:
+        print(f"  FAIL: transfers/launches ran on {len(tids)} threads "
+              "(single relay owner violated)", file=sys.stderr)
+        rc = 1
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    occ = stats["mesh_lane_occupancy"]
+    padr = stats["mesh_pad_waste_ratio"]
+    if not (0.0 < occ <= 1.0) or abs((occ + padr) - 1.0) > 1e-9:
+        print(f"  FAIL: occupancy {occ} + pad waste {padr} must be "
+              "complementary and published", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -477,6 +672,13 @@ def main() -> int:
         "before blocking on kernel k (span-order proxy with a slow mock "
         "readback) and the buffer pool keeps steady-state allocations flat",
     )
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="round-9 gate: mesh-dispatcher lane packing on a mocked "
+        "2-lane mesh — pack/demux parity + blame, pure-pad-lane plan "
+        "shape, zero slot leak, single relay owner, superbatch overlap",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -484,6 +686,8 @@ def main() -> int:
         return run_transfer(args)
     if args.overlap:
         return run_overlap(args)
+    if args.mesh:
+        return run_mesh(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

@@ -34,6 +34,7 @@ WHITELIST = frozenset({
     "tendermint_tpu/ops/pallas_rlc.py",
     "tendermint_tpu/ops/pallas_sr25519.py",
     "tendermint_tpu/ops/sharded.py",
+    "tendermint_tpu/ops/mesh.py",          # mesh-dispatcher packing + prep
     "tendermint_tpu/ops/mixed.py",
     "tendermint_tpu/ops/_testing.py",      # test scaffolding, not production
 })
@@ -53,6 +54,14 @@ ENTRY_POINTS = frozenset({
     "verify_kernel_cached",
     "xla_tables",
     "coords_tables",
+    # mesh dispatcher (ISSUE 9): superbatch launch builders + the
+    # replicated epoch-table uploads
+    "mesh_valid_fn",
+    "mesh_valid_fn_cached",
+    "mesh_pallas_valid_fn",
+    "epoch_tables_sharded",
+    "sharded_xla_tables",
+    "prepare_superbatch",
 })
 
 # `transfer` is a common word; only flag it on a device_pool-ish receiver
