@@ -248,10 +248,16 @@ def worker() -> None:
     # the JSON line carries a per-component breakdown (ISSUE 1 satellite —
     # BENCH_r*.json trajectories get a host/device split, not just a
     # single rate). Record overhead is ~µs on ~100ms ops.
+    # TM_TPU_BENCH_TRACE=0 turns the per-rep tracing off; span_summary
+    # then honestly reports {"tracing": false} with the stats OMITTED
+    # (ISSUE 10 satellite — a 0.0 p50 that means "not measured" poisons
+    # every downstream trajectory that averages it).
     from tendermint_tpu.observability import trace as _tr
 
-    _tr.TRACER.clear()
-    _tr.configure(enabled=True)
+    trace_on = os.environ.get("TM_TPU_BENCH_TRACE", "1") not in ("", "0")
+    if trace_on:
+        _tr.TRACER.clear()
+        _tr.configure(enabled=True)
     reps = 5 if on_accel else 1
     rep_times = []
     rep_preps = []
@@ -298,7 +304,7 @@ def worker() -> None:
     single_s = statistics.median(rep_times) / n_sigs
     prep_med = statistics.median(rep_preps)
 
-    _span_stats = _tr.TRACER.summary()
+    _span_stats = _tr.TRACER.summary() if trace_on else {}
     _tr.configure(enabled=False)
     # host_gil_ms_per_commit: estimated GIL-HELD host milliseconds per
     # n_sigs commit prep — the quantity that bounds concurrent
@@ -318,7 +324,8 @@ def worker() -> None:
     _gil_ms = _prep_p50 - (
         _released_ms if _load_native_for_gil() is not None else 0.0
     )
-    span_summary = {
+    span_summary = {"tracing": False} if not trace_on else {
+        "tracing": True,
         "host_prep_ms_p50": round(
             _span_stats.get("bench.host_prep", {}).get("p50_ms", 0.0), 3
         ),
@@ -444,7 +451,7 @@ def worker() -> None:
         try:
             jobs = _build_commit_jobs(n_sigs, n_commits=8)
             sus_rate, attempts = _bench_verify_commit_stream(
-                jobs, n_sigs, measure_rtt
+                jobs, n_sigs, measure_rtt, traced=trace_on
             )
         except Exception as e:  # noqa: BLE001
             import traceback
@@ -466,29 +473,34 @@ def worker() -> None:
             }
 
         span_summary["stream_rate_spread_sigs_per_s"] = _spread("rate")
-        span_summary["stream_queue_wait_ms_p50"] = _spread(
-            "queue_wait_ms_p50"
-        )
-        span_summary["stream_dispatch_relay_ms_p50"] = _spread(
-            "dispatch_relay_ms_p50"
-        )
-        # overlapped-relay accounting (ISSUE 7): per-attempt H2D time
-        # hidden behind device compute, and the overlap ratio spread —
-        # the 0.8x-kernel / <=15%-spread acceptance is checkable from
-        # this artifact alone
-        span_summary["stream_transfer_hidden_ms"] = _spread(
-            "transfer_hidden_ms"
-        )
-        span_summary["stream_overlap_ratio"] = _spread("overlap_ratio")
-        # mesh dispatcher (ISSUE 9): per-attempt lane-packing efficiency
-        # (all-zero when TM_TPU_MESH is off — the classic dispatcher
-        # records no mesh_pack spans)
-        span_summary["stream_mesh_lane_occupancy"] = _spread(
-            "mesh_lane_occupancy"
-        )
-        span_summary["stream_mesh_pad_waste_ratio"] = _spread(
-            "mesh_pad_waste_ratio"
-        )
+        # span-derived spreads exist only when the per-attempt tracer ran
+        # — with TM_TPU_BENCH_TRACE=0 the keys are OMITTED, not zeroed
+        # (downstream consumers key on presence, bench_report tolerates
+        # absence)
+        if trace_on:
+            span_summary["stream_queue_wait_ms_p50"] = _spread(
+                "queue_wait_ms_p50"
+            )
+            span_summary["stream_dispatch_relay_ms_p50"] = _spread(
+                "dispatch_relay_ms_p50"
+            )
+            # overlapped-relay accounting (ISSUE 7): per-attempt H2D time
+            # hidden behind device compute, and the overlap ratio spread —
+            # the 0.8x-kernel / <=15%-spread acceptance is checkable from
+            # this artifact alone
+            span_summary["stream_transfer_hidden_ms"] = _spread(
+                "transfer_hidden_ms"
+            )
+            span_summary["stream_overlap_ratio"] = _spread("overlap_ratio")
+            # mesh dispatcher (ISSUE 9): per-attempt lane-packing
+            # efficiency (all-zero when TM_TPU_MESH is off — the classic
+            # dispatcher records no mesh_pack spans)
+            span_summary["stream_mesh_lane_occupancy"] = _spread(
+                "mesh_lane_occupancy"
+            )
+            span_summary["stream_mesh_pad_waste_ratio"] = _spread(
+                "mesh_pad_waste_ratio"
+            )
     dev_s = 1.0 / sus_rate if sus_rate else single_s
 
     try:
@@ -501,6 +513,7 @@ def worker() -> None:
     # if a later (secondary) benchmark stalls past the worker timeout the
     # headline number still stands.
     partial = {
+        "schema_version": 1,
         "metric": f"verify_commit_{n_sigs}",
         "value": round(1.0 / dev_s, 1),
         "unit": "sigs/s",
@@ -567,6 +580,7 @@ def worker() -> None:
             print(f"# simnet churn bench failed: {e}", file=sys.stderr)
 
     out = {
+        "schema_version": 1,
         "metric": f"verify_commit_{n_sigs}",
         "value": round(1.0 / dev_s, 1),
         "unit": "sigs/s",
@@ -753,6 +767,7 @@ def multichip_main(argv) -> None:
     by_lanes = {c["lanes"]: c["sigs_per_s"] for c in curve}
     base = by_lanes.get(1, curve[0]["sigs_per_s"] if curve else 0.0)
     out = {
+        "schema_version": 1,
         "metric": "multichip_aggregate_sigs_per_s",
         "value": curve[-1]["sigs_per_s"] if curve else 0.0,
         "unit": "sigs/s",
@@ -831,7 +846,8 @@ def _build_commit_jobs(n_vals: int, n_commits: int):
     return jobs
 
 
-def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
+def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt,
+                                traced: bool = True) -> tuple:
     """Stream the commits through types.verify_commit concurrently (their
     device batches pipeline through the shared AsyncBatchVerifier) and
     return (best_rate, attempts). Relay-health gating: retry when the RTT
@@ -921,28 +937,32 @@ def _bench_verify_commit_stream(jobs, n_sigs: int, measure_rtt) -> tuple:
         gc.collect()  # each pass churns ~100 MB of entry tuples/arrays;
         # collect OUTSIDE the timed window, not during it
         rtt = measure_rtt()
-        rate, spans = one_pass(traced=True)
-        hidden_ms, transfer_ms = spans.get("_transfer_overlap", (0.0, 0.0))
-        occ, pad = spans.get("_mesh_pack", (0.0, 0.0))
-        attempts.append({
-            "mesh_lane_occupancy": round(occ, 4),
-            "mesh_pad_waste_ratio": round(pad, 4),
-            "rate": round(rate, 1),
-            "rtt_ms": round(rtt, 1),
-            "queue_wait_ms_p50": round(
-                spans.get("pipeline.queue_wait", {}).get("p50_ms", 0.0), 3
-            ),
-            "dispatch_relay_ms_p50": round(
-                spans.get("pipeline.dispatch", {}).get("p50_ms", 0.0), 3
-            ),
-            # overlapped relay (ISSUE 7): how much of this attempt's H2D
-            # time rode behind device compute
-            "transfer_ms": round(transfer_ms, 3),
-            "transfer_hidden_ms": round(hidden_ms, 3),
-            "overlap_ratio": round(
-                hidden_ms / transfer_ms if transfer_ms else 0.0, 4
-            ),
-        })
+        rate, spans = one_pass(traced=traced)
+        att = {"rate": round(rate, 1), "rtt_ms": round(rtt, 1)}
+        if traced:
+            hidden_ms, transfer_ms = spans.get(
+                "_transfer_overlap", (0.0, 0.0)
+            )
+            occ, pad = spans.get("_mesh_pack", (0.0, 0.0))
+            att.update({
+                "mesh_lane_occupancy": round(occ, 4),
+                "mesh_pad_waste_ratio": round(pad, 4),
+                "queue_wait_ms_p50": round(
+                    spans.get("pipeline.queue_wait", {}).get("p50_ms", 0.0),
+                    3,
+                ),
+                "dispatch_relay_ms_p50": round(
+                    spans.get("pipeline.dispatch", {}).get("p50_ms", 0.0), 3
+                ),
+                # overlapped relay (ISSUE 7): how much of this attempt's
+                # H2D time rode behind device compute
+                "transfer_ms": round(transfer_ms, 3),
+                "transfer_hidden_ms": round(hidden_ms, 3),
+                "overlap_ratio": round(
+                    hidden_ms / transfer_ms if transfer_ms else 0.0, 4
+                ),
+            })
+        attempts.append(att)
         print(f"# verify_commit stream attempt {attempt}: {rate:.0f} sigs/s "
               f"(rtt {rtt:.0f}ms)", file=sys.stderr)
         # best-of over >= MIN_ATTEMPTS passes: batch splits and GIL

@@ -18,13 +18,16 @@ LastCommit verification runs on the device batch engine.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time as _time
+from collections import deque
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 from ..libs.service import BaseService
+from ..observability import trace as _trace
 from ..types import (
     BlockID,
     Commit,
@@ -80,6 +83,69 @@ class BlockPartMessage:
 @dataclass
 class VoteMessage:
     vote: Vote
+    # flow correlation id (ISSUE 10): captured from the tracer's inbound-
+    # flow register at enqueue time so the causal chain survives the
+    # receive-queue hop (the vote is verified on a later event/thread
+    # than the delivery that carried it)
+    flow: Optional[int] = None
+
+
+@dataclass
+class HeightTimeline:
+    """Per-height consensus latency attribution (ISSUE 10): the timestamps
+    of every phase transition one height passes through, read off the
+    state machine's own clock (`self._now` — the simnet virtual clock when
+    injected, so simulated timelines are deterministic). The per-phase
+    breakdown is the 2302.00418 instrument: where a height's latency
+    actually went — waiting for the proposal, gathering 2/3 prevotes,
+    gathering 2/3 precommits, fetching/committing the block, or verifying
+    and applying it."""
+
+    height: int
+    t_new_height: float
+    t_proposal: Optional[float] = None       # valid proposal accepted
+    t_prevote_23: Optional[float] = None     # 2/3 prevotes observed
+    t_precommit_23: Optional[float] = None   # 2/3 precommits observed
+    t_commit: Optional[float] = None         # entered STEP_COMMIT
+    t_verify_dispatch: Optional[float] = None  # block validate/verify begins
+    t_applied: Optional[float] = None        # ABCI apply finished
+    rounds: int = 0                          # rounds consumed (>= 1)
+
+    # (phase, start attr, end attr) — consecutive transition deltas
+    _PHASES = (
+        ("propose", "t_new_height", "t_proposal"),
+        ("prevote", "t_proposal", "t_prevote_23"),
+        ("precommit", "t_prevote_23", "t_precommit_23"),
+        ("commit", "t_precommit_23", "t_commit"),
+        ("apply", "t_verify_dispatch", "t_applied"),
+    )
+
+    def phases(self) -> Dict[str, float]:
+        """Phase durations in seconds, only for transitions that happened
+        (a height entered via WAL replay or catch-up can skip phases)."""
+        out: Dict[str, float] = {}
+        for name, a, b in self._PHASES:
+            ta, tb = getattr(self, a), getattr(self, b)
+            if ta is not None and tb is not None and tb >= ta:
+                out[name] = tb - ta
+        return out
+
+    def to_dict(self) -> dict:
+        d = {
+            "height": self.height,
+            "rounds": self.rounds,
+            "t_new_height": self.t_new_height,
+            "t_proposal": self.t_proposal,
+            "t_prevote_23": self.t_prevote_23,
+            "t_precommit_23": self.t_precommit_23,
+            "t_commit": self.t_commit,
+            "t_verify_dispatch": self.t_verify_dispatch,
+            "t_applied": self.t_applied,
+            "phases": self.phases(),
+        }
+        if self.t_applied is not None:
+            d["total_s"] = self.t_applied - self.t_new_height
+        return d
 
 
 class ConsensusState(BaseService):
@@ -98,9 +164,22 @@ class ConsensusState(BaseService):
         priv_validator=None,
         metrics=None,  # libs.metrics.ConsensusMetrics (None = no-op)
         clock=None,  # injectable time source (simnet); None = wall clock
+        tracer=None,  # per-node SpanTracer (simnet); None = global TRACER
     ):
         super().__init__("ConsensusState")
         self._cfg = config
+        # Flight recorder (ISSUE 10): spans/flows go to the injected
+        # per-node tracer under simnet (virtual-clock timebase, one pid
+        # per node in the merged trace) and to the process tracer on a
+        # real node.
+        self._tracer = tracer if tracer is not None else _trace.TRACER
+        # last-K completed HeightTimeline records (RPC /height_timeline,
+        # SimReport ring, flight-recorder dumps)
+        ring = int(os.environ.get("TM_TPU_TIMELINE_RING", "32") or 32)
+        self.height_timelines: Deque[HeightTimeline] = deque(
+            maxlen=max(ring, 1)
+        )
+        self._timeline: Optional[HeightTimeline] = None
         # All reads of "now" inside the state machine (round start times,
         # commit times, vote timestamps) go through self._now so a virtual
         # clock can drive the whole machine deterministically.
@@ -215,7 +294,11 @@ class ConsensusState(BaseService):
         self._wake()
 
     def add_vote_msg(self, vote: Vote, peer_id: str = "") -> None:
-        self._queue.put((VoteMessage(vote), peer_id))
+        msg = VoteMessage(vote)
+        tr = self._tracer
+        if tr.enabled and tr.flow is not None:
+            msg.flow = tr.flow  # the delivery's flow rides with the vote
+        self._queue.put((msg, peer_id))
         self._wake()
 
     def _send_internal(self, msg) -> None:
@@ -353,7 +436,7 @@ class ConsensusState(BaseService):
                     self.rs.proposal_block_parts.is_complete():
                 pass  # handled inside _add_proposal_block_part
         elif isinstance(msg, VoteMessage):
-            self._try_add_vote(msg.vote, peer_id)
+            self._try_add_vote(msg.vote, peer_id, flow=msg.flow)
         else:
             raise ValueError(f"unknown msg type {type(msg)}")
 
@@ -439,6 +522,48 @@ class ConsensusState(BaseService):
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self._state = state
+        # flight recorder: a fresh timeline per height (an unfinished one
+        # — catch-up, WAL replay — is simply superseded)
+        self._timeline = HeightTimeline(height=height,
+                                        t_new_height=self._now())
+
+    # ------------------------------------------------------------------
+    # per-height latency attribution (ISSUE 10)
+
+    def _tl_mark(self, attr: str) -> None:
+        """Stamp a phase transition once, on the current height's
+        timeline; later re-entries (higher rounds re-reaching 2/3) keep
+        the FIRST observation — the latency the height actually paid."""
+        tl = self._timeline
+        if tl is not None and tl.height == self.rs.height and \
+                getattr(tl, attr) is None:
+            setattr(tl, attr, self._now())
+
+    def _tl_finish(self, tl: HeightTimeline) -> None:
+        """Height committed+applied: retire the timeline into the ring and
+        feed the phase histograms."""
+        self.height_timelines.append(tl)
+        self._timeline = None
+        m = self._metrics
+        if m is not None:
+            try:
+                for name, dur in tl.phases().items():
+                    m.phase_seconds.observe(dur, phase=name)
+            except Exception:  # noqa: BLE001 — metrics must never break commit
+                pass
+
+    def height_timeline(self, height: Optional[int] = None
+                        ) -> Optional[HeightTimeline]:
+        """The retained timeline for `height` (latest when None)."""
+        ring = list(self.height_timelines)  # snapshot: RPC thread reads
+        if not ring:
+            return None
+        if height is None:
+            return ring[-1]
+        for tl in ring:
+            if tl.height == height:
+                return tl
+        return None
 
     def _schedule_round_0(self) -> None:
         sleep = max(self.rs.start_time - self._now(), 0.0)
@@ -605,6 +730,7 @@ class ConsensusState(BaseService):
         prevotes = rs.votes.prevotes(round_)
         if prevotes is None or not prevotes.has_two_thirds_any():
             raise RuntimeError("enter_prevote_wait without +2/3 prevotes")
+        self._tl_mark("t_prevote_23")
         rs.round = round_
         rs.step = STEP_PREVOTE_WAIT
         self._new_step_event()
@@ -621,7 +747,8 @@ class ConsensusState(BaseService):
             return
         rs.round = round_
         rs.step = STEP_PRECOMMIT
-        self._new_step_event()
+        self._tl_mark("t_prevote_23")  # entered on polka or prevote-wait
+        self._new_step_event()         # timeout — 2/3 prevotes either way
         prevotes = rs.votes.prevotes(round_)
         block_id, ok = (prevotes.two_thirds_majority() if prevotes else (BlockID(), False))
         if not ok:
@@ -683,6 +810,7 @@ class ConsensusState(BaseService):
         precommits = rs.votes.precommits(round_)
         if precommits is None or not precommits.has_two_thirds_any():
             raise RuntimeError("enter_precommit_wait without +2/3 precommits")
+        self._tl_mark("t_precommit_23")
         rs.triggered_timeout_precommit = True
         self._new_step_event()
         self._ticker.schedule_timeout(
@@ -698,6 +826,8 @@ class ConsensusState(BaseService):
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
         rs.commit_time = self._now()
+        self._tl_mark("t_precommit_23")  # 2/3 precommits proved just above
+        self._tl_mark("t_commit")
         self._new_step_event()
         precommits = rs.votes.precommits(commit_round)
         block_id, ok = precommits.two_thirds_majority()
@@ -740,6 +870,9 @@ class ConsensusState(BaseService):
             raise RuntimeError("finalize_commit preconditions violated")
         if block.hash() != block_id.hash:
             raise RuntimeError("cannot finalize: proposal block does not hash to commit hash")
+        # the verify/apply leg begins here: block validation (LastCommit
+        # signatures ride the device batch engine) then ABCI apply
+        self._tl_mark("t_verify_dispatch")
         self._block_exec.validate_block(self._state, block)
 
         # Save to block store before applying (state.go:1640-1652)
@@ -754,6 +887,12 @@ class ConsensusState(BaseService):
 
         state_copy = self._state.copy()
         new_state = self._block_exec.apply_block(state_copy, block_id, block)
+
+        tl = self._timeline
+        if tl is not None and tl.height == height:
+            tl.rounds = rs.round + 1
+            tl.t_applied = self._now()
+            self._tl_finish(tl)
 
         # NewHeight: updateToState + schedule round 0
         self._update_to_state(new_state)
@@ -829,6 +968,7 @@ class ConsensusState(BaseService):
         ):
             raise ValueError("error invalid proposal signature")
         rs.proposal = proposal
+        self._tl_mark("t_proposal")
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.new_from_header(
                 proposal.block_id.part_set_header
@@ -862,8 +1002,24 @@ class ConsensusState(BaseService):
                 self._try_finalize_commit(rs.height)
         return added
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
-        """state.go:1959-2005."""
+    def _try_add_vote(self, vote: Vote, peer_id: str,
+                      flow: Optional[int] = None) -> bool:
+        """state.go:1959-2005, span-wrapped: the vote's signature verify +
+        set accounting is the consensus-side terminus of a gossiped vote's
+        causal chain — the flow id captured at enqueue time (or parked on
+        the tracer by a synchronous delivery driver) FINISHES here, so the
+        merged trace links gossip send → deliver → verify dispatch."""
+        tr = self._tracer
+        if tr.enabled:
+            fid = flow if flow is not None else tr.flow
+            with tr.span("consensus.verify_dispatch", flow=fid,
+                         flow_phase="f" if fid is not None else None,
+                         height=vote.height, round=vote.round,
+                         type=vote.type):
+                return self._try_add_vote_impl(vote, peer_id)
+        return self._try_add_vote_impl(vote, peer_id)
+
+    def _try_add_vote_impl(self, vote: Vote, peer_id: str) -> bool:
         try:
             return self._add_vote(vote, peer_id)
         except ErrVoteNonDeterministicSignature:

@@ -63,10 +63,15 @@ _ON = os.environ.get("TM_TPU_DEVCHECK", "") == "1"
 _mtx = threading.Lock()  # guards all devcheck global state below
 _violations: List[dict] = []
 _counts: Dict[str, int] = {"relay_touches": 0, "lock_acquires": 0,
-                           "canary_checks": 0, "canary_registered": 0}
+                           "canary_checks": 0, "canary_registered": 0,
+                           "span_opens": 0}
 _relay_owners: Set[int] = set()
 _lock_edges: Dict[str, Set[str]] = {}
 _tls = threading.local()  # .held: list of lock names; .exempt: int depth
+# unbalanced-span canary (ISSUE 10): thread ident -> open span names, in
+# nesting order. Fed by observability.trace._Span when devcheck is armed;
+# span_check() asserts every stack drained (tracer close, pipeline close).
+_open_spans: Dict[int, List[str]] = {}
 
 _CANARY_RING = 64
 _canaries: "OrderedDict[int, tuple]" = OrderedDict()  # id -> (tag, arr, snap)
@@ -104,6 +109,7 @@ def reset_state() -> None:
         _relay_owners.clear()
         _lock_edges.clear()
         _canaries.clear()
+        _open_spans.clear()
         for k in _counts:
             _counts[k] = 0
 
@@ -141,6 +147,7 @@ def report() -> dict:
             "violations": list(_violations),
             "counts": dict(_counts),
             "lock_order_edges": int(sum(len(v) for v in _lock_edges.values())),
+            "open_spans": int(sum(len(s) for s in _open_spans.values())),
         }
 
 
@@ -217,6 +224,82 @@ def note_relay_touch(what: str) -> None:
             f"exactly ONE dispatch-owner thread may launch/transfer",
         )
         raise DevcheckViolation(rec["message"])
+
+
+# ---------------------------------------------------------------------------
+# 1b) unbalanced-span canary (ISSUE 10 satellite)
+#
+# observability.trace._Span reports every enter/exit here when devcheck is
+# armed; span_check() (tracer close, pipeline close) asserts that every
+# thread's stack drained. A span left open — an early return or exception
+# path that dodged the `with` discipline, or a hand-called __enter__ —
+# corrupts the flame-graph nesting every summary trusts, silently.
+
+
+def span_opened(name: str) -> None:
+    if not _ON:
+        return
+    ident = threading.get_ident()
+    with _mtx:
+        _counts["span_opens"] += 1
+        _open_spans.setdefault(ident, []).append(name)
+
+
+def span_closed(name: str) -> None:
+    """Pop the most recent matching open span. Unconditional on the live
+    flag like DevLock.release: disabling devcheck mid-span must not leave
+    a stale entry that later reads as a leak."""
+    if not _open_spans:
+        # nothing was ever pushed (devcheck never armed): skip the lock —
+        # this keeps the tracing-enabled/devcheck-off path allocation- and
+        # contention-free (the racy read only ever skips when empty)
+        return
+    ident = threading.get_ident()
+    with _mtx:
+        stack = _open_spans.get(ident)
+        if not stack:
+            return
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
+        if not stack:
+            _open_spans.pop(ident, None)
+
+
+def span_check(where: str, only_exited: bool = False) -> None:
+    """Assert no span is left open (tracer `close()`, verifier close).
+    `only_exited=True` restricts the check to threads that are no longer
+    alive — the right scope for a component close() racing unrelated
+    live threads legitimately mid-span (a span on a DEAD thread can
+    never be closed, so it is always a leak). Raises with the per-thread
+    leftovers; only the REPORTED entries are cleared, so a live thread's
+    in-progress bookkeeping is never corrupted and one leak does not
+    re-report at every subsequent checkpoint."""
+    if not _ON:
+        return
+    names = {t.ident: t.name for t in threading.enumerate()}
+    with _mtx:
+        leftover = {
+            i: list(s)
+            for i, s in _open_spans.items()
+            if s and not (only_exited and i in names)
+        }
+        for i in leftover:
+            _open_spans.pop(i, None)
+    if not leftover:
+        return
+    detail = "; ".join(
+        f"{names.get(i, 'exited-thread')}({i}): {s}"
+        for i, s in sorted(leftover.items())
+    )
+    rec = _violate(
+        "unbalanced-span",
+        f"{sum(len(s) for s in leftover.values())} span(s) left open at "
+        f"{where} — every span must close on the thread that opened it "
+        f"({detail})",
+    )
+    raise DevcheckViolation(rec["message"])
 
 
 # ---------------------------------------------------------------------------

@@ -260,6 +260,17 @@ class ConsensusMetrics:
         self.block_size_bytes = registry.gauge(
             "consensus", "block_size_bytes", "Size of the latest block."
         )
+        # per-height latency attribution (ISSUE 10): the HeightTimeline
+        # phase durations (propose / prevote / precommit / commit / apply)
+        # as one labeled histogram — the 2302.00418-style per-phase
+        # breakdown, scrapeable instead of paper-only
+        self.phase_seconds = registry.histogram(
+            "consensus", "phase_seconds",
+            "Consensus phase durations per committed height, by phase "
+            "label (propose|prevote|precommit|commit|apply).",
+            buckets=[0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0],
+            labeled=True,
+        )
 
 
 class MempoolMetrics:

@@ -13,4 +13,12 @@ clock read, dict build, or string work happens.
 """
 
 from . import trace  # noqa: F401
-from .trace import TRACER, configure, span  # noqa: F401
+from .trace import (  # noqa: F401
+    TRACER,
+    SpanTracer,
+    configure,
+    flow_chains,
+    merge_traces,
+    next_flow,
+    span,
+)

@@ -19,45 +19,119 @@ Design constraints (ISSUE 1):
   https://ui.perfetto.dev; `dump(path)` writes it to disk (the node's
   OnStop flushes through this so a SIGTERM run leaves a complete file).
 
+Causal cross-node tracing (ISSUE 10):
+
+- **Flow events.** A span may carry a correlation id (`flow=` + a
+  `flow_phase` of "s"/"t"/"f" — start/step/finish); `export_chrome()`
+  emits matching Trace Event Format flow events bound to the slice, so a
+  vote's journey (gossip send → deliver → verify dispatch) renders as a
+  clickable arrow chain in Perfetto. `next_flow()` allocates process-wide
+  ids (offset above 2^32 so they never collide with a simulation's own
+  deterministic per-clock flow counters).
+- **Per-node tracer instances.** `SpanTracer(node=..., now=..., epoch=...)`
+  stamps every exported event with a per-node pid (+ a `process_name`
+  metadata event) and reads time from an injected clock — simnet gives
+  each simulated node a tracer on the shared virtual clock, so one merged
+  trace aligns every node on the same (virtual) timebase.
+- **Merging.** `merge_traces([doc, ...])` re-keys pids and concatenates
+  event streams into ONE Chrome-trace document; flow ids are preserved
+  verbatim so cross-document chains stay linked.
+
 Enable via config (`[instrumentation] tracing = true`), env
 (`TM_TPU_TRACE=1`), or `configure(enabled=True)`.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..libs import devcheck as _devcheck
 
 _PID = os.getpid()
 
 # A record is (name, start_s, end_s, tid, args_or_None); start/end are
-# time.perf_counter() readings against the tracer's epoch.
+# readings of the tracer's clock (perf_counter by default) against the
+# tracer's epoch. Flow correlation rides INSIDE args under the reserved
+# keys "flow" (int id) and "flow_phase" ("s"|"t"|"f") so the tuple shape
+# — and every 5-tuple consumer — stays stable.
 _Record = Tuple[str, float, float, int, Optional[dict]]
+
+# Process-wide flow-id allocator for the wall-clock tracer. Offset far
+# above any simulation's per-SimClock counter (which starts at 1) so a
+# merged trace never aliases two unrelated chains onto one id.
+_FLOW_BASE = 1 << 32
+_flow_counter = itertools.count(_FLOW_BASE + 1)
+
+
+def next_flow() -> int:
+    """Allocate a process-unique flow (correlation) id."""
+    return next(_flow_counter)
+
+# Per-node tracers get small deterministic pids well away from real OS
+# pids; assignment order is the tracer construction order.
+_node_pid_mtx = threading.Lock()
+_node_pids: Dict[int, int] = {}  # id(tracer) -> pid
+_NODE_PID_BASE = 10_000_000
 
 
 class SpanTracer:
-    """Ring-buffered span recorder. One process-wide instance (TRACER)."""
+    """Ring-buffered span recorder. One process-wide wall-clock instance
+    (TRACER); per-node instances (simnet) carry a node name and an
+    injected clock."""
 
-    def __init__(self, capacity: int = 16384):
+    def __init__(self, capacity: int = 16384, node: Optional[str] = None,
+                 now: Optional[Callable[[], float]] = None,
+                 epoch: Optional[float] = None):
         self.enabled = False
+        self.node = node
+        self._now = now if now is not None else time.perf_counter
+        # inbound-flow register: a delivery driver parks the active flow
+        # id here so downstream spans (consensus.verify_dispatch) can
+        # continue the chain; single-threaded drivers only
+        self.flow: Optional[int] = None
         self._cap = max(int(capacity), 16)
         self._buf: List[Optional[_Record]] = [None] * self._cap
         self._n = 0  # monotonic write index; wraps over _cap
         self._mtx = threading.Lock()
-        self._epoch = time.perf_counter()
+        self._epoch = float(epoch) if epoch is not None else self._now()
 
     # -- recording -----------------------------------------------------
 
     def record(self, name: str, start: float, end: float,
-               args: Optional[dict] = None) -> None:
-        """Record one completed span (perf_counter start/end)."""
+               args: Optional[dict] = None, flow: Optional[int] = None,
+               flow_phase: Optional[str] = None) -> None:
+        """Record one completed span (clock start/end). `flow`/`flow_phase`
+        attach a correlation id under the reserved args keys."""
+        if flow is not None:
+            args = dict(args) if args else {}
+            args["flow"] = int(flow)
+            args["flow_phase"] = flow_phase or "t"
         rec = (name, start, end, threading.get_ident(), args)
         with self._mtx:
             self._buf[self._n % self._cap] = rec
             self._n += 1
+
+    def span(self, name: str, flow: Optional[int] = None,
+             flow_phase: Optional[str] = None, **args) -> object:
+        """Context manager recording on THIS tracer (per-node instances);
+        same disabled-path contract as the module-level span()."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args or None, flow, flow_phase)
+
+    def flow_point(self, name: str, flow: Optional[int],
+                   phase: str = "t", **args) -> None:
+        """Record an instant (zero-duration) event carrying a flow id —
+        how one coalesced batch fans a step/finish out to many chains."""
+        if not self.enabled or flow is None:
+            return
+        t = self._now()
+        self.record(name, t, t, args or None, flow=flow, flow_phase=phase)
 
     def configure(self, enabled: Optional[bool] = None,
                   capacity: Optional[int] = None) -> None:
@@ -68,6 +142,12 @@ class SpanTracer:
                 self._n = 0
         if enabled is not None:
             self.enabled = bool(enabled)
+
+    def close(self) -> None:
+        """Retire the tracer: under TM_TPU_DEVCHECK=1 assert every span
+        opened on every thread was closed (the unbalanced-span canary —
+        a leaked span skews every summary that trusts nesting)."""
+        _devcheck.span_check(f"tracer.close({self.node or 'global'})")
 
     def clear(self) -> None:
         with self._mtx:
@@ -94,34 +174,61 @@ class SpanTracer:
             return [r for r in self._buf[head:] + self._buf[:head]
                     if r is not None]
 
+    def _pid(self) -> int:
+        if self.node is None:
+            return _PID
+        with _node_pid_mtx:
+            pid = _node_pids.get(id(self))
+            if pid is None:
+                pid = _NODE_PID_BASE + len(_node_pids) + 1
+                _node_pids[id(self)] = pid
+            return pid
+
     def export_chrome(self) -> dict:
-        """Trace Event Format dict (chrome://tracing / Perfetto JSON)."""
+        """Trace Event Format dict (chrome://tracing / Perfetto JSON).
+        Spans carrying a flow id additionally emit the matching flow
+        event ("s"/"t"/"f", binding-point "e" on finish) at the slice's
+        start timestamp, so Perfetto draws the causal arrows."""
         evs = []
         epoch = self._epoch
+        pid = self._pid()
         for name, start, end, tid, args in self.events():
+            ts = (start - epoch) * 1e6   # microseconds
             ev = {
                 "name": name,
                 "cat": "tendermint_tpu",
                 "ph": "X",
-                "pid": _PID,
+                "pid": pid,
                 "tid": tid,
-                "ts": (start - epoch) * 1e6,   # microseconds
+                "ts": ts,
                 "dur": (end - start) * 1e6,
             }
             if args:
                 ev["args"] = args
+                fid = args.get("flow")
+                if fid is not None:
+                    ph = args.get("flow_phase", "t")
+                    if ph not in ("s", "t", "f"):
+                        ph = "t"
+                    fev = {
+                        "name": "flow", "cat": "flow", "ph": ph,
+                        "id": int(fid), "pid": pid, "tid": tid, "ts": ts,
+                    }
+                    if ph == "f":
+                        fev["bp"] = "e"  # bind to the enclosing slice
+                    evs.append(fev)
             evs.append(ev)
         evs.sort(key=lambda e: e["ts"])
+        if self.node is not None:
+            evs.insert(0, {
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": self.node},
+            })
         return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
     def dump(self, path: str) -> str:
         """Write the Chrome-trace JSON to `path` (returns the path)."""
-        doc = self.export_chrome()
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, path)  # atomic: a SIGTERM mid-dump never leaves
-        return path            # a truncated file at the advertised path
+        return dump_doc(self.export_chrome(), path)
 
     def summary(self) -> Dict[str, dict]:
         return summarize_events(self.export_chrome())
@@ -130,18 +237,32 @@ class SpanTracer:
 class _Span:
     """Active span: records on exit. Only built when tracing is enabled."""
 
-    __slots__ = ("_name", "_args", "_t0")
+    __slots__ = ("_tr", "_name", "_args", "_flow", "_phase", "_t0")
 
-    def __init__(self, name: str, args: Optional[dict]):
+    def __init__(self, tracer: "SpanTracer", name: str, args: Optional[dict],
+                 flow: Optional[int] = None, phase: Optional[str] = None):
+        self._tr = tracer
         self._name = name
         self._args = args
+        self._flow = flow
+        self._phase = phase
 
     def __enter__(self) -> "_Span":
-        self._t0 = time.perf_counter()
+        if _devcheck.enabled():
+            _devcheck.span_opened(self._name)
+        self._t0 = self._tr._now()
         return self
 
     def __exit__(self, *exc) -> bool:
-        TRACER.record(self._name, self._t0, time.perf_counter(), self._args)
+        tr = self._tr
+        tr.record(self._name, self._t0, tr._now(), self._args,
+                  flow=self._flow, flow_phase=self._phase)
+        # unconditional (like DevLock.release): devcheck disabled between
+        # enter and exit must still pop the armed-time push. The inject
+        # seam leaks ONLY this bookkeeping (the span still records) so
+        # the close()-time canary demonstrably fires.
+        if not _devcheck.inject_lintbug("span"):
+            _devcheck.span_closed(self._name)
         return False
 
 
@@ -164,8 +285,10 @@ if os.environ.get("TM_TPU_TRACE", "0") not in ("", "0"):
     TRACER.enabled = True
 
 
-def span(name: str, **args) -> object:
-    """Context manager recording `name` with optional args.
+def span(name: str, flow: Optional[int] = None,
+         flow_phase: Optional[str] = None, **args) -> object:
+    """Context manager recording `name` with optional args (and an
+    optional flow correlation id) on the process-wide TRACER.
 
     The disabled path returns a shared null object after a single attribute
     check — hot-path call sites need no `if` of their own (though sites
@@ -173,7 +296,7 @@ def span(name: str, **args) -> object:
     """
     if not TRACER.enabled:
         return _NULL_SPAN
-    return _Span(name, args or None)
+    return _Span(TRACER, name, args or None, flow, flow_phase)
 
 
 def configure(enabled: Optional[bool] = None,
@@ -244,3 +367,79 @@ def summarize_events(trace_doc: dict) -> Dict[str, dict]:
         "events": len(evs),
     }
     return out
+
+
+def dump_doc(doc: dict, path: str) -> str:
+    """Atomically write a trace document as JSON: tmp file + rename, so a
+    SIGTERM mid-dump never leaves a truncated file at the advertised
+    path. Shared by SpanTracer.dump, simnet_run --trace and trace_report
+    --out."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_traces(docs: Sequence[dict],
+                 labels: Optional[Sequence[str]] = None) -> dict:
+    """Merge several Chrome-trace documents into ONE (ISSUE 10): pids are
+    re-keyed per source document (collision-proof), `process_name`
+    metadata survives (or is synthesized from `labels`), and flow ids are
+    preserved VERBATIM — a flow started in one document and finished in
+    another stays a single causal chain. Documents must share a timebase
+    for the timeline to be meaningful (simnet's per-node tracers all read
+    the same virtual clock)."""
+    merged: List[dict] = []
+    meta: List[dict] = []
+    next_pid = 1
+    for i, doc in enumerate(docs):
+        label = labels[i] if labels is not None and i < len(labels) else None
+        evs = doc.get("traceEvents", [])
+        named = {
+            ev.get("pid")
+            for ev in evs
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"
+        }
+        pid_map: Dict[object, int] = {}
+        for ev in evs:
+            old = ev.get("pid", 0)
+            new = pid_map.get(old)
+            if new is None:
+                new = pid_map[old] = next_pid
+                next_pid += 1
+            ev2 = dict(ev)
+            ev2["pid"] = new
+            if ev2.get("ph") == "M":
+                meta.append(ev2)
+            else:
+                merged.append(ev2)
+        for old, new in sorted(pid_map.items(), key=lambda kv: kv[1]):
+            if old not in named:
+                meta.append({
+                    "name": "process_name", "ph": "M", "pid": new, "tid": 0,
+                    "args": {"name": label or f"proc{new}"},
+                })
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta + merged, "displayTimeUnit": "ms"}
+
+
+def flow_chains(trace_doc: dict) -> Dict[int, List[dict]]:
+    """Group a document's flow-carrying slices by flow id, each chain
+    ordered (phase-aware: "s" first, "f" last, ties by ts). The merged-
+    trace acceptance check — and the tests — read chains through this
+    instead of re-parsing the event soup."""
+    order = {"s": 0, "t": 1, "f": 2}
+    chains: Dict[int, List[dict]] = {}
+    for ev in trace_doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        fid = args.get("flow")
+        if fid is None:
+            continue
+        chains.setdefault(int(fid), []).append(ev)
+    for evs in chains.values():
+        evs.sort(key=lambda e: (order.get((e.get("args") or {}).get(
+            "flow_phase", "t"), 1), e.get("ts", 0.0)))
+    return chains

@@ -126,11 +126,15 @@ class DispatchError(RuntimeError):
 
 
 class _Job:
-    __slots__ = ("entries", "future")
+    __slots__ = ("entries", "future", "flow")
 
     def __init__(self, entries: EntryBlock):
         self.entries = entries
         self.future: Future = Future()
+        # flow correlation id (ISSUE 10): allocated at submit() when the
+        # tracer is live, threaded through the coalesced batch so the
+        # dispatch/verdict instants chain back to the submitting caller
+        self.flow: Optional[int] = None
 
 
 class AsyncBatchVerifier:
@@ -223,6 +227,11 @@ class AsyncBatchVerifier:
         if len(block) > max_b:
             return self._submit_chunked(block, max_b)
         job = _Job(block)
+        if _trace.TRACER.enabled:
+            job.flow = _trace.next_flow()
+            _trace.TRACER.flow_point(
+                "pipeline.submit", job.flow, "s", n=len(block)
+            )
         self._q.put(job)
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
@@ -266,6 +275,12 @@ class AsyncBatchVerifier:
         _devcheck.unclaim_relay(self.dispatch_thread_idents)
         if _devcheck.enabled():
             _devcheck.canary_sweep("pipeline.close")
+            # scoped to EXITED threads: the pipeline's own joined threads
+            # can only have leaks left, while an unrelated live thread
+            # (consensus mid-verify_dispatch, or a dispatch thread that
+            # outlived join's timeout on a stalled device call) is
+            # legitimately mid-span and must not false-positive
+            _devcheck.span_check("pipeline.close", only_exited=True)
 
     # -- worker ----------------------------------------------------------
 
@@ -446,6 +461,12 @@ class AsyncBatchVerifier:
         # the device result and the caller's future
         for job, off, n in spans:
             job.future.set_result(arr[off : off + n])
+        if _trace.TRACER.enabled:
+            for job, _off, n in spans:
+                if getattr(job, "flow", None) is not None:
+                    _trace.TRACER.flow_point(
+                        "pipeline.verdict", job.flow, "f", n=n
+                    )
 
     def _worker(self) -> None:
         """Coalescer: many small commits (e.g. 128-signature headers
@@ -765,6 +786,16 @@ class AsyncBatchVerifier:
                 try:
                     with _span("pipeline.dispatch", bucket=bucket):
                         dev = f(*dev_args)
+                    if _trace.TRACER.enabled:
+                        # one launch serves many coalesced jobs: step each
+                        # job's flow through the dispatch instant so every
+                        # chain passes through this batch's slice
+                        for _j, _, _ in spans:
+                            if getattr(_j, "flow", None) is not None:
+                                _trace.TRACER.flow_point(
+                                    "pipeline.dispatch.flow", _j.flow, "t",
+                                    bucket=bucket,
+                                )
                     # start the device->host copy NOW: a blocking fetch
                     # through the relay costs a full RTT (~65 ms, PERF_r05),
                     # but an async copy rides behind the compute so the

@@ -233,6 +233,36 @@ class Environment:
             out["trace"] = _trace.TRACER.export_chrome()
         return out
 
+    def height_timeline(self, height: int = 0) -> dict:
+        """Per-height consensus latency attribution (ISSUE 10): the
+        HeightTimeline record for `height` (latest when omitted) from the
+        node's last-K ring — phase timestamps, per-phase durations and the
+        round count, turning "why was h=37 slow" into a lookup."""
+        cs = self._node.consensus
+        # ONE snapshot serves the lookup, the error message and the
+        # retained-range summary — a commit landing mid-handler cannot
+        # make them disagree
+        ring = list(cs.height_timelines)
+        if not ring:
+            raise RPCError(-32603, "no height timelines recorded yet")
+        h = int(height) if height else 0
+        tl = next((t for t in ring if t.height == h), None) if h else ring[-1]
+        if tl is None:
+            raise RPCError(
+                -32603,
+                f"height {h} not in the retained timeline ring "
+                f"({ring[0].height}..{ring[-1].height})",
+            )
+        return {
+            "height": str(tl.height),
+            "timeline": tl.to_dict(),
+            "retained": {
+                "count": len(ring),
+                "min_height": str(ring[0].height),
+                "max_height": str(ring[-1].height),
+            },
+        }
+
     def net_info(self) -> dict:
         router = self._node.router
         peers = router.connected() if router else []
@@ -627,7 +657,7 @@ ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "tx", "tx_search", "block_search", "num_unconfirmed_txs",
     "unconfirmed_txs", "check_tx", "remove_tx", "broadcast_evidence",
-    "dump_trace",
+    "dump_trace", "height_timeline",
 ]
 
 # routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured.

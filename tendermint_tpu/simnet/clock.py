@@ -52,6 +52,11 @@ class SimClock:
         self.seed = seed
         self.rng = random.Random(seed)
         self.events_run = 0
+        # flow-id allocator for causal tracing (ISSUE 10): envelope
+        # send→deliver correlation ids. Deliberately NOT the PRNG and not
+        # gated on tracing — allocation order is part of the simulation's
+        # deterministic state, so tracing on/off cannot change a run
+        self._flow = 0
         # True when the LAST run_until call exited because its max_wall_s
         # budget expired (vs predicate/deadline/heap-drain) — lets callers
         # classify a wall cutoff without re-deriving it from elapsed time
@@ -61,6 +66,11 @@ class SimClock:
 
     def time(self) -> float:
         return self._t
+
+    def next_flow(self) -> int:
+        """Next envelope flow (correlation) id — deterministic counter."""
+        self._flow += 1
+        return self._flow
 
     # -- scheduling ------------------------------------------------------
 

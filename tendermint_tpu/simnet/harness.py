@@ -37,6 +37,7 @@ import time as _wall
 from dataclasses import dataclass, field as _field
 from typing import Dict, List, Optional
 
+from ..observability import trace as _trace
 from .clock import NodeClock, SimClock
 from .faults import Fault, make_double_sign_prevote
 from .transport import LinkConfig, SimNetwork, SimRouter
@@ -78,6 +79,13 @@ class SimReport:
     n_validators: int = 0
     valset_changes: List[int] = _field(default_factory=list)
     epoch_cache: dict = _field(default_factory=dict)
+    # flight recorder (ISSUE 10): the last-K HeightTimeline dicts from the
+    # most-advanced live node (virtual-clock timestamps — deterministic),
+    # and — ONLY when an invariant broke — a flight_recorder dump carrying
+    # every node's recent timelines plus the merged trace tail, so
+    # "invariant broke at h=37" arrives with its own evidence attached
+    height_timelines: List[dict] = _field(default_factory=list)
+    flight_recorder: Optional[dict] = None
     # the run ended because the REAL-time budget expired, not because the
     # virtual deadline passed or an invariant broke — machine-speed
     # dependent, so schedule search treats such a run as INCONCLUSIVE
@@ -139,6 +147,17 @@ class SimNode:
         self.wal_path = os.path.join(cluster.base_dir, f"node{idx}", "cs.wal")
         os.makedirs(os.path.dirname(self.wal_path), exist_ok=True)
         self.node_clock = NodeClock(cluster.clock)
+        # per-node tracer on the SHARED virtual clock (ISSUE 10): every
+        # node's spans land on one timebase, stamped with the node id, so
+        # the cluster exports ONE merged trace with a pid per node.
+        # Survives crash/restart (the runtime is rebuilt, the trace isn't)
+        self.tracer = _trace.SpanTracer(
+            capacity=int(os.environ.get("TM_TPU_SIMNET_TRACE_BUFFER")
+                         or "8192"),
+            node=self.node_id,
+            now=cluster.clock.time,
+            epoch=cluster.clock.time(),
+        )
 
         self.crashed = False
         self.byzantine = False
@@ -206,6 +225,7 @@ class SimNode:
             wal=WAL(self.wal_path),
             priv_validator=self.pv,
             clock=self.node_clock,
+            tracer=self.tracer,
         )
         self.cs.on_enqueue = self._on_enqueue
         self.cs._height_events.append(self._on_commit)
@@ -316,6 +336,7 @@ class Cluster:
         chain_id: str = CHAIN_ID,
         n_validators: Optional[int] = None,
         sig_memo: Optional[bool] = None,
+        tracing: Optional[bool] = None,
     ):
         from ..types import Timestamp
         from ..types.genesis import GenesisDoc, GenesisValidator
@@ -359,7 +380,14 @@ class Cluster:
         # crash-stop node is simply excluded from the liveness target
         self._pending_restarts: set = set()
 
+        # cluster tracing (ISSUE 10): None follows the process tracer's
+        # enabled flag at start() time (tools/simnet_run.py --trace turns
+        # that on), True/False forces it. The flow-id counter runs either
+        # way, so tracing cannot perturb replay exactness.
+        self._tracing = tracing
+
         self.nodes = [SimNode(self, i) for i in range(n_nodes)]
+        self.network.set_tracers({n.node_id: n.tracer for n in self.nodes})
         self.genesis_doc = GenesisDoc(
             chain_id=chain_id,
             genesis_time=Timestamp(seconds=GENESIS_SECONDS),
@@ -422,6 +450,11 @@ class Cluster:
         if c is not None:
             c.clear()
         self._epoch_stats0 = self._epoch_stats()
+        tracing = (
+            _trace.TRACER.enabled if self._tracing is None else self._tracing
+        )
+        for n in self.nodes:
+            n.tracer.configure(enabled=tracing)
         for n in self.nodes:
             n.start()
         for i, f in enumerate(self.faults):
@@ -658,6 +691,70 @@ class Cluster:
         live = [n.height() for n in self.nodes if not n.crashed]
         return min(live) if live else 0
 
+    def export_merged_trace(self, include_process: bool = False) -> dict:
+        """ONE Chrome-trace document for the whole cluster (ISSUE 10):
+        every node's virtual-clock tracer (pid per node, process_name
+        metadata), flow ids preserved so a vote's gossip-send → deliver →
+        verify-dispatch chain is clickable in Perfetto across node
+        boundaries. All node tracers read the SAME virtual clock, so the
+        merged timeline is coherent; the process-wide WALL-clock tracer
+        (driver/pipeline spans) uses an incomparable timebase and is only
+        appended — as a clearly-labeled separate process — on explicit
+        `include_process=True`."""
+        docs = []
+        labels = []
+        if include_process:
+            docs.append(_trace.TRACER.export_chrome())
+            labels.append("driver (wall-clock)")
+        for n in self.nodes:
+            docs.append(n.tracer.export_chrome())
+            labels.append(n.node_id)
+        return _trace.merge_traces(docs, labels)
+
+    def _timeline_ring(self, node: "SimNode", last: Optional[int] = None
+                       ) -> List[dict]:
+        if node.cs is None:
+            return []
+        ring = [tl.to_dict() for tl in node.cs.height_timelines]
+        return ring[-last:] if last else ring
+
+    def height_timelines(self) -> List[dict]:
+        """The last-K HeightTimeline dicts of the most-advanced live node
+        — the SimReport ring. Virtual-clock timestamps: deterministic
+        under replay."""
+        best = None
+        for n in self.nodes:
+            if n.cs is None:
+                continue
+            if best is None or n.height() > best.height():
+                best = n
+        return self._timeline_ring(best) if best is not None else []
+
+    def flight_recorder_dump(self, trace_tail: int = 512,
+                             timelines_per_node: int = 8) -> dict:
+        """The automatic invariant-failure attachment: every live node's
+        recent height timelines plus the merged trace's tail — enough to
+        answer "what was each node doing when it broke" without re-running
+        the schedule."""
+        timelines = {
+            n.node_id: self._timeline_ring(n, timelines_per_node)
+            for n in self.nodes
+            if n.cs is not None
+        }
+        doc = self.export_merged_trace()
+        evs = doc.get("traceEvents", [])
+        meta = [e for e in evs if e.get("ph") == "M"]
+        rest = [e for e in evs if e.get("ph") != "M"]
+        return {
+            "height_timelines": timelines,
+            "tracing": any(n.tracer.enabled for n in self.nodes),
+            "trace_events_total": len(rest),
+            "trace_tail": {
+                "traceEvents": meta + rest[-trace_tail:],
+                "displayTimeUnit": "ms",
+            },
+        }
+
     def fingerprint(self) -> str:
         """seed → ordered digest of the committed canonical chain. Two
         same-seed runs must match byte-for-byte (replay exactness)."""
@@ -860,4 +957,10 @@ class Cluster:
             valset_changes=walk[0],
             epoch_cache=self.epoch_cache_delta(),
             wall_budget_hit=wall_hit,
+            height_timelines=self.height_timelines(),
+            # the flight recorder rides ONLY on invariant failures — a
+            # green run keeps the report lean
+            flight_recorder=(
+                self.flight_recorder_dump() if violations else None
+            ),
         )

@@ -315,6 +315,9 @@ def search_schedules(
                 "seed": seed,
                 "reason": rep.reason,
                 "violations": rep.violations,
+                # the run's own evidence (ISSUE 10): per-node height
+                # timelines + merged trace tail, captured at failure time
+                "flight_recorder": rep.flight_recorder,
                 "schedule": [f.to_dict() for f in faults],
                 "minimal": [f.to_dict() for f in minimal],
                 "shrink_runs": shrink_runs,

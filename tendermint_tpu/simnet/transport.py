@@ -141,6 +141,17 @@ class SimNetwork:
         # where a slow big-cluster run's events actually go
         self.delivered_by_channel: Dict[int, int] = {}
         self._digest = hashlib.sha256()
+        # causal tracing (ISSUE 10): node_id -> per-node SpanTracer. When
+        # set (harness.Cluster with tracing on), every scheduled delivery
+        # gets a flow id from the clock's deterministic counter; the send
+        # records a "gossip.send" start on the sender's tracer and the
+        # delivery wraps the receiver in a "net.deliver" step span with
+        # the flow id parked on the receiver tracer, so consensus-side
+        # spans can finish the chain
+        self._tracers: Dict[str, object] = {}
+
+    def set_tracers(self, tracers: Dict[str, object]) -> None:
+        self._tracers = dict(tracers or {})
 
     # -- wiring ----------------------------------------------------------
 
@@ -219,6 +230,7 @@ class SimNetwork:
             copies = 2
             self.duplicated += 1
         now = self._clock.time()
+        sender_tr = self._tracers.get(from_id)
         for _ in range(copies):
             delay = cfg.latency_s
             if cfg.jitter_s > 0.0:
@@ -231,6 +243,15 @@ class SimNetwork:
                 tx = len(env.message) / cfg.bandwidth_bps
                 self._link_busy_until[key] = free + tx
                 delay += (free - now) + tx
+            # flow id per scheduled COPY (a duplicate is its own causal
+            # chain); allocated unconditionally so tracing never perturbs
+            # the deterministic counter stream
+            fid = self._clock.next_flow()
+            if sender_tr is not None and sender_tr.enabled:
+                sender_tr.flow_point(
+                    "gossip.send", fid, "s", to=to_id, ch=env.channel_id,
+                    bytes=len(env.message),
+                )
             delivery = Envelope(
                 from_id=from_id,
                 to_id=to_id,
@@ -238,11 +259,11 @@ class SimNetwork:
                 message=env.message,
             )
             self._clock.call_later(
-                delay, lambda d=delivery: self._deliver(d)
+                delay, lambda d=delivery, f=fid: self._deliver(d, f)
             )
         return True
 
-    def _deliver(self, env: Envelope) -> None:
+    def _deliver(self, env: Envelope, flow: Optional[int] = None) -> None:
         # partitions/crashes also eat messages already in flight
         if self._blocked(env.from_id, env.to_id):
             self.dropped += 1
@@ -264,6 +285,20 @@ class SimNetwork:
                 len(env.message),
             )
         )
+        tr = self._tracers.get(env.to_id)
+        if tr is not None and tr.enabled:
+            # step the flow through the delivery and park the id on the
+            # receiver's tracer: spans opened while the reactor handles
+            # this envelope (consensus.verify_dispatch) finish the chain
+            with tr.span("net.deliver", flow=flow, flow_phase="t",
+                         frm=env.from_id, ch=env.channel_id,
+                         bytes=len(env.message)):
+                tr.flow = flow
+                try:
+                    recv(env)
+                finally:
+                    tr.flow = None
+            return
         recv(env)
 
     def schedule_digest(self) -> str:

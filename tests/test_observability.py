@@ -381,7 +381,9 @@ class TestHotPathInstrumentation:
         """Tracing off must cost ~nothing on verify_batch: the per-call
         instrument overhead (the ~10 null-span entries a verify_batch
         dispatch walks through) must be < 2% of the measured verify_batch
-        wall clock."""
+        wall clock. Extended over flow-event sites (ISSUE 10): a span
+        carrying flow kwargs and a flow_point both take the same
+        single-attribute-check disabled path."""
         from tendermint_tpu.ops import backend
 
         monkeypatch.setenv("TM_TPU_PALLAS", "0")
@@ -400,7 +402,10 @@ class TestHotPathInstrumentation:
             for _ in range(n_ops):
                 with tr.span("x", n=64, bucket=128):
                     pass
-            per_span = (time.perf_counter() - t0) / n_ops
+                with tr.span("y", flow=123, flow_phase="t", bucket=128):
+                    pass
+                tr.TRACER.flow_point("z", 123, "s", n=64)
+            per_span = (time.perf_counter() - t0) / (3 * n_ops)
             # ~10 instrument sites fire per verify_batch dispatch
             assert per_span * 10 < 0.02 * verify_s, (per_span, verify_s)
         finally:

@@ -53,7 +53,7 @@ SMOKE_SEED = 42
 SMOKE_HEIGHT = 20  # the acceptance bar: partition+heal+crash/restart to h>=20
 
 
-def build_cluster(args, faults, link=None):
+def build_cluster(args, faults, link=None, tracing=None):
     from tendermint_tpu.simnet import Cluster, LinkConfig
 
     if link is None:
@@ -72,6 +72,7 @@ def build_cluster(args, faults, link=None):
         faults=faults,
         txs_per_node=args.txs,
         n_validators=args.validators or None,
+        tracing=tracing,
     )
 
 
@@ -105,10 +106,22 @@ def load_faults(args):
     return []
 
 
-def run_once(args, faults, link=None) -> dict:
+def run_once(args, faults, link=None, want_trace=False) -> tuple:
+    """One cluster run; returns (verdict_dict, merged_trace_doc_or_None).
+    The merged doc (ISSUE 10) is the CLUSTER export — per-node
+    virtual-clock tracers + the driver's wall-clock spans, flow chains
+    intact — not just the process-wide ring."""
     from tendermint_tpu.observability import trace as _trace
 
-    cluster = build_cluster(args, faults, link=link)
+    # per-node tracing only where the doc is actually kept: with --trace
+    # --repeat N, runs 1..N-1 force it OFF instead of paying full span
+    # recording for buffers that are discarded (tracing never perturbs a
+    # run, so replay-exactness across the repeats is unaffected)
+    cluster = build_cluster(
+        args, faults, link=link,
+        tracing=want_trace if args.trace else None,
+    )
+    merged = None
     try:
         with _trace.span("simnet.run", seed=args.seed, nodes=args.nodes):
             rep = cluster.run_to_height(
@@ -116,13 +129,15 @@ def run_once(args, faults, link=None) -> dict:
                 max_virtual_s=args.max_virtual_s,
                 max_wall_s=_wall_budget(args, None),
             )
+        if want_trace:
+            merged = cluster.export_merged_trace()
     finally:
         cluster.stop()  # closes WALs and removes the temp dir even on error
     out = rep.to_dict()
     out["commits_per_s"] = (
         round(rep.height / rep.wall_s, 2) if rep.wall_s > 0 else None
     )
-    return out
+    return out, merged
 
 
 def _wall_budget(args, mode_default):
@@ -362,7 +377,16 @@ def main() -> int:
 
     t0 = time.monotonic()
     faults = load_faults(args)
-    runs = [run_once(args, load_faults(args)) for _ in range(max(args.repeat, 1))]
+    runs = []
+    merged_doc = None
+    for i in range(max(args.repeat, 1)):
+        out, doc = run_once(
+            args, load_faults(args),
+            want_trace=bool(args.trace) and i == 0,
+        )
+        runs.append(out)
+        if doc is not None:
+            merged_doc = doc
     verdict = dict(runs[0])
     verdict["runs"] = len(runs)
     verdict["wall_total_s"] = round(time.monotonic() - t0, 3)
@@ -378,9 +402,8 @@ def main() -> int:
     if args.devcheck:
         _attach_devcheck(verdict)
 
-    if args.trace:
-        path = _trace.TRACER.dump(args.trace)
-        verdict["trace_path"] = path
+    if args.trace and merged_doc is not None:
+        verdict["trace_path"] = _trace.dump_doc(merged_doc, args.trace)
 
     print(json.dumps(verdict, indent=2, default=str))
     return 0 if verdict["ok"] else 1
