@@ -1,0 +1,22 @@
+"""Wall-clock Timestamp source — the sanctioned wall-clock read for
+light-client code.
+
+tmlint's simnet-determinism pass covers `tendermint_tpu/light/`
+(ISSUE 11): simnet-driven light clients and the batched verification
+service must read time through an injected clock, so the wall-clock
+DEFAULT lives here (libs/ is outside the deterministic scope) and rides
+in via the `now_fn` seams on light.client.Client and
+light.service.LightVerifyService.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def now_ts():
+    """Current wall clock as a wire.canonical.Timestamp."""
+    from ..wire.canonical import Timestamp
+
+    t = time.time()
+    return Timestamp(seconds=int(t), nanos=int((t % 1) * 1e9))

@@ -6,12 +6,17 @@ from .verifier import (  # noqa: F401
     ErrNotEnoughTrust,
     ErrOldHeaderExpired,
     header_expired,
+    prepare_adjacent,
+    prepare_non_adjacent,
+    prepare_verify,
     validate_trust_level,
     verify,
     verify_adjacent,
     verify_backwards,
     verify_non_adjacent,
 )
+from .batch import HeaderRequest  # noqa: F401
 from .client import Client, LightBlock, TrustOptions  # noqa: F401
 from .provider import Provider, NodeBackedProvider  # noqa: F401
+from .service import LightVerifyService  # noqa: F401
 from .store import LightStore  # noqa: F401

@@ -11,10 +11,10 @@ witnesses.
 from __future__ import annotations
 
 import http.client as _http
-import time as _time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Callable, List, Optional
 
+from ..libs.timeutil import now_ts as _now_ts
 from ..types import Fraction
 from ..wire.canonical import Timestamp
 from . import verifier
@@ -84,11 +84,6 @@ def make_attack_evidence(conflicted: LightBlock, trusted: LightBlock, common: Li
     return ev
 
 
-def _now_ts() -> Timestamp:
-    t = _time.time()
-    return Timestamp(seconds=int(t), nanos=int((t % 1) * 1e9))
-
-
 class Client:
     """light/client.go:130-1100."""
 
@@ -103,9 +98,14 @@ class Client:
         max_clock_drift: float = DEFAULT_MAX_CLOCK_DRIFT,
         sequential: bool = False,
         pruning_size: int = DEFAULT_PRUNING_SIZE,
+        now_fn: Optional[Callable[[], Timestamp]] = None,
     ):
         trust_options.validate()
         verifier.validate_trust_level(trust_level)
+        # injected clock (ISSUE 11 satellite): simnet-driven light
+        # clients read virtual time through here; the wall-clock default
+        # lives in libs/timeutil, outside tmlint's deterministic scope
+        self._now_ts = now_fn or _now_ts
         self._chain_id = chain_id
         self._trusting_period = trust_options.period
         self._trust_level = trust_level
@@ -273,7 +273,7 @@ class Client:
         """client.go:406-487."""
         if height <= 0:
             raise ValueError("height must be positive")
-        now = now or _now_ts()
+        now = now or self._now_ts()
         existing = self._store.light_block(height)
         if existing is not None:
             return existing

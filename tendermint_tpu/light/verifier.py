@@ -9,7 +9,10 @@ pipelined 1k-header sync workload of BASELINE config #5.
 
 from __future__ import annotations
 
+from typing import Callable, List
+
 from ..types import ErrNotEnoughVotingPowerSigned, Fraction, SignedHeader, ValidatorSet
+from ..types import validation as _validation
 from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
@@ -88,15 +91,125 @@ def verify_new_header_and_vals(
         )
 
 
-def verify_adjacent(
+class SigCheck:
+    """One commit-signature check of a header verification (ISSUE 11).
+
+    The prepare_* functions below run every NON-sig check host-side
+    (heights, trust level, expiry, hash chaining, clock drift — exactly
+    the lines the old verify_* bodies ran) and return the sig work as
+    SigCheck objects instead of verifying in place. Two consumers:
+
+      run_sync()  the sequential path — calls the SAME types.validation
+                  entry point the old code called, with the identical
+                  error wrapping, so verify_adjacent/verify_non_adjacent
+                  keep their byte-for-byte behavior;
+      prepare()   the batched light service — returns (entries, conclude)
+                  where `entries` is the check's EntryBlock (epoch
+                  metadata attached) to ship through the shared device
+                  pipeline and `conclude(valid)` raises the identical
+                  (wrapped) error over the device verdict row. A check
+                  the async seam cannot represent falls back to
+                  run_sync() inside prepare() and returns (None, None),
+                  as does the sub-threshold single-signature path.
+    """
+
+    __slots__ = ("kind", "_run", "_prep", "_wrap")
+
+    def __init__(self, kind: str, run: Callable[[], None],
+                 prep: Callable[[], tuple],
+                 wrap: Callable[[BaseException], BaseException]):
+        self.kind = kind
+        self._run = run
+        self._prep = prep
+        self._wrap = wrap
+
+    def _raise(self, e: BaseException):
+        w = self._wrap(e)
+        if w is e:
+            raise
+        raise w from e
+
+    def run_sync(self) -> None:
+        try:
+            self._run()
+        except Exception as e:  # noqa: BLE001 — wrap decides
+            self._raise(e)
+
+    def prepare(self):
+        try:
+            entries, conclude = self._prep()
+        except _validation.PrepareUnsupported:
+            self.run_sync()
+            return None, None
+        except Exception as e:  # noqa: BLE001 — wrap decides
+            self._raise(e)
+        if conclude is None:
+            return None, None
+
+        def _conclude(valid) -> None:
+            try:
+                conclude(valid)
+            except Exception as e:  # noqa: BLE001 — wrap decides
+                self._raise(e)
+
+        return entries, _conclude
+
+
+def _wrap_trusting(e: BaseException) -> BaseException:
+    """verify_non_adjacent's trusting-stage wrapping (verifier.go:67-80):
+    only insufficient tallied power is a (retryable) trust failure — any
+    other commit defect is an invalid header."""
+    if isinstance(e, ErrNotEnoughVotingPowerSigned):
+        return ErrNotEnoughTrust(str(e))
+    if isinstance(e, ValueError):
+        return ErrInvalidHeader(str(e))
+    return e
+
+
+def _wrap_light(e: BaseException) -> BaseException:
+    """The +2/3 commit check's wrapping (verifier.go:143-148): any commit
+    defect surfaces as ErrInvalidHeader."""
+    if isinstance(e, ErrInvalidHeader):
+        return e
+    if isinstance(e, ValueError):
+        return ErrInvalidHeader(str(e))
+    return e
+
+
+def _light_check(chain_id: str, vals: ValidatorSet, block_id, height: int,
+                 commit) -> SigCheck:
+    return SigCheck(
+        "light",
+        run=lambda: verify_commit_light(chain_id, vals, block_id, height, commit),
+        prep=lambda: _validation.prepare_commit_light(
+            chain_id, vals, block_id, height, commit
+        ),
+        wrap=_wrap_light,
+    )
+
+
+def _trusting_check(chain_id: str, vals: ValidatorSet, commit,
+                    trust_level: Fraction) -> SigCheck:
+    return SigCheck(
+        "trusting",
+        run=lambda: verify_commit_light_trusting(chain_id, vals, commit, trust_level),
+        prep=lambda: _validation.prepare_commit_light_trusting(
+            chain_id, vals, commit, trust_level
+        ),
+        wrap=_wrap_trusting,
+    )
+
+
+def prepare_adjacent(
     trusted_header: SignedHeader,
     untrusted_header: SignedHeader,
     untrusted_vals: ValidatorSet,
     trusting_period: float,
     now: Timestamp,
     max_clock_drift: float,
-) -> None:
-    """verifier.go:103-150."""
+) -> List[SigCheck]:
+    """verifier.go:103-150 host checks; returns the sig work (one +2/3
+    commit check) instead of running it."""
     if untrusted_header.header.height != trusted_header.header.height + 1:
         raise ValueError("headers must be adjacent in height")
     if header_expired(trusted_header, trusting_period, now):
@@ -110,20 +223,92 @@ def verify_adjacent(
             f"expected old header next validators ({trusted_header.header.next_validators_hash.hex()}) "
             f"to match those from new header ({untrusted_header.header.validators_hash.hex()})"
         )
-    # full commit verification on the device engine (verifier.go:143-148);
-    # any commit defect surfaces as ErrInvalidHeader
-    try:
-        verify_commit_light(
+    return [
+        _light_check(
             trusted_header.header.chain_id,
             untrusted_vals,
             untrusted_header.commit.block_id,
             untrusted_header.header.height,
             untrusted_header.commit,
         )
-    except ErrInvalidHeader:
-        raise
-    except ValueError as e:
-        raise ErrInvalidHeader(str(e)) from e
+    ]
+
+
+def prepare_non_adjacent(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+    trust_level: Fraction,
+) -> List[SigCheck]:
+    """verifier.go:33-101 host checks; returns the sig work — the
+    trust-level check against the OLD set, then the full +2/3 of the NEW
+    set, IN ORDER (the service applies verdicts in stage order so error
+    precedence matches the sequential path)."""
+    if untrusted_header.header.height == trusted_header.header.height + 1:
+        raise ValueError("headers must be non adjacent in height")
+    validate_trust_level(trust_level)
+    if header_expired(trusted_header, trusting_period, now):
+        raise ErrOldHeaderExpired(f"old header has expired at {now}")
+    verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
+    )
+    chain_id = trusted_header.header.chain_id
+    return [
+        _trusting_check(
+            chain_id, trusted_vals, untrusted_header.commit, trust_level
+        ),
+        _light_check(
+            chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        ),
+    ]
+
+
+def prepare_verify(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+    trust_level: Fraction,
+) -> List[SigCheck]:
+    """verifier.go:152-176 Verify dispatch, over the prepare seam."""
+    if untrusted_header.header.height != trusted_header.header.height + 1:
+        return prepare_non_adjacent(
+            trusted_header, trusted_vals, untrusted_header, untrusted_vals,
+            trusting_period, now, max_clock_drift, trust_level,
+        )
+    return prepare_adjacent(
+        trusted_header, untrusted_header, untrusted_vals,
+        trusting_period, now, max_clock_drift,
+    )
+
+
+def verify_adjacent(
+    trusted_header: SignedHeader,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period: float,
+    now: Timestamp,
+    max_clock_drift: float,
+) -> None:
+    """verifier.go:103-150: the prepare seam driven synchronously —
+    full commit verification on the device engine (verifier.go:143-148);
+    any commit defect surfaces as ErrInvalidHeader."""
+    for chk in prepare_adjacent(
+        trusted_header, untrusted_header, untrusted_vals,
+        trusting_period, now, max_clock_drift,
+    ):
+        chk.run_sync()
 
 
 def verify_non_adjacent(
@@ -136,42 +321,12 @@ def verify_non_adjacent(
     max_clock_drift: float,
     trust_level: Fraction,
 ) -> None:
-    """verifier.go:33-101."""
-    if untrusted_header.header.height == trusted_header.header.height + 1:
-        raise ValueError("headers must be non adjacent in height")
-    validate_trust_level(trust_level)
-    if header_expired(trusted_header, trusting_period, now):
-        raise ErrOldHeaderExpired(f"old header has expired at {now}")
-    verify_new_header_and_vals(
-        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
-    )
-    # trust-level check against the OLD validator set (verifier.go:67-80):
-    # only insufficient tallied power is a (retryable) trust failure —
-    # any other commit defect is an invalid header.
-    try:
-        verify_commit_light_trusting(
-            trusted_header.header.chain_id,
-            trusted_vals,
-            untrusted_header.commit,
-            trust_level,
-        )
-    except ErrNotEnoughVotingPowerSigned as e:
-        raise ErrNotEnoughTrust(str(e)) from e
-    except ValueError as e:
-        raise ErrInvalidHeader(str(e)) from e
-    # then the full +2/3 of the NEW set (verifier.go:82-88)
-    try:
-        verify_commit_light(
-            trusted_header.header.chain_id,
-            untrusted_vals,
-            untrusted_header.commit.block_id,
-            untrusted_header.header.height,
-            untrusted_header.commit,
-        )
-    except ErrInvalidHeader:
-        raise
-    except ValueError as e:
-        raise ErrInvalidHeader(str(e)) from e
+    """verifier.go:33-101: the prepare seam driven synchronously."""
+    for chk in prepare_non_adjacent(
+        trusted_header, trusted_vals, untrusted_header, untrusted_vals,
+        trusting_period, now, max_clock_drift, trust_level,
+    ):
+        chk.run_sync()
 
 
 def verify(

@@ -90,6 +90,30 @@ def mock_mesh_prepare(real_prepare, rtt_s: float):
     return prep
 
 
+def mock_light_prepare(real_prepare, rtt_s: float):
+    """Mocked-relay DEVICE for `bench.py light` and the
+    `tools/prep_bench.py --light` throughput figure: the real host prep
+    (sign-bytes, epoch grouping, coalescing, packing) and the H2D
+    transfer run unchanged, but the launch returns an all-accept verdict
+    row behind a fixed relay RTT instead of running the kernel — the
+    mock_mesh_prepare philosophy applied to the classic single-lane
+    `_prepare`. What the light-service curve then measures is exactly
+    what the service adds over per-request dispatch: cross-request
+    epoch-grouped coalescing (headers per relay command) and
+    request-level dedup, not kernel speed."""
+    import numpy as np
+
+    def prep(entries):
+        _f, args, rlc, bucket = real_prepare(entries)
+
+        def launch(*_xs):
+            return SlowReadback(np.ones((bucket,), dtype=bool), rtt_s)
+
+        return launch, args, rlc, bucket
+
+    return prep
+
+
 def drain_pool(pool, timeout: float = 5.0) -> None:
     """Wait for every in-flight slot to return. The resolver completes a
     batch's futures BEFORE releasing its pool slot, so a caller waking

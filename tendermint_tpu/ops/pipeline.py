@@ -126,15 +126,20 @@ class DispatchError(RuntimeError):
 
 
 class _Job:
-    __slots__ = ("entries", "future", "flow")
+    __slots__ = ("entries", "future", "flow", "flow_owned")
 
     def __init__(self, entries: EntryBlock):
         self.entries = entries
         self.future: Future = Future()
         # flow correlation id (ISSUE 10): allocated at submit() when the
         # tracer is live, threaded through the coalesced batch so the
-        # dispatch/verdict instants chain back to the submitting caller
+        # dispatch/verdict instants chain back to the submitting caller.
+        # flow_owned=False (ISSUE 11) marks a CONTINUED caller flow (the
+        # light service's RPC-arrival → verdict chain): the verdict
+        # instant then steps ("t") instead of finishing ("f") so the
+        # caller owns the chain's terminal event.
         self.flow: Optional[int] = None
+        self.flow_owned = True
 
 
 class AsyncBatchVerifier:
@@ -215,7 +220,7 @@ class AsyncBatchVerifier:
         self._dispatch_thread.start()
         self._resolve_thread.start()
 
-    def submit(self, entries) -> Future:
+    def submit(self, entries, flow: Optional[int] = None) -> Future:
         if self._stopped.is_set():
             raise RuntimeError("verifier is closed")
         block = as_block(entries)
@@ -225,18 +230,30 @@ class AsyncBatchVerifier:
             # submissions at the lane capacity so every chunk fits one
             max_b = min(max_b, _mesh.lane_cap())
         if len(block) > max_b:
-            return self._submit_chunked(block, max_b)
+            return self._submit_chunked(block, max_b, flow)
         job = _Job(block)
         if _trace.TRACER.enabled:
-            job.flow = _trace.next_flow()
-            _trace.TRACER.flow_point(
-                "pipeline.submit", job.flow, "s", n=len(block)
-            )
+            if flow is not None:
+                # continue the CALLER's flow (ISSUE 11: the light
+                # service chains RPC arrival → epoch-group → mesh_pack →
+                # verdict through the pipeline); the caller emits the
+                # finish, so this submit and the verdict both step
+                job.flow = int(flow)
+                job.flow_owned = False
+                _trace.TRACER.flow_point(
+                    "pipeline.submit", job.flow, "t", n=len(block)
+                )
+            else:
+                job.flow = _trace.next_flow()
+                _trace.TRACER.flow_point(
+                    "pipeline.submit", job.flow, "s", n=len(block)
+                )
         self._q.put(job)
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
 
-    def _submit_chunked(self, block: EntryBlock, max_b: int) -> Future:
+    def _submit_chunked(self, block: EntryBlock, max_b: int,
+                        flow: Optional[int] = None) -> Future:
         """An oversized job rides as zero-copy slices through the normal
         queue (the dispatcher stays the only device-touching thread; the
         old path ran a chunked synchronous fallback on the worker) and
@@ -244,7 +261,7 @@ class AsyncBatchVerifier:
         futs: List[Future] = []
         i = 0
         while i < len(block):
-            futs.append(self.submit(block[i : i + max_b]))
+            futs.append(self.submit(block[i : i + max_b], flow=flow))
             i += max_b
         agg: Future = Future()
         done_lock = threading.Lock()
@@ -465,7 +482,9 @@ class AsyncBatchVerifier:
             for job, _off, n in spans:
                 if getattr(job, "flow", None) is not None:
                     _trace.TRACER.flow_point(
-                        "pipeline.verdict", job.flow, "f", n=n
+                        "pipeline.verdict", job.flow,
+                        "f" if getattr(job, "flow_owned", True) else "t",
+                        n=n,
                     )
 
     def _worker(self) -> None:

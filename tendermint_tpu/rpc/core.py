@@ -636,6 +636,76 @@ class Environment:
                 continue
         return {"blocks": blocks, "total_count": str(len(heights))}
 
+    # -- light-client verification service (ISSUE 11) ---------------------
+
+    def _light_service(self):
+        """Lazy per-environment LightVerifyService bound to the node's
+        shared device pipeline — requests are self-contained (headers +
+        valsets ride in the call), so the node's own stores are not
+        consulted."""
+        svc = getattr(self, "_light_svc", None)
+        if svc is None:
+            from ..light.service import LightVerifyService
+
+            svc = self._light_svc = LightVerifyService()
+        return svc
+
+    def light_verify(self, requests=None, timeout: float = 60.0,
+                     stream: bool = False):
+        """Batched light-client header verification: many (trusted,
+        untrusted) pairs verified through the shared device pipeline —
+        sig work grouped by valset epoch and coalesced ACROSS requests,
+        non-sig checks bit-identical to light/verifier.py. Verdicts are
+        listed in COMPLETION order (each carries its request `index`);
+        `stream=true` returns them as chunked NDJSON lines as device
+        batches resolve instead of one JSON body."""
+        from ..light import service as _lsvc
+
+        if isinstance(requests, str):
+            try:
+                requests = json.loads(requests)
+            except json.JSONDecodeError as e:
+                raise RPCError(-32602, f"requests is not JSON: {e}") from e
+        if not isinstance(requests, list) or not requests:
+            raise RPCError(-32602, "requests must be a non-empty list")
+        try:
+            reqs = [_lsvc.request_from_json(d) for d in requests]
+        except (KeyError, ValueError, TypeError) as e:
+            raise RPCError(-32602, f"bad light_verify request: {e}") from e
+        svc = self._light_service()
+        batch = svc.submit_many(reqs)
+        timeout = float(timeout)
+        # GET params arrive as strings — accept the usual truthy spellings
+        if str(stream).lower() in ("true", "1", "yes", "on"):
+            def gen():
+                # a deadline expiry must still terminate the chunked
+                # stream cleanly (error line + terminator), never escape
+                # mid-response after the 200 headers went out
+                try:
+                    for v in batch.stream(timeout=timeout):
+                        yield v
+                except TimeoutError as e:
+                    yield {"done": False, "error": str(e),
+                           "total": len(batch), "stats": svc.stats()}
+                    return
+                yield {
+                    "done": True,
+                    "total": len(batch),
+                    "stats": svc.stats(),
+                }
+
+            return gen()
+        try:
+            verdicts = list(batch.stream(timeout=timeout))
+        except TimeoutError as e:
+            raise RPCError(-32603, str(e)) from e
+        return {
+            "verdicts": verdicts,
+            "total": str(len(verdicts)),
+            "ok_count": str(sum(1 for v in verdicts if v["ok"])),
+            "stats": svc.stats(),
+        }
+
     # -- subscriptions (events.go; served over the websocket endpoint) ----
 
     def _subscribe(self, subscriber: str, query: str):
@@ -657,7 +727,7 @@ ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "tx", "tx_search", "block_search", "num_unconfirmed_txs",
     "unconfirmed_txs", "check_tx", "remove_tx", "broadcast_evidence",
-    "dump_trace", "height_timeline",
+    "dump_trace", "height_timeline", "light_verify",
 ]
 
 # routes.go:56-60 AddUnsafe — mounted only when rpc.unsafe is configured.

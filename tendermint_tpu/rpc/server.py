@@ -54,6 +54,9 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     def _call(self, method: str, params: dict, id_):
+        """Returns the response BYTES, or a generator when the method
+        streams (the /light_verify verdict stream) — callers send the
+        latter through _send_stream as chunked NDJSON."""
         if not self._route_allowed(method):
             return _rpc_response(
                 id_, error=RPCError(-32601, f"Method not found: {method}")
@@ -65,6 +68,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
         try:
             result = fn(**params) if params else fn()
+            if hasattr(result, "__next__"):
+                return result  # streaming method: items, not one body
             return _rpc_response(id_, result=result)
         except RPCError as e:
             return _rpc_response(id_, error=e)
@@ -72,6 +77,53 @@ class _Handler(BaseHTTPRequestHandler):
             return _rpc_response(id_, error=RPCError(-32602, f"Invalid params: {e}"))
         except Exception as e:  # noqa: BLE001 — internal error on the wire
             return _rpc_response(id_, error=RPCError(-32603, f"Internal error: {e}"))
+
+    def _call_bytes(self, method: str, params: dict, id_) -> bytes:
+        """Batch JSON-RPC slots cannot stream: a streaming result inside
+        a batch collapses to an error response instead of corrupting the
+        batch body. The generator is NOT drained — the work behind it
+        was already submitted and resolves (and memoizes) on its own;
+        draining would only park this handler thread until the batch's
+        deadline."""
+        resp = self._call(method, params, id_)
+        if isinstance(resp, bytes):
+            return resp
+        resp.close()
+        return _rpc_response(
+            id_, error=RPCError(
+                -32600, "streaming methods are not supported in a batch"
+            )
+        )
+
+    def _send_stream(self, gen) -> None:
+        """Chunked NDJSON (application/x-ndjson): one JSON object per
+        line, flushed as each item resolves — the streaming half of
+        /light_verify (verdicts arrive as device batches complete)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            try:
+                for item in gen:
+                    line = json.dumps(item).encode() + b"\n"
+                    self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
+                    self.wfile.flush()
+            except Exception as e:  # noqa: BLE001 — headers already sent:
+                # the only honest move left is an error line + terminator
+                line = json.dumps(
+                    {"done": False, "error": f"stream failed: {e}"}
+                ).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream; verdicts already resolved
+
+    def _respond(self, resp) -> None:
+        if isinstance(resp, bytes):
+            self._send(200, resp)
+        else:
+            self._send_stream(resp)
 
     def do_POST(self):  # noqa: N802
         try:
@@ -84,7 +136,7 @@ class _Handler(BaseHTTPRequestHandler):
         if isinstance(req, list):
             out = [
                 json.loads(
-                    self._call(r.get("method", ""), r.get("params") or {}, r.get("id"))
+                    self._call_bytes(r.get("method", ""), r.get("params") or {}, r.get("id"))
                 )
                 if isinstance(r, dict)
                 else json.loads(_rpc_response(None, error=RPCError(-32600, "Invalid Request")))
@@ -101,8 +153,7 @@ class _Handler(BaseHTTPRequestHandler):
         method = req.get("method", "")
         if not isinstance(method, str):
             method = ""
-        resp = self._call(method, params, req.get("id"))
-        self._send(200, resp)
+        self._respond(self._call(method, params, req.get("id")))
 
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
@@ -125,8 +176,7 @@ class _Handler(BaseHTTPRequestHandler):
         for k, v in parse_qsl(parsed.query):
             v = v.strip('"')
             params[k] = v
-        resp = self._call(method, params, -1)
-        self._send(200, resp)
+        self._respond(self._call(method, params, -1))
 
 
 class RPCServer:

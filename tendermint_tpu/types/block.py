@@ -179,13 +179,24 @@ class Header:
     last_results_hash: bytes = b""
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
+    # memoized merkle root: the class is FROZEN so the 14-leaf tree can
+    # never change under a live instance, and init=False makes
+    # dataclasses.replace() re-default the memo to None (a forged-header
+    # copy must never inherit the original's hash). compare=False keeps
+    # __eq__/__hash__ on the real fields.
+    _hash_memo: Optional[bytes] = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def hash(self) -> bytes:
         """Merkle root of proto-encoded fields (types/block.go:448-483).
         Returns b"" when the header is incomplete (nil in Go)."""
         if not self.validators_hash:
             return b""
-        return merkle.hash_from_byte_slices(
+        h = self._hash_memo
+        if h is not None:
+            return h
+        h = merkle.hash_from_byte_slices(
             [
                 self.version.encode(),
                 cdc_encode_string(self.chain_id),
@@ -203,6 +214,8 @@ class Header:
                 cdc_encode_bytes(self.proposer_address),
             ]
         )
+        object.__setattr__(self, "_hash_memo", h)
+        return h
 
     def encode(self) -> bytes:
         w = ProtoWriter()

@@ -165,6 +165,244 @@ def validate_hash(h: bytes) -> None:
         raise ValueError(f"expected size to be {tmhash.SIZE} bytes, got {len(h)} bytes")
 
 
+class PrepareUnsupported(Exception):
+    """prepare_commit_batch cannot represent this commit/valset for the
+    async seam (e.g. a non-columnar or mixed-key validator set); the
+    caller falls back to the synchronous verify path, which handles
+    every case the reference handles."""
+
+
+def prepare_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID,
+                         height: int, commit: Commit):
+    """verify_commit_light's host half (ISSUE 11 seam): the basic
+    val/commit binding checks plus prepare_commit_batch with the light
+    predicates. Returns (entries, conclude); (None, None) means the
+    commit rode the sub-threshold single-signature path synchronously
+    and is already fully verified. Raises exactly what
+    verify_commit_light raises host-side, or PrepareUnsupported when the
+    async seam cannot represent the set."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    if not _should_batch_verify(vals, commit):
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            _ignore_not_for_block, _count_all, False, True,
+        )
+        return None, None
+    return prepare_commit_batch(
+        chain_id, vals, commit, voting_power_needed,
+        _ignore_not_for_block, _count_all, False, True,
+    )
+
+
+def prepare_commit_light_trusting(chain_id: str, vals: ValidatorSet,
+                                  commit: Commit, trust_level: Fraction):
+    """verify_commit_light_trusting's host half (ISSUE 11 seam): nil and
+    overflow checks, by-address selection with double-vote detection and
+    the trust-level tally — returning the sig work instead of verifying
+    in place. Same return/raise contract as prepare_commit_light."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    total_mul, overflow = safe_mul(vals.total_voting_power(), trust_level.numerator)
+    if overflow:
+        raise OverflowError(
+            "int64 overflow while calculating voting power needed; "
+            "please provide smaller trustLevel numerator"
+        )
+    voting_power_needed = total_mul // trust_level.denominator
+    if not _should_batch_verify(vals, commit):
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            _ignore_not_for_block, _count_all, False, False,
+        )
+        return None, None
+    return prepare_commit_batch(
+        chain_id, vals, commit, voting_power_needed,
+        _ignore_not_for_block, _count_all, False, False,
+    )
+
+
+def _blame_conclude(sig_idxs, commit):
+    """The verdict half of _verify_commit_batch over a device validity
+    row: all-valid returns, otherwise the FIRST invalid lane maps back
+    through the selection to the reference's blame string
+    (validation.go:242-248)."""
+    import numpy as _np
+
+    def conclude(valid) -> None:
+        valid_arr = _np.asarray(valid, dtype=bool)
+        if valid_arr.size and valid_arr.all():
+            return
+        if not valid_arr.all() and valid_arr.size:
+            idx = int(sig_idxs[int(_np.argmin(valid_arr))])
+            sig = commit.signatures[idx]
+            raise ValueError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+            )
+        raise RuntimeError(
+            "BUG: batch verification failed with no invalid signatures"
+        )
+
+    return conclude
+
+
+def prepare_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+):
+    """The host half of _verify_commit_batch with the device verify
+    EXTRACTED (ISSUE 11): selection, double-vote detection, length
+    checks and the voting-power tally run here, but instead of calling
+    bv.verify() the prepared EntryBlock is RETURNED (epoch metadata
+    attached, so the shared AsyncBatchVerifier can coalesce it with
+    other same-epoch work across requests) together with a
+    conclude(valid) callable reproducing the exact blame errors.
+    Host-side failures raise exactly what _verify_commit_batch raises
+    before its verify call."""
+    proposer = vals.get_proposer()
+    if (
+        proposer is None
+        or not _batch.supports_batch_verifier(proposer.pub_key)
+        or len(commit.signatures) < BATCH_VERIFY_THRESHOLD
+    ):
+        raise RuntimeError(
+            "unsupported signature algorithm or insufficient signatures for batch verification"
+        )
+    cols = vals.ed25519_columns()
+    if cols is None:
+        # mixed/non-ed25519 set: the EntryBlock seam is ed25519-shaped;
+        # the synchronous path (per-key typed add) covers this correctly
+        raise PrepareUnsupported("validator set is not columnar ed25519")
+    if look_up_by_index:
+        fused = _fused_commit_prep(
+            chain_id, vals, commit, voting_power_needed,
+            ignore_sig, count_sig, count_all_signatures,
+        )
+        if fused is not None:
+            sel_idx, tallied, eblk = fused
+            if eblk is None:
+                raise ErrNotEnoughVotingPowerSigned(
+                    got=tallied, needed=voting_power_needed
+                )
+            return eblk, _blame_conclude(sel_idx, commit)
+    selected, tallied = _select_commit_sigs(
+        vals, commit, voting_power_needed,
+        ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+    )
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    import numpy as _np
+
+    from ..ops import epoch_cache as _epoch
+    from ..ops.entry_block import EntryBlock
+
+    batch_sig_idxs = [i for i, _, _ in selected]
+    with _span("verify_commit.sign_bytes", n=len(selected)):
+        buf, offsets = commit.vote_sign_bytes_block(chain_id, batch_sig_idxs)
+    # gather pub rows from the cached columns (key TYPE safety is
+    # structural: ed25519_columns is None for any mixed set) and attach
+    # the epoch metadata so warm epochs ship only per-sig data —
+    # val_idx rows are VALIDATOR-SET rows (the device-table gather key),
+    # which differ from signature indexes on the by-address path
+    rows = _np.asarray([r for _, r, _ in selected], dtype=_np.int32)
+    pub = cols[0][rows]
+    epoch_key = _epoch.note_valset(vals)
+    sigs_list = commit.signatures
+    sig = _np.frombuffer(
+        b"".join(sigs_list[i].signature for i in batch_sig_idxs),
+        dtype=_np.uint8,
+    ).reshape(len(selected), 64)
+    eblk = EntryBlock(pub, sig, buf, offsets,
+                      val_idx=rows, epoch_key=epoch_key)
+    return eblk, _blame_conclude(batch_sig_idxs, commit)
+
+
+def _select_commit_sigs(
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+):
+    """Selection + tally half of the batch path (validation.go:152-240):
+    flag filtering, by-index/by-address lookup with double-vote
+    detection, signature-length checks, and the voting-power tally with
+    the reference's early-stop semantics. Returns (selected, tallied)
+    with selected = [(sig_idx, val_row, validator), ...] in signature
+    order — val_row is the validator's row in `vals` (== sig_idx when
+    looking up by index). Raises exactly the errors the inline selection
+    raised. Shared by _verify_commit_batch and prepare_commit_batch so
+    the sequential and batched-service paths cannot drift."""
+    tallied = 0
+    if count_all_signatures and look_up_by_index and ignore_sig is _ignore_absent:
+        # verify_commit's exact predicate set on a 10k-validator commit is
+        # the benchmark hot path: flag-attribute listcomps cut the
+        # 3-calls-per-signature selection ~3x. The whole selection is
+        # GIL-held, so this directly bounds how many concurrent commit
+        # verifies the async device pipeline can keep fed.
+        from .block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
+
+        sigs = commit.signatures
+        validators = vals.validators
+        flags = [c.block_id_flag for c in sigs]
+        selected = [
+            (i, i, validators[i])
+            for i, f in enumerate(flags)
+            if f != BLOCK_ID_FLAG_ABSENT
+        ]
+        if any(len(sigs[i].signature) != 64 for i, _, _ in selected):
+            raise ValueError("invalid signature length")
+        if count_sig is _count_for_block:
+            tallied = sum(
+                validators[i].voting_power
+                for i, f in enumerate(flags)
+                if f == BLOCK_ID_FLAG_COMMIT
+            )
+        else:
+            tallied = sum(v.voting_power for _, _, v in selected)
+        return selected, tallied
+    selected = []  # (sig_idx, val_row, val) in signature order
+    seen_vals: dict = {}
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val_row, val = idx, vals.validators[idx]
+        else:
+            val_row, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_row in seen_vals:
+                raise ValueError(
+                    f"double vote from {val} ({seen_vals[val_row]} and {idx})"
+                )
+            seen_vals[val_row] = idx
+        # length check here, not at the deferred bv.add below — the
+        # error must surface per-lane before the voting-power tally
+        # concludes, exactly as when add() ran inside this loop
+        # (BatchVerifier.Add order, crypto/ed25519/ed25519.go:203-217)
+        if len(commit_sig.signature) != 64:
+            raise ValueError("invalid signature length")
+        selected.append((idx, val_row, val))
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    return selected, tallied
+
+
 def _fused_commit_prep(
     chain_id: str,
     vals: ValidatorSet,
@@ -211,8 +449,6 @@ def _verify_commit_batch(
     look_up_by_index: bool,
 ) -> None:
     """validation.go:152-263."""
-    tallied = 0
-    seen_vals: dict = {}
     proposer = vals.get_proposer()
     bv = _batch.create_batch_verifier(proposer.pub_key if proposer else None)
     if bv is None or len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
@@ -258,59 +494,11 @@ def _verify_commit_batch(
             raise RuntimeError(
                 "BUG: batch verification failed with no invalid signatures"
             )
-    if count_all_signatures and look_up_by_index and ignore_sig is _ignore_absent:
-        # verify_commit's exact predicate set on a 10k-validator commit is
-        # the benchmark hot path: flag-attribute listcomps cut the
-        # 3-calls-per-signature selection ~3x. The whole selection is
-        # GIL-held, so this directly bounds how many concurrent commit
-        # verifies the async device pipeline can keep fed.
-        from .block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
-
-        sigs = commit.signatures
-        validators = vals.validators
-        flags = [c.block_id_flag for c in sigs]
-        selected = [
-            (i, validators[i])
-            for i, f in enumerate(flags)
-            if f != BLOCK_ID_FLAG_ABSENT
-        ]
-        if any(len(sigs[i].signature) != 64 for i, _ in selected):
-            raise ValueError("invalid signature length")
-        if count_sig is _count_for_block:
-            tallied = sum(
-                validators[i].voting_power
-                for i, f in enumerate(flags)
-                if f == BLOCK_ID_FLAG_COMMIT
-            )
-        else:
-            tallied = sum(v.voting_power for _, v in selected)
-    else:
-        selected = []  # (idx, val) in signature order
-        for idx, commit_sig in enumerate(commit.signatures):
-            if ignore_sig(commit_sig):
-                continue
-            if look_up_by_index:
-                val = vals.validators[idx]
-            else:
-                val_idx, val = vals.get_by_address(commit_sig.validator_address)
-                if val is None:
-                    continue
-                if val_idx in seen_vals:
-                    raise ValueError(
-                        f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
-                    )
-                seen_vals[val_idx] = idx
-            # length check here, not at the deferred bv.add below — the
-            # error must surface per-lane before the voting-power tally
-            # concludes, exactly as when add() ran inside this loop
-            # (BatchVerifier.Add order, crypto/ed25519/ed25519.go:203-217)
-            if len(commit_sig.signature) != 64:
-                raise ValueError("invalid signature length")
-            selected.append((idx, val))
-            if count_sig(commit_sig):
-                tallied += val.voting_power
-            if not count_all_signatures and tallied > voting_power_needed:
-                break
+    sel_rows, tallied = _select_commit_sigs(
+        vals, commit, voting_power_needed,
+        ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+    )
+    selected = [(idx, val) for idx, _, val in sel_rows]
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
     batch_sig_idxs = [idx for idx, _ in selected]

@@ -130,3 +130,103 @@ def parse_validator_set(d) -> ValidatorSet:
     vs = ValidatorSet(validators=vals)
     vs._update_total_voting_power()
     return vs
+
+
+# ---------------------------------------------------------------------------
+# core-type -> JSON encoding (ISSUE 11): the exact inverse of the parsers
+# above, shape-identical to rpc/core.py's /commit and /validators results
+# so /light_verify requests round-trip through one codec.
+# ---------------------------------------------------------------------------
+
+
+def time_to_json(ts: Timestamp) -> str:
+    from ..types.genesis import _time_to_rfc3339
+
+    return _time_to_rfc3339(ts)
+
+
+def _hexs(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def block_id_to_json(bid: BlockID) -> dict:
+    return {
+        "hash": _hexs(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": _hexs(bid.part_set_header.hash),
+        },
+    }
+
+
+def header_to_json(h: Header) -> dict:
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": time_to_json(h.time),
+        "last_block_id": block_id_to_json(h.last_block_id),
+        "last_commit_hash": _hexs(h.last_commit_hash),
+        "data_hash": _hexs(h.data_hash),
+        "validators_hash": _hexs(h.validators_hash),
+        "next_validators_hash": _hexs(h.next_validators_hash),
+        "consensus_hash": _hexs(h.consensus_hash),
+        "app_hash": _hexs(h.app_hash),
+        "last_results_hash": _hexs(h.last_results_hash),
+        "evidence_hash": _hexs(h.evidence_hash),
+        "proposer_address": _hexs(h.proposer_address),
+    }
+
+
+def commit_to_json(c: Commit) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": block_id_to_json(c.block_id),
+        "signatures": [
+            {
+                "block_id_flag": cs.block_id_flag,
+                "validator_address": _hexs(cs.validator_address),
+                "timestamp": time_to_json(cs.timestamp),
+                "signature": (
+                    base64.b64encode(cs.signature).decode()
+                    if cs.signature
+                    else None
+                ),
+            }
+            for cs in c.signatures
+        ],
+    }
+
+
+def signed_header_to_json(sh: SignedHeader) -> dict:
+    return {
+        "header": header_to_json(sh.header),
+        "commit": commit_to_json(sh.commit),
+    }
+
+
+def validator_set_to_json(vs: ValidatorSet) -> dict:
+    # parse_validator (above) accepts only ed25519 — refuse to emit a
+    # foreign key under the ed25519 type tag (the bytes would round-trip
+    # into a mismatched-address parse error at best)
+    for v in vs.validators:
+        if v.pub_key.type() != "ed25519":
+            raise ValueError(
+                f"validator pubkey type {v.pub_key.type()!r} has no JSON "
+                f"wire form here (ed25519 only)"
+            )
+    return {
+        "validators": [
+            {
+                "address": _hexs(v.address),
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(v.pub_key.bytes()).decode(),
+                },
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            }
+            for v in vs.validators
+        ]
+    }

@@ -30,6 +30,7 @@ from tools.tmlint.rules import ALL_RULES, RULES_BY_NAME  # noqa: E402
 OPS_PATH = "tendermint_tpu/ops/fake_mod.py"
 SIMNET_PATH = "tendermint_tpu/simnet/fake_mod.py"
 REACTOR_PATH = "tendermint_tpu/blocksync/fake_mod.py"
+LIGHT_PATH = "tendermint_tpu/light/fake_service.py"
 HOT_PATH = "tendermint_tpu/ops/entry_block.py"
 
 
@@ -278,6 +279,40 @@ class TestRelayOwnership:
         """
         assert rules_of(lint(src_prep, REACTOR_PATH)) == ["relay-ownership"]
 
+    # -- ISSUE 11: the light service's dispatch path -----------------------
+
+    def test_positive_light_service_direct_relay(self):
+        """A light-service-shaped module touching the relay directly —
+        launching, transferring, or wiring a mocked-relay device double
+        into the pipeline — is flagged; the service must submit through
+        AsyncBatchVerifier."""
+        src = """
+            import jax
+
+            def verify_unique(self, stages):
+                return [jax.device_put(st.entries) for st in stages]
+        """
+        assert rules_of(lint(src, LIGHT_PATH)) == ["relay-ownership"]
+        src_mock = """
+            from tendermint_tpu.ops._testing import mock_light_prepare
+
+            def install_fast_path(pl):
+                pl.AsyncBatchVerifier._prepare = mock_light_prepare(
+                    pl.AsyncBatchVerifier._prepare, 0.0
+                )
+        """
+        assert rules_of(lint(src_mock, LIGHT_PATH)) == ["relay-ownership"]
+
+    def test_negative_light_service_submit_pattern(self):
+        """The real service shape — EntryBlocks submitted to the shared
+        verifier, verdicts via futures — is clean."""
+        src = """
+            def verify_unique(self, stages, fid):
+                futs = [self._v.submit(st.entries, flow=fid) for st in stages]
+                return [f.result(timeout=600) for f in futs]
+        """
+        assert not lint(src, LIGHT_PATH, "relay-ownership")
+
 
 class TestSimnetDeterminism:
     def test_positive_wall_clock(self):
@@ -340,6 +375,49 @@ class TestSimnetDeterminism:
         """
         assert not lint(src, "tendermint_tpu/rpc/fake.py",
                         "simnet-determinism")
+
+    def test_positive_light_scope(self):
+        """ISSUE 11 satellite: light/ is in the deterministic scope — a
+        wall-clock read in a light-client module is flagged (the
+        sanctioned default lives in libs/timeutil, injected via now_fn)."""
+        src = """
+            import time as _time
+            def _now_ts():
+                return _time.time()
+        """
+        assert rules_of(
+            lint(src, "tendermint_tpu/light/client.py")
+        ) == ["simnet-determinism"]
+
+    def test_negative_light_injected_clock(self):
+        src = """
+            def verify_at(self, height, now=None):
+                now = now or self._now_ts()
+                return (height, now)
+        """
+        assert not lint(src, "tendermint_tpu/light/client.py",
+                        "simnet-determinism")
+
+    def test_light_tree_is_clean_without_suppressions(self):
+        """The REAL light/ modules lint clean with zero suppressions —
+        the satellite's acceptance: clock injection landed everywhere."""
+        import tokenize
+
+        light_dir = os.path.join(REPO_ROOT, "tendermint_tpu", "light")
+        for name in sorted(os.listdir(light_dir)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(light_dir, name)
+            with open(path) as fh:
+                src = fh.read()
+            rel = f"tendermint_tpu/light/{name}"
+            assert not run_source(src, rel, [RULES_BY_NAME["simnet-determinism"]]), \
+                f"{rel} has determinism findings"
+            with open(path, "rb") as fh:
+                for tok in tokenize.tokenize(fh.readline):
+                    if tok.type == tokenize.COMMENT:
+                        assert "disable=simnet-determinism" not in tok.string, \
+                            f"{rel} suppresses the determinism pass"
 
     def test_suppressed(self):
         src = """

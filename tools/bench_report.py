@@ -69,6 +69,9 @@ METRIC_KEYS = (
     "sustained_sigs_per_s", "sustained_vs_baseline", "mixed_curve_sigs_per_s",
     "pipelined_headers_per_s", "simnet_commits_per_s",
     "simnet_churn_commits_per_s", "speedup_2v1", "n_devices",
+    # light-service artifacts (LIGHT_r*, ISSUE 11)
+    "light_unique_headers_per_s", "light_sequential_headers_per_s",
+    "vs_sequential", "memo_hit_ratio",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -78,10 +81,10 @@ _LOWER_IS_BETTER = {"relay_rtt_ms"}
 COMPARE_KEYS = (
     "value", "sustained_sigs_per_s", "kernel_stream_sigs_per_s",
     "pipelined_headers_per_s", "mixed_curve_sigs_per_s", "relay_rtt_ms",
-    "speedup_2v1",
+    "speedup_2v1", "light_unique_headers_per_s",
 )
 
-_NAME_RE = re.compile(r"(BENCH|MULTICHIP)_r(\d+)", re.I)
+_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT)_r(\d+)", re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -194,6 +197,7 @@ def load(path: str) -> dict:
 def default_paths(root: str = REPO) -> List[str]:
     paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "LIGHT_r*.json")))
     return paths
 
 
@@ -210,7 +214,7 @@ def validate(art: dict) -> List[str]:
     if art.get("unreadable"):
         probs.append("; ".join(art["notes"]))
         return probs
-    if art["kind"] not in ("bench", "multichip"):
+    if art["kind"] not in ("bench", "multichip", "light"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -643,6 +643,170 @@ def run_mesh(args) -> int:
     return rc
 
 
+def run_light(args) -> int:
+    """--light: the round-11 light-service gate on a mocked relay (slow
+    readback over REAL kernels — verdicts are live). Asserts the three
+    properties the batched service must hold:
+
+      coalesce  cross-request SAME-EPOCH coalescing proven by launch
+                count: R warm requests emit 2R-1 stage blocks but the
+                shared pipeline fuses them into far fewer device
+                launches (each undersized per-request dispatch would
+                otherwise pay a full relay RTT — the ~1.2k headers/s
+                sequential ceiling)
+      parity    verdicts AND blame byte-identical to the sequential
+                light/verifier.py path — ok requests, a forged-commit
+                request (tampered signature) and an expired-trusted-
+                header request all match (type name + error string)
+      no leak   zero buffer-pool slots in flight once drained, and a
+                memoized resubmission adds ZERO launches
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from dataclasses import replace as dc_replace
+
+    import bench as _bench
+
+    from tendermint_tpu.light import verifier as lv
+    from tendermint_tpu.light.batch import HeaderRequest, fingerprint
+    from tendermint_tpu.light.service import LightVerifyService
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.types.block import Commit
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    n_vals, n_headers = 8, 6
+    resolve_delay = 0.15
+    chain_id = "light-gate"
+    print(f"prep_bench --light: vals={n_vals} headers={n_headers} "
+          f"resolve_delay={resolve_delay}s")
+    rc = 0
+    shs = _bench._build_header_chain(chain_id, n_headers, n_vals)
+    trusted, vset = shs[0]
+    now = Timestamp(seconds=1_600_000_000 + n_headers + 60)
+    period = 1e9
+
+    def mkreq(k, untrusted=None, p=period):
+        return HeaderRequest(
+            trusted_header=trusted, trusted_vals=vset,
+            untrusted_header=untrusted or shs[k][0],
+            untrusted_vals=vset, trusting_period=p,
+        )
+
+    def seq_verdict(req):
+        try:
+            lv.verify(req.trusted_header, req.trusted_vals,
+                      req.untrusted_header, req.untrusted_vals,
+                      req.trusting_period, now, req.max_clock_drift,
+                      req.trust_level)
+            return None
+        except Exception as e:  # noqa: BLE001 — the verdict IS the error
+            return (type(e).__name__, str(e))
+
+    # warm epoch: one valset across every request, device tables resident
+    _epoch.reset(4)
+    # adversarial inputs: a forged commit (tampered signature) and an
+    # expired trusted header, alongside the clean warm requests
+    fcommit = Commit.decode(shs[3][0].commit.encode())
+    fcommit.signatures[4] = dc_replace(
+        fcommit.signatures[4], signature=b"\x07" * 64
+    )
+    from tendermint_tpu.types import SignedHeader
+
+    forged = SignedHeader(header=shs[3][0].header, commit=fcommit)
+    reqs = [mkreq(k) for k in range(1, n_headers + 1)]
+    reqs.append(mkreq(3, untrusted=forged))
+    reqs.append(mkreq(5, p=1.0))  # trusted header long expired
+    n_stage_blocks = 1 + (n_headers - 1) * 2 + 2 + 0  # adjacent:1, non-adj:2 each, forged:2, expired:0
+    assert len({fingerprint(r, now) for r in reqs}) == len(reqs)
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay)
+    )
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    v = pl.AsyncBatchVerifier(depth=1, pool_depth=OVERLAP_POOL_DEPTH)
+    svc = LightVerifyService(verifier=v)
+    try:
+        res = svc.submit_many(reqs, now=now).results(timeout=900)
+        launches1 = sum(
+            1 for name, *_ in tr.TRACER.events() if name == "pipeline.dispatch"
+        )
+        # memoized resubmission: byte-identical requests resolve from the
+        # verdict memo with ZERO additional device work
+        res2 = svc.submit_many(reqs, now=now).results(timeout=120)
+        launches2 = sum(
+            1 for name, *_ in tr.TRACER.events() if name == "pipeline.dispatch"
+        )
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+        stats = svc.stats()
+    finally:
+        tr.configure(enabled=False)
+        svc.close()
+        v.close()
+        pl.AsyncBatchVerifier._prepare = real_prepare
+
+    # -- parity vs the sequential verifier ------------------------------
+    mism = []
+    for i, (req, r) in enumerate(zip(reqs, res)):
+        want = seq_verdict(req)
+        got = None if r["ok"] else (r["error_type"], r["error"])
+        if want != got:
+            mism.append((i, want, got))
+    ok_count = sum(1 for r in res if r["ok"])
+    print(f"  requests={len(reqs)} ok={ok_count} "
+          f"rejected={len(reqs) - ok_count}")
+    print(f"  verdict/blame parity vs sequential : "
+          f"{'OK' if not mism else f'MISMATCH {mism[:2]}'}")
+    if mism:
+        rc = 1
+    if [r["ok"] for r in res2] != [r["ok"] for r in res]:
+        print("  FAIL: memoized verdicts differ from first pass",
+              file=sys.stderr)
+        rc = 1
+
+    # -- cross-request coalescing by launch count ------------------------
+    print(f"  stage blocks submitted             : {n_stage_blocks}")
+    print(f"  device launches (first pass)       : {launches1}")
+    print(f"  device launches (memo resubmission): {launches2 - launches1}")
+    if launches1 >= n_stage_blocks:
+        print(f"  FAIL: {launches1} launches for {n_stage_blocks} stage "
+              "blocks — no cross-request coalescing", file=sys.stderr)
+        rc = 1
+    if launches2 != launches1:
+        print("  FAIL: memoized resubmission launched device work",
+              file=sys.stderr)
+        rc = 1
+    if stats["memo_hits"] != len(reqs):
+        print(f"  FAIL: expected {len(reqs)} memo hits, got "
+              f"{stats['memo_hits']}", file=sys.stderr)
+        rc = 1
+
+    # -- epoch grouping + pool hygiene -----------------------------------
+    est = _epoch.stats()
+    print(f"  epoch cache                        : entries={est['entries']} "
+          f"hits={est['hits']} misses={est['misses']}")
+    print(f"  pool                               : {pool}")
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    if est["hits"] <= 0:
+        print("  FAIL: warm-epoch requests never hit the epoch cache",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -679,6 +843,14 @@ def main() -> int:
         "2-lane mesh — pack/demux parity + blame, pure-pad-lane plan "
         "shape, zero slot leak, single relay owner, superbatch overlap",
     )
+    ap.add_argument(
+        "--light",
+        action="store_true",
+        help="round-11 gate: light-service batched verification on a "
+        "mocked relay — cross-request same-epoch coalescing by launch "
+        "count, verdict/blame parity vs the sequential verifier, memoized "
+        "resubmission launches nothing, zero pool-slot leak",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -688,6 +860,8 @@ def main() -> int:
         return run_overlap(args)
     if args.mesh:
         return run_mesh(args)
+    if args.light:
+        return run_light(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

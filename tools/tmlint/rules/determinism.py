@@ -9,11 +9,15 @@ the moment any simnet-reachable code path reads the wall clock
 instance), reads OS entropy (`os.urandom`, `uuid.uuid4`, `secrets`), or
 lets a Python `set`'s hash-order feed a scheduling decision.
 
-Scope: tendermint_tpu/simnet/ and tendermint_tpu/consensus/ (the modules
-the simnet harness drives). The injection seams are the allowlist: clocks
-ride `self._now` / injected `clock` objects, randomness rides seeded
-`random.Random` instances — neither matches these patterns, so correctly
-injected code lints clean by construction.
+Scope: tendermint_tpu/simnet/, tendermint_tpu/consensus/ (the modules
+the simnet harness drives) and tendermint_tpu/light/ (ISSUE 11:
+simnet-driven light clients and the batched verification service — their
+wall-clock default lives in libs/timeutil and rides in via the `now_fn`
+seams, so the light modules themselves lint clean without suppressions).
+The injection seams are the allowlist: clocks ride `self._now` / injected
+`clock` objects, randomness rides seeded `random.Random` instances —
+neither matches these patterns, so correctly injected code lints clean by
+construction.
 """
 
 from __future__ import annotations
@@ -44,7 +48,8 @@ class SimnetDeterminismRule(Rule):
 
     def applies_to(self, relpath: str) -> bool:
         return relpath.startswith(
-            ("tendermint_tpu/simnet/", "tendermint_tpu/consensus/")
+            ("tendermint_tpu/simnet/", "tendermint_tpu/consensus/",
+             "tendermint_tpu/light/")
         )
 
     # -- call patterns ---------------------------------------------------

@@ -62,6 +62,13 @@ ENTRY_POINTS = frozenset({
     "epoch_tables_sharded",
     "sharded_xla_tables",
     "prepare_superbatch",
+    # mocked-relay device doubles (ISSUE 11): these REPLACE the relay for
+    # benches/gates — production code (the light service's dispatch path
+    # included) must route through AsyncBatchVerifier, never wire a mock
+    "mock_light_prepare",
+    "mock_mesh_prepare",
+    "slow_prepare",
+    "slow_mesh_prepare",
 })
 
 # `transfer` is a common word; only flag it on a device_pool-ish receiver
