@@ -296,6 +296,23 @@ class MempoolMetrics:
         self.recheck_times = registry.counter(
             "mempool", "recheck_times", "Txs rechecked after a block commit."
         )
+        # device-batched ingress back-pressure (ISSUE 13): pushed by
+        # mempool/ingress.py IngressAccumulator
+        self.ingress_queue_depth = registry.gauge(
+            "mempool", "ingress_queue_depth",
+            "Tx signatures waiting in the ingress accumulator window.",
+        )
+        self.ingress_batch_wait_ms = registry.histogram(
+            "mempool", "ingress_batch_wait_ms",
+            "Milliseconds the oldest tx of each ingress batch waited "
+            "before its window flushed to the device.",
+            buckets=[0.5, 1, 2.5, 5, 10, 25, 50, 100, 250],
+        )
+        self.checktx_preemptions = registry.counter(
+            "mempool", "checktx_preemptions",
+            "Queued ingress CheckTx batches bypassed by a higher-priority "
+            "consensus batch in the QoS dispatch queue.",
+        )
 
 
 class P2PMetrics:
@@ -452,6 +469,21 @@ def ops_metrics() -> OpsMetrics:
         if _global_ops is None:
             _global_ops = OpsMetrics(global_registry())
         return _global_ops
+
+
+_global_mempool: Optional["MempoolMetrics"] = None
+
+
+def mempool_metrics() -> "MempoolMetrics":
+    """Process-wide MempoolMetrics for the ingress accumulator when no
+    node-attached set exists (benches, tests, multi-node sims sharing one
+    device engine). Nodes with instrumentation enabled still build their
+    own per-node set; the accumulator uses whichever it was handed."""
+    global _global_mempool
+    with _global_mtx:
+        if _global_mempool is None:
+            _global_mempool = MempoolMetrics(global_registry())
+        return _global_mempool
 
 
 def ops_stats() -> dict:

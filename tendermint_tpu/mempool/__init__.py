@@ -14,11 +14,17 @@ import itertools
 import threading
 import time
 from collections import OrderedDict
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ..abci import types as abci
 from ..types.tx import tx_key
+from . import ingress as _ingress
+
+# CheckTx rejection codes for the signature stage (codespace "ingress")
+CODE_BAD_SIGNATURE = 101
+CODE_BAD_NONCE = 102
 
 
 class TxCache:
@@ -78,6 +84,7 @@ class TxMempool:
         proxy_app,  # mempool-connection ABCI client
         config=None,
         height: int = 0,
+        ingress=None,  # mempool/ingress.py IngressAccumulator (opt-in)
     ):
         from ..config import MempoolConfig
 
@@ -96,6 +103,13 @@ class TxMempool:
         # libs.metrics.MempoolMetrics, attached by node setup when the
         # instrumentation config enables prometheus (None = no-op)
         self.metrics = None
+        # device-batched ingress (ISSUE 13): when attached, signed-tx
+        # CheckTx signature verdicts come from the accumulator's batched
+        # device windows; without one they verify inline on the host —
+        # the sequential baseline, same code path minus the batching
+        self._ingress = ingress
+        # per-sender replay protection: pubkey -> highest accepted nonce
+        self._nonces: Dict[bytes, int] = {}
 
     # -- config hooks ---------------------------------------------------
 
@@ -123,50 +137,166 @@ class TxMempool:
     def is_empty(self) -> bool:
         return self.size() == 0
 
+    def attach_ingress(self, accumulator) -> None:
+        """Attach a mempool/ingress.py IngressAccumulator: signed-tx
+        CheckTx signature verdicts now come from batched device windows
+        instead of inline host verification."""
+        self._ingress = accumulator
+
     def check_tx(self, tx: bytes, callback: Optional[Callable] = None, sender: str = "") -> abci.ResponseCheckTx:
-        """mempool.go:230-342."""
+        """mempool.go:230-342 — sync facade over check_tx_async: blocks
+        until the signature verdict (if any) and the app CheckTx land."""
+        return self.check_tx_async(tx, callback, sender).result(timeout=300)
+
+    def check_tx_async(
+        self, tx: bytes, callback: Optional[Callable] = None,
+        sender: str = "",
+    ) -> "Future[abci.ResponseCheckTx]":
+        """CheckTx with a device-batched signature stage (ISSUE 13).
+
+        Prechecks (size, pre_check hook, envelope structure, seen-cache)
+        raise synchronously exactly as check_tx always has. The returned
+        future resolves to the ResponseCheckTx; it raises
+        MempoolFullError (the sync path's raise, deferred) or the
+        DispatchError of a poisoned device window (infrastructure
+        failure — the tx is dropped from the seen-cache so a retry can
+        resubmit it).
+
+        Unsigned (legacy) txs and signed txs without an accumulator
+        complete INLINE on the calling thread — byte-identical responses
+        to the pre-ISSUE-13 code. Signed txs with an accumulator complete
+        on its completer thread once the batched verdict lands; the
+        mempool lock is never held across the device wait."""
         if len(tx) > self._cfg.max_tx_bytes:
             raise ValueError(
                 f"tx size {len(tx)} exceeds max {self._cfg.max_tx_bytes}"
             )
         if self._pre_check is not None:
             self._pre_check(tx)
+        stx = _ingress.parse_signed_tx(tx)  # MalformedTxError on bad envelope
         if not self._cache.push(tx):
             # seen before: reject as duplicate (mempool.go:270-287)
             raise DuplicateTxError(tx_key(tx))
+        fut: "Future[abci.ResponseCheckTx]" = Future()
+        if stx is None:
+            self._finish_check_tx(tx, None, True, sender, callback, fut)
+        elif self._ingress is None:
+            # sequential baseline: same completion path, host verdict
+            self._finish_check_tx(
+                tx, stx, _ingress.host_verify(stx), sender, callback, fut
+            )
+        else:
+            vfut = self._ingress.submit(stx)
+
+            def _on_verdict(f, tx=tx, stx=stx):
+                # runs on the ingress COMPLETER thread (never the
+                # pipeline resolver — see mempool/ingress.py)
+                try:
+                    ok = bool(f.result())
+                except Exception as e:  # noqa: BLE001 — poisoned window
+                    # device-infrastructure failure, not a parity
+                    # rejection: drop the seen-cache entry so the tx is
+                    # retryable, and surface the DispatchError
+                    self._cache.remove(tx)
+                    if not fut.done():
+                        fut.set_exception(e)
+                    return
+                self._finish_check_tx(tx, stx, ok, sender, callback, fut)
+
+            vfut.add_done_callback(_on_verdict)
+        return fut
+
+    def _finish_check_tx(self, tx: bytes, stx, sig_ok: bool, sender: str,
+                         callback: Optional[Callable], fut: Future) -> None:
+        """Complete CheckTx from the signature verdict. Takes the mempool
+        lock only around state mutation — no device or future waits
+        inside it (the lock-discipline shape tmlint now flags)."""
+        try:
+            res = self._check_tx_verdict(tx, stx, sig_ok, sender)
+        except BaseException as e:  # noqa: BLE001 — incl. MempoolFullError
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        try:
+            if callback is not None:
+                callback(res)
+        except BaseException as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        if not fut.done():
+            fut.set_result(res)
+
+    def _sig_reject(self, tx: bytes, code: int, log: str) -> abci.ResponseCheckTx:
+        if self.metrics is not None:
+            self.metrics.failed_txs.inc()
+        if not self._cfg.keep_invalid_txs_in_cache:
+            self._cache.remove(tx)
+        return abci.ResponseCheckTx(code=code, log=log, codespace="ingress")
+
+    def _check_tx_verdict(self, tx: bytes, stx, sig_ok: bool,
+                          sender: str) -> abci.ResponseCheckTx:
+        if stx is not None:
+            if not sig_ok:
+                return self._sig_reject(
+                    tx, CODE_BAD_SIGNATURE, "invalid signature"
+                )
+            with self._mtx:
+                last = self._nonces.get(stx.pub)
+            if last is not None and stx.nonce <= last:
+                return self._sig_reject(
+                    tx, CODE_BAD_NONCE,
+                    f"nonce {stx.nonce} <= {last}: replay or out of order",
+                )
         res = self._proxy.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
         if res.is_ok():
+            stale = None
             with self._mtx:
-                if len(self._tx_by_key) >= self._cfg.size or (
-                    self._size_bytes + len(tx) > self._cfg.max_txs_bytes
-                ):
-                    # full: evict strictly-lower-priority txs to make room
-                    # (mempool.go:498 + priority_queue.go GetEvictableTxs);
-                    # reject when no such set frees enough capacity
-                    victims = self._evictable_locked(res.priority, len(tx))
-                    if not victims:
-                        self._cache.remove(tx)
-                        raise MempoolFullError(len(self._tx_by_key))
-                    for v in victims:
-                        self._remove_tx(v.key, compact=False)
-                        self._cache.remove(v.tx)
-                    self._compact_fifo()
-                    if self.metrics is not None:
-                        self.metrics.evicted_txs.inc(len(victims))
-                was_empty = not self._tx_by_key
-                wtx = _WrappedTx(
-                    sort_key=(-res.priority, next(self._seq)),
-                    tx=tx,
-                    key=tx_key(tx),
-                    priority=res.priority,
-                    sender=res.sender or sender,
-                    gas_wanted=res.gas_wanted,
-                    height=self._height,
-                    timestamp=time.time(),
+                if stx is not None:
+                    # authoritative nonce check: the unlocked fast-path
+                    # read above races concurrent same-sender txs; this
+                    # one is serialized with the record below
+                    prev = self._nonces.get(stx.pub)
+                    if prev is not None and stx.nonce <= prev:
+                        stale = prev
+                if stale is None:
+                    if len(self._tx_by_key) >= self._cfg.size or (
+                        self._size_bytes + len(tx) > self._cfg.max_txs_bytes
+                    ):
+                        # full: evict strictly-lower-priority txs to make room
+                        # (mempool.go:498 + priority_queue.go GetEvictableTxs);
+                        # reject when no such set frees enough capacity
+                        victims = self._evictable_locked(res.priority, len(tx))
+                        if not victims:
+                            self._cache.remove(tx)
+                            raise MempoolFullError(len(self._tx_by_key))
+                        for v in victims:
+                            self._remove_tx(v.key, compact=False)
+                            self._cache.remove(v.tx)
+                        self._compact_fifo()
+                        if self.metrics is not None:
+                            self.metrics.evicted_txs.inc(len(victims))
+                    was_empty = not self._tx_by_key
+                    wtx = _WrappedTx(
+                        sort_key=(-res.priority, next(self._seq)),
+                        tx=tx,
+                        key=tx_key(tx),
+                        priority=res.priority,
+                        sender=res.sender or sender,
+                        gas_wanted=res.gas_wanted,
+                        height=self._height,
+                        timestamp=time.time(),
+                    )
+                    self._tx_by_key[wtx.key] = wtx
+                    self._fifo.append(wtx)
+                    self._size_bytes += len(tx)
+                    if stx is not None:
+                        self._nonces[stx.pub] = stx.nonce
+            if stale is not None:
+                return self._sig_reject(
+                    tx, CODE_BAD_NONCE,
+                    f"nonce {stx.nonce} <= {stale}: replay or out of order",
                 )
-                self._tx_by_key[wtx.key] = wtx
-                self._fifo.append(wtx)
-                self._size_bytes += len(tx)
             if was_empty and self._notify_available is not None:
                 self._notify_available()
             if self.metrics is not None:
@@ -176,8 +306,6 @@ class TxMempool:
                 self.metrics.failed_txs.inc()
             if not self._cfg.keep_invalid_txs_in_cache:
                 self._cache.remove(tx)
-        if callback is not None:
-            callback(res)
         return res
 
     def _evictable_locked(self, priority: int, tx_size: int) -> List[_WrappedTx]:
@@ -304,19 +432,66 @@ class TxMempool:
         self._fifo = [w for w in self._fifo if not w.removed]
 
     def _recheck_txs(self) -> None:
-        """mempool.go:580-620: re-CheckTx all remaining txs."""
+        """mempool.go:580-620: re-CheckTx all remaining txs.
+
+        ISSUE 13: signed txs re-verify their signatures first — as ONE
+        block-sized device batch through the ingress accumulator when one
+        is attached, per-tx on the host otherwise — then the survivors
+        re-run app CheckTx exactly as before. The caller holds the
+        mempool lock; the device wait below is on a raw PIPELINE future
+        (the resolver thread never takes this lock), NOT on a per-tx
+        ingress future (those resolve on the completer thread, which
+        does — waiting on one here would deadlock the process)."""
         if self.metrics is not None:
             self.metrics.recheck_times.inc(len(self._tx_by_key))
-        for wtx in list(self._tx_by_key.values()):
-            res = self._proxy.check_tx(
-                abci.RequestCheckTx(tx=wtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+        sig_bad: set = set()
+        signed: List = []
+        for wtx in self._tx_by_key.values():
+            try:
+                stx = _ingress.parse_signed_tx(wtx.tx)
+            except ValueError:
+                stx = None  # unreachable past check_tx, but never fatal
+            if stx is not None:
+                signed.append((wtx, stx))
+        dev = [p for p in signed
+               if p[1].scheme == _ingress.SCHEME_ED25519]
+        host = [p for p in signed
+                if p[1].scheme != _ingress.SCHEME_ED25519]
+        if dev and self._ingress is not None:
+            from ..ops.entry_block import EntryBlock
+
+            block = EntryBlock.from_entries(
+                [(s.pub, s.signed_bytes(), s.sig) for _, s in dev]
             )
-            ok = res.is_ok()
-            if ok and self._post_check is not None:
-                try:
-                    self._post_check(wtx.tx, res)
-                except ValueError:
-                    ok = False
+            try:
+                verdicts = self._ingress.submit_block(block).result(
+                    timeout=300
+                )
+                for (wtx, _), ok in zip(dev, verdicts):
+                    if not ok:
+                        sig_bad.add(wtx.key)
+            except Exception:  # noqa: BLE001 — infra failure, not verdicts
+                # keep the txs; they recheck again after the next commit
+                pass
+        else:
+            for wtx, s in dev:
+                if not _ingress.host_verify(s):
+                    sig_bad.add(wtx.key)
+        for wtx, s in host:
+            if not _ingress.host_verify(s):
+                sig_bad.add(wtx.key)
+        for wtx in list(self._tx_by_key.values()):
+            ok = wtx.key not in sig_bad
+            if ok:
+                res = self._proxy.check_tx(
+                    abci.RequestCheckTx(tx=wtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+                )
+                ok = res.is_ok()
+                if ok and self._post_check is not None:
+                    try:
+                        self._post_check(wtx.tx, res)
+                    except ValueError:
+                        ok = False
             if not ok:
                 self._remove_tx(wtx.key, compact=False)
                 if not self._cfg.keep_invalid_txs_in_cache:
@@ -338,6 +513,14 @@ class TxMempool:
             self._fifo.clear()
             self._size_bytes = 0
             self._cache.reset()
+            self._nonces.clear()
+
+    def ingress_stats(self) -> dict:
+        """The attached accumulator's snapshot (rpc /status); a mempool
+        without one reports {"enabled": False}."""
+        if self._ingress is None:
+            return {"enabled": False}
+        return dict(self._ingress.stats(), enabled=True)
 
 
 class DuplicateTxError(ValueError):

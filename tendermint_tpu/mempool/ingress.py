@@ -1,0 +1,473 @@
+"""Device-batched transaction ingress (ISSUE 13).
+
+The second serving workload from the north star: user transactions.
+`check_tx` used to be a pure host path — the signed-tx envelope below
+adds signature-carrying txs, and this module's accumulator batches their
+signatures into `EntryBlock`s over a short time/size window and submits
+them to the SHARED AsyncBatchVerifier at INGRESS priority, so a tx flood
+rides the device pipeline (thousands of sigs per relay command) without
+ever starving consensus commit batches (ops/pipeline.py QoS classes).
+
+Signed-tx envelope (scheme-tagged, nonce-carrying):
+
+    MAGIC(4) | scheme(1) | pub(32|33) | nonce(8 BE) | sig(64) | payload
+
+The signed message is the envelope minus the signature field (MAGIC +
+scheme + pub + nonce + payload) — a signature cannot be transplanted
+onto a different payload, nonce or key. Txs WITHOUT the magic (the
+kvstore's `k=v` and `val:` txs, every pre-existing test fixture) carry
+no signature and bypass the verification stage entirely: their CheckTx
+responses are byte-identical to the pre-ISSUE-13 behavior.
+
+Scheme lanes (the 2302.00418 story):
+  ed25519    device lane — batched through the shared verifier
+  sr25519    host batch lane — crypto/sr25519.verify_batch (the native
+             schnorrkel batch path when built); schnorrkel's transcript
+             binding has no device kernel here yet
+  secp256k1  host fallback, one ECDSA verify per tx on the completion
+             thread — batched ECDSA verification is the documented gap
+             (README "Transaction ingress"); NEVER silently dropped: an
+             unverifiable sig is an explicit rejection, not an accept.
+
+Threading (the deadlock rule this module exists to respect): completion
+work that takes the mempool lock runs on the accumulator's OWN completer
+thread, never on the pipeline's resolver thread — consensus holds the
+mempool lock across update()→recheck while waiting on pipeline futures,
+so a resolver blocked on that lock would deadlock the process. Verifier
+done-callbacks only enqueue; the completer does the locking.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+MAGIC = b"\xc1TX1"
+SCHEME_ED25519 = 0
+SCHEME_SR25519 = 1
+SCHEME_SECP256K1 = 2
+_PUB_LEN = {SCHEME_ED25519: 32, SCHEME_SR25519: 32, SCHEME_SECP256K1: 33}
+_SIG_LEN = 64
+_NONCE_LEN = 8
+
+DEFAULT_BATCH = 256
+DEFAULT_WINDOW_MS = 4.0
+
+
+class MalformedTxError(ValueError):
+    """Envelope magic present but the structure is broken (truncated
+    fields, unknown scheme). A ValueError so the reactor/RPC catch sites
+    that already reject bad txs reject these too."""
+
+
+class SignedTx:
+    __slots__ = ("scheme", "pub", "nonce", "sig", "payload", "raw")
+
+    def __init__(self, scheme: int, pub: bytes, nonce: int, sig: bytes,
+                 payload: bytes, raw: bytes):
+        self.scheme = scheme
+        self.pub = pub
+        self.nonce = nonce
+        self.sig = sig
+        self.payload = payload
+        self.raw = raw
+
+    def signed_bytes(self) -> bytes:
+        """The message the signature covers: the envelope minus the
+        signature field."""
+        return (MAGIC + bytes([self.scheme]) + self.pub
+                + self.nonce.to_bytes(_NONCE_LEN, "big") + self.payload)
+
+
+def parse_signed_tx(tx: bytes) -> Optional[SignedTx]:
+    """None when `tx` carries no envelope (legacy tx — no sig stage);
+    MalformedTxError when the magic is present but the layout is not."""
+    if not tx.startswith(MAGIC):
+        return None
+    if len(tx) < len(MAGIC) + 1:
+        raise MalformedTxError("signed tx truncated before scheme byte")
+    scheme = tx[len(MAGIC)]
+    pub_len = _PUB_LEN.get(scheme)
+    if pub_len is None:
+        raise MalformedTxError(f"unknown signature scheme {scheme}")
+    hdr = len(MAGIC) + 1 + pub_len + _NONCE_LEN + _SIG_LEN
+    if len(tx) < hdr:
+        raise MalformedTxError(
+            f"signed tx truncated: {len(tx)} < {hdr} header bytes"
+        )
+    off = len(MAGIC) + 1
+    pub = tx[off : off + pub_len]
+    off += pub_len
+    nonce = int.from_bytes(tx[off : off + _NONCE_LEN], "big")
+    off += _NONCE_LEN
+    sig = tx[off : off + _SIG_LEN]
+    off += _SIG_LEN
+    return SignedTx(scheme, pub, nonce, sig, tx[off:], tx)
+
+
+def encode_signed_tx(scheme: int, pub: bytes, nonce: int, sig: bytes,
+                     payload: bytes) -> bytes:
+    if len(pub) != _PUB_LEN[scheme]:
+        raise ValueError(f"scheme {scheme} pubkey must be "
+                         f"{_PUB_LEN[scheme]} bytes, got {len(pub)}")
+    if len(sig) != _SIG_LEN:
+        raise ValueError(f"signature must be {_SIG_LEN} bytes")
+    return (MAGIC + bytes([scheme]) + pub
+            + int(nonce).to_bytes(_NONCE_LEN, "big") + sig + payload)
+
+
+def make_signed_tx(priv, payload: bytes, nonce: int,
+                   scheme: int = SCHEME_ED25519) -> bytes:
+    """Sign `payload` under the envelope: the signature covers the full
+    header (scheme, pub, nonce) plus the payload."""
+    pub = priv.pub_key().bytes()
+    body = (MAGIC + bytes([scheme]) + pub
+            + int(nonce).to_bytes(_NONCE_LEN, "big") + payload)
+    sig = priv.sign(body)
+    return encode_signed_tx(scheme, pub, nonce, sig, payload)
+
+
+def host_verify(stx: SignedTx) -> bool:
+    """Per-scheme host verification — the sequential baseline (no
+    accumulator attached) and the recheck fallback for host-lane schemes.
+    An unverifiable signature (missing native backend, structurally bad
+    key) is False — an explicit rejection — never a silent accept."""
+    msg = stx.signed_bytes()
+    try:
+        if stx.scheme == SCHEME_ED25519:
+            from ..crypto import ed25519 as _ed
+
+            return bool(_ed.verify_zip215_fast(stx.pub, msg, stx.sig))
+        if stx.scheme == SCHEME_SR25519:
+            from ..crypto import sr25519 as _sr
+
+            return bool(_sr.verify_batch([(stx.pub, msg, stx.sig)])[0])
+        if stx.scheme == SCHEME_SECP256K1:
+            from ..crypto import secp256k1 as _secp
+
+            return bool(_secp.PubKey(stx.pub).verify_signature(msg, stx.sig))
+    except Exception:  # noqa: BLE001 — reject, never crash CheckTx
+        return False
+    return False
+
+
+class _Pending:
+    __slots__ = ("stx", "future", "t_enq")
+
+    def __init__(self, stx: SignedTx, t_enq: float):
+        self.stx = stx
+        self.future: "Future[bool]" = Future()
+        self.t_enq = t_enq
+
+
+# live accumulators for /status aggregation (rpc/core.py)
+_ACTIVE: "weakref.WeakSet[IngressAccumulator]" = weakref.WeakSet()
+
+
+def ingress_stats() -> dict:
+    """Aggregate snapshot over every live accumulator in the process —
+    the /status `mempool_ingress` section."""
+    accs = list(_ACTIVE)
+    if not accs:
+        return {"enabled": False}
+    out: Dict[str, float] = {
+        "enabled": True, "queue_depth": 0, "batches": 0, "sigs": 0,
+        "host_lane_sigs": 0, "preemptions": 0, "dispatch_errors": 0,
+    }
+    waits = []
+    for a in accs:
+        s = a.stats()
+        out["queue_depth"] += s["queue_depth"]
+        out["batches"] += s["batches"]
+        out["sigs"] += s["sigs"]
+        out["host_lane_sigs"] += s["host_lane_sigs"]
+        out["preemptions"] += s["preemptions"]
+        out["dispatch_errors"] += s["dispatch_errors"]
+        if s["batch_wait_ms_avg"]:
+            waits.append(s["batch_wait_ms_avg"])
+    out["batch_wait_ms_avg"] = sum(waits) / len(waits) if waits else 0.0
+    return out
+
+
+class IngressAccumulator:
+    """Window/size-batched CheckTx signature verification.
+
+    submit(stx) returns a Future[bool] sig verdict. ed25519 entries
+    accumulate until `max_batch` signatures or `window_ms` after the
+    oldest entry, then flush as ONE EntryBlock into the shared verifier
+    at PRIORITY_INGRESS; sr25519/secp256k1 entries flush on the same
+    clock through their host lanes. Verdict futures resolve on the
+    accumulator's completer thread (see the module docstring for why
+    that thread exists). A DispatchError from the device poisons ONLY
+    its own window's futures — later windows are untouched.
+
+    Knobs: TM_TPU_MEMPOOL_BATCH (default 256 sigs) and
+    TM_TPU_MEMPOOL_WINDOW_MS (default 4 ms)."""
+
+    def __init__(self, verifier=None, max_batch: Optional[int] = None,
+                 window_ms: Optional[float] = None, metrics=None):
+        if max_batch is None:
+            max_batch = int(os.environ.get("TM_TPU_MEMPOOL_BATCH",
+                                           DEFAULT_BATCH))
+        if window_ms is None:
+            window_ms = float(os.environ.get("TM_TPU_MEMPOOL_WINDOW_MS",
+                                             DEFAULT_WINDOW_MS))
+        self._max = max(int(max_batch), 1)
+        self._window_s = max(float(window_ms), 0.0) / 1000.0
+        self._v = verifier
+        self._v_hooked = False
+        self.metrics = metrics
+        self._mtx = threading.Lock()
+        self._pend_dev: List[_Pending] = []    # ed25519 → device lane
+        self._pend_host: List[_Pending] = []   # sr25519/secp256k1 lanes
+        self._t_first = 0.0
+        self._wake = threading.Event()   # new work for the flusher
+        self._full = threading.Event()   # batch hit max: flush now
+        self._cq: "queue.Queue" = queue.Queue()
+        self._inflight = 0               # flushed-but-uncompleted batches
+        self._stopped = threading.Event()
+        # counters (read via stats(); the metrics set mirrors them)
+        self.batches = 0
+        self.sigs = 0
+        self.host_lane_sigs = 0
+        self.preempted = 0
+        self.dispatch_errors = 0
+        self._wait_ms_sum = 0.0
+        self._thread = threading.Thread(
+            target=self._flusher, daemon=True, name="mempool-ingress-flush"
+        )
+        self._cthread = threading.Thread(
+            target=self._completer, daemon=True,
+            name="mempool-ingress-complete",
+        )
+        self._thread.start()
+        self._cthread.start()
+        _ACTIVE.add(self)
+
+    # -- wiring ----------------------------------------------------------
+
+    def _metrics(self):
+        if self.metrics is None:
+            from ..libs import metrics as _m
+
+            self.metrics = _m.mempool_metrics()
+        return self.metrics
+
+    def _ensure_verifier(self):
+        if self._v is None:
+            from ..ops import pipeline as _pl
+
+            self._v = _pl.shared_verifier()
+        if not self._v_hooked:
+            self._v_hooked = True
+            hook = getattr(self._v, "add_preempt_hook", None)
+            if hook is not None:
+                hook(self._note_preempt)
+        return self._v
+
+    def _note_preempt(self, n: int) -> None:
+        self.preempted += n
+        try:
+            self._metrics().checktx_preemptions.inc(n)
+        except Exception:  # noqa: BLE001 — observability never fatal
+            pass
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, stx: SignedTx) -> "Future[bool]":
+        """Queue one signature; the returned future resolves to the bool
+        verdict (or raises DispatchError when the device window failed)
+        on the completer thread."""
+        if self._stopped.is_set():
+            raise RuntimeError("ingress accumulator is closed")
+        p = _Pending(stx, time.perf_counter())
+        with self._mtx:
+            lane = (self._pend_dev if stx.scheme == SCHEME_ED25519
+                    else self._pend_host)
+            if not self._pend_dev and not self._pend_host:
+                self._t_first = p.t_enq
+            lane.append(p)
+            depth = len(self._pend_dev) + len(self._pend_host)
+            full = depth >= self._max or self._window_s <= 0.0
+        m = self._metrics()
+        if m is not None:
+            m.ingress_queue_depth.set(depth)
+        if full:
+            self._full.set()
+        self._wake.set()
+        return p.future
+
+    def submit_block(self, block, priority: Optional[int] = None):
+        """Raw EntryBlock passthrough for recheck: returns the PIPELINE
+        future directly (resolved on the resolver thread, which never
+        takes the mempool lock) — safe to wait on while holding the
+        mempool lock, unlike the per-tx futures from submit()."""
+        from ..ops import pipeline as _pl
+
+        if priority is None:
+            priority = _pl.PRIORITY_INGRESS
+        return self._ensure_verifier().submit(block, priority=priority)
+
+    def flush_now(self) -> None:
+        self._full.set()
+        self._wake.set()
+
+    # -- flusher thread --------------------------------------------------
+
+    def _flusher(self) -> None:
+        while True:
+            with self._mtx:
+                have = bool(self._pend_dev or self._pend_host)
+                t_first = self._t_first
+            if not have:
+                if self._stopped.is_set():
+                    break
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            if self._window_s > 0.0 and not self._stopped.is_set():
+                remaining = t_first + self._window_s - time.perf_counter()
+                if remaining > 0 and not self._full.is_set():
+                    self._full.wait(remaining)
+            self._full.clear()
+            self._flush()
+
+    def _flush(self) -> None:
+        with self._mtx:
+            dev, self._pend_dev = self._pend_dev, []
+            host, self._pend_host = self._pend_host, []
+            self._t_first = 0.0
+        if not dev and not host:
+            return
+        now = time.perf_counter()
+        wait_ms = max(
+            (now - min(p.t_enq for p in dev + host)) * 1e3, 0.0
+        )
+        self.batches += 1
+        self.sigs += len(dev) + len(host)
+        self.host_lane_sigs += len(host)
+        self._wait_ms_sum += wait_ms
+        m = self._metrics()
+        if m is not None:
+            m.ingress_queue_depth.set(0)
+            m.ingress_batch_wait_ms.observe(wait_ms)
+        if dev:
+            self._flush_device(dev)
+        if host:
+            self._cq.put(("host", host))
+
+    def _flush_device(self, dev: List[_Pending]) -> None:
+        try:
+            from ..ops.entry_block import EntryBlock
+
+            block = EntryBlock.from_entries(
+                [(p.stx.pub, p.stx.signed_bytes(), p.stx.sig) for p in dev]
+            )
+            with self._mtx:
+                self._inflight += 1
+            fut = self.submit_block(block)
+        except Exception as e:  # noqa: BLE001 — window isolation
+            with self._mtx:
+                self._inflight = max(self._inflight - 1, 0)
+            for p in dev:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            return
+        # done-callback runs on the pipeline resolver: ONLY enqueue —
+        # the completer owns any work that may take the mempool lock
+        fut.add_done_callback(
+            lambda f, batch=dev: self._cq.put(("device", batch, f))
+        )
+
+    # -- completer thread ------------------------------------------------
+
+    def _completer(self) -> None:
+        while True:
+            item = self._cq.get()
+            if item is None:
+                break
+            if item[0] == "device":
+                _, batch, fut = item
+                self._complete_device(batch, fut)
+                with self._mtx:
+                    self._inflight = max(self._inflight - 1, 0)
+            else:
+                self._complete_host(item[1])
+
+    @staticmethod
+    def _deliver(p: _Pending, ok: bool) -> None:
+        if not p.future.done():
+            p.future.set_result(bool(ok))
+
+    def _complete_device(self, batch: List[_Pending], fut) -> None:
+        err = fut.exception()
+        if err is not None:
+            # poisoned window: exactly these futures fail; the
+            # accumulator and every later window keep flowing
+            self.dispatch_errors += 1
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(err)
+            return
+        verdicts = fut.result()
+        for p, ok in zip(batch, verdicts):
+            self._deliver(p, bool(ok))
+
+    def _complete_host(self, batch: List[_Pending]) -> None:
+        sr = [p for p in batch if p.stx.scheme == SCHEME_SR25519]
+        if sr:
+            try:
+                from ..crypto import sr25519 as _sr
+
+                verdicts = _sr.verify_batch(
+                    [(p.stx.pub, p.stx.signed_bytes(), p.stx.sig)
+                     for p in sr]
+                )
+            except Exception:  # noqa: BLE001 — reject, never drop
+                verdicts = [False] * len(sr)
+            for p, ok in zip(sr, verdicts):
+                self._deliver(p, bool(ok))
+        for p in batch:
+            if p.stx.scheme == SCHEME_SR25519:
+                continue
+            # secp256k1 (and anything future): per-sig host fallback —
+            # the explicit non-batched path, never a silent drop
+            self._deliver(p, host_verify(p.stx))
+
+    # -- lifecycle / introspection ---------------------------------------
+
+    def stats(self) -> dict:
+        with self._mtx:
+            depth = len(self._pend_dev) + len(self._pend_host)
+        return {
+            "queue_depth": depth,
+            "batches": self.batches,
+            "sigs": self.sigs,
+            "host_lane_sigs": self.host_lane_sigs,
+            "batch_wait_ms_avg": (
+                self._wait_ms_sum / self.batches if self.batches else 0.0
+            ),
+            "preemptions": self.preempted,
+            "dispatch_errors": self.dispatch_errors,
+            "max_batch": self._max,
+            "window_ms": self._window_s * 1e3,
+        }
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stopped.set()
+        self._wake.set()
+        self._full.set()
+        self._thread.join(timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mtx:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.005)
+        self._cq.put(None)
+        self._cthread.join(timeout=timeout)

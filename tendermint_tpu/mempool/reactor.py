@@ -57,11 +57,32 @@ class MempoolReactor:
 
     # -- local entry: checked tx broadcast -------------------------------
 
+    def submit_tx_and_broadcast(self, tx: bytes):
+        """Async entry (ISSUE 13): submit through check_tx_async and
+        broadcast from a done-callback on verdict success — the caller
+        never blocks on the device window, and no mempool lock is held
+        anywhere near the wait. The callback only reads the response and
+        pushes to the p2p channel (thread-safe), so running it on the
+        ingress completer thread is fine. Precheck failures (duplicate,
+        oversize, malformed envelope) still raise synchronously."""
+        fut = self._mempool.check_tx_async(tx)
+
+        def _relay(f, tx=tx):
+            try:
+                res = f.result()
+            except Exception:  # noqa: BLE001 — rejected/poisoned: no relay
+                return
+            if res.is_ok() and self._broadcast:
+                self._ch.broadcast(encode_txs([tx]))
+
+        fut.add_done_callback(_relay)
+        return fut
+
     def check_tx_and_broadcast(self, tx: bytes):
-        res = self._mempool.check_tx(tx)
-        if res.is_ok() and self._broadcast:
-            self._ch.broadcast(encode_txs([tx]))
-        return res
+        """Sync facade over submit_tx_and_broadcast (the RPC
+        broadcast_tx_sync path): blocks for the response, but the
+        broadcast-on-success rides the done-callback either way."""
+        return self.submit_tx_and_broadcast(tx).result(timeout=300)
 
     # -- peer gossip ------------------------------------------------------
 
@@ -77,9 +98,23 @@ class MempoolReactor:
                     continue
                 self._seen_from_peers.add(k)
                 try:
-                    res = self._mempool.check_tx(tx, sender=env.from_id)
+                    # async per tx: a peer's batched Txs message lands in
+                    # ONE accumulator window instead of serializing this
+                    # loop on per-tx device waits (ISSUE 13)
+                    fut = self._mempool.check_tx_async(
+                        tx, sender=env.from_id
+                    )
                 except (DuplicateTxError, MempoolFullError, ValueError):
                     continue
-                if res.is_ok() and self._broadcast:
-                    # relay to the rest of the mesh (reactor.go broadcast walk)
-                    self._ch.broadcast(encode_txs([tx]))
+
+                def _relay(f, tx=tx):
+                    try:
+                        res = f.result()
+                    except Exception:  # noqa: BLE001 — no relay on failure
+                        return
+                    if res.is_ok() and self._broadcast:
+                        # relay to the rest of the mesh (reactor.go
+                        # broadcast walk)
+                        self._ch.broadcast(encode_txs([tx]))
+
+                fut.add_done_callback(_relay)

@@ -114,6 +114,59 @@ def mock_light_prepare(real_prepare, rtt_s: float):
     return prep
 
 
+class DeadlineReadback:
+    """Proxy device result that materializes at an absolute deadline —
+    `rtt_s` after LAUNCH, not after the resolver gets around to it. The
+    SlowReadback mock charges its delay inside __array__, which
+    serializes the resolver at one RTT per batch; a real device's compute
+    proceeds while the host pipelines, so concurrent launches' readbacks
+    mature in parallel. bench.py mempool uses this so the mocked relay
+    models per-launch LATENCY (each batch's verdict is unavailable for a
+    full RTT) without inventing a serial resolver bottleneck no real
+    backend has."""
+
+    def __init__(self, verdict, deadline: float):
+        self._verdict = verdict
+        self._deadline = deadline
+
+    def copy_to_host_async(self):
+        pass
+
+    def __array__(self, dtype=None):
+        import numpy as np
+
+        now = time.perf_counter()
+        if now < self._deadline:
+            time.sleep(self._deadline - now)
+        a = np.asarray(self._verdict)
+        return a.astype(dtype) if dtype is not None else a  # tmlint: disable=donation-aliasing — mock mimics device semantics
+
+
+def mock_mempool_prepare(real_prepare, rtt_s: float):
+    """Mocked-relay DEVICE for `bench.py mempool` (ISSUE 13): the real
+    ingress accumulation, EntryBlock packing, host prep and H2D transfer
+    run unchanged, but the launch returns an all-accept verdict row that
+    matures `rtt_s` after launch (DeadlineReadback) instead of running
+    the kernel. Both bench columns — the windowed accumulator and the
+    per-tx baseline — pay this same relay latency per LAUNCH, so the
+    ratio measures exactly what device-batched CheckTx adds: signatures
+    fused per relay command."""
+    import numpy as np
+
+    def prep(entries):
+        _f, args, rlc, bucket = real_prepare(entries)
+
+        def launch(*_xs):
+            return DeadlineReadback(
+                np.ones((bucket,), dtype=bool),
+                time.perf_counter() + rtt_s,
+            )
+
+        return launch, args, rlc, bucket
+
+    return prep
+
+
 def drain_pool(pool, timeout: float = 5.0) -> None:
     """Wait for every in-flight slot to return. The resolver completes a
     batch's futures BEFORE releasing its pool slot, so a caller waking

@@ -213,7 +213,14 @@ def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
     into at most `max_lanes` single-epoch lanes of `cap` signatures.
     Jobs that fit nowhere are returned as held-over for the next
     superbatch (exactly the coalescer's bucket-overflow hold). A job
-    larger than `cap` raises — submit() must chunk first."""
+    larger than `cap` raises — submit() must chunk first.
+
+    QoS ordering (ISSUE 13): jobs pack in (priority, seq) order — a
+    CONSENSUS-class job claims its lane before any queued INGRESS
+    superjob, so when the pack overflows into the hold list it is the
+    lowest-priority latest arrivals that wait for the next superbatch.
+    Jobs without the attributes (direct callers, older tests) default to
+    the most urgent class in arrival order — the pre-QoS behavior."""
     cap = cap or lane_cap()
     # pow2 lane-count discipline (see MeshPlan): never pack more lanes
     # than the plan will have room for
@@ -221,6 +228,10 @@ def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
     lanes: List[Lane] = []
     held: List = []
     empty: List = []
+    jobs = sorted(
+        jobs,
+        key=lambda j: (getattr(j, "priority", 0), getattr(j, "seq", 0)),
+    )
     for job in jobs:
         n = len(job.entries)
         if n > cap:

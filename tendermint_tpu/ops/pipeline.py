@@ -19,12 +19,15 @@ Consumers:
 from __future__ import annotations
 
 import functools
+import heapq
+import itertools
 import logging
 import os
 import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -107,6 +110,87 @@ def _alias_view(arr: np.ndarray) -> np.ndarray:
     return buf[:]  # non-owning view of the shared scratch
 
 
+# QoS priority classes (ISSUE 13): the dispatcher is multi-tenant now —
+# consensus commit batches share it with mempool CheckTx superbatches.
+# Lower value = more urgent. Two classes only: a pending CONSENSUS batch
+# overtakes every queued INGRESS superbatch (never an in-flight launch),
+# so a tx flood cannot push commit verification to the back of the line.
+PRIORITY_CONSENSUS = 0
+PRIORITY_INGRESS = 1
+
+
+class _PriorityQueue:
+    """Priority-ordered hand-off queue (ISSUE 13): items pop in
+    (priority, arrival) order — arrival sequence preserves FIFO within a
+    class, so this degrades to the old plain Queue when every producer
+    uses one priority. Reordering happens strictly while an item is
+    QUEUED: once the consumer picks a batch up (an in-flight transfer or
+    launch) it is never revoked. The None close sentinel is delivered
+    only after the heap drains, preserving the plain-Queue shutdown
+    contract. `on_bypass(n)` — called outside the internal lock — reports
+    how many queued lower-priority items a new arrival overtook: the
+    preemption-visibility hook feeding `checktx_preemptions`."""
+
+    def __init__(self, on_bypass=None):
+        self._heap: list = []
+        self._ctr = itertools.count()
+        self._cv = threading.Condition(threading.Lock())
+        self._closed = False
+        self.on_bypass = on_bypass
+
+    def put(self, item, priority: int = 0) -> None:
+        if item is None:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            return
+        with self._cv:
+            bypassed = sum(1 for p, _, _ in self._heap if p > priority)
+            heapq.heappush(self._heap, (priority, next(self._ctr), item))
+            self._cv.notify()
+        if bypassed and self.on_bypass is not None:
+            try:
+                self.on_bypass(bypassed)
+            except Exception:  # noqa: BLE001 — observability never fatal
+                pass
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._heap:
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise queue.Empty
+                self._cv.wait(remaining)
+            return heapq.heappop(self._heap)[2]
+
+    def get_nowait(self):
+        with self._cv:
+            if not self._heap:
+                raise queue.Empty
+            return heapq.heappop(self._heap)[2]
+
+    def empty(self) -> bool:
+        with self._cv:
+            return not self._heap
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._heap)
+
+    def best_priority(self) -> Optional[int]:
+        """Priority of the most-urgent queued item (None when empty) —
+        the dispatcher's preemption probe while parked on the depth
+        semaphore with a lower-urgency batch in hand."""
+        with self._cv:
+            return self._heap[0][0] if self._heap else None
+
+
 class DispatchError(RuntimeError):
     """A batch failed on the dispatch-owner thread (host prep, epoch-table
     upload, or kernel launch). Carries the epoch/bucket context of the
@@ -126,9 +210,11 @@ class DispatchError(RuntimeError):
 
 
 class _Job:
-    __slots__ = ("entries", "future", "flow", "flow_owned")
+    __slots__ = ("entries", "future", "flow", "flow_owned",
+                 "priority", "seq")
 
-    def __init__(self, entries: EntryBlock):
+    def __init__(self, entries: EntryBlock,
+                 priority: int = PRIORITY_CONSENSUS, seq: int = 0):
         self.entries = entries
         self.future: Future = Future()
         # flow correlation id (ISSUE 10): allocated at submit() when the
@@ -140,6 +226,11 @@ class _Job:
         # caller owns the chain's terminal event.
         self.flow: Optional[int] = None
         self.flow_owned = True
+        # QoS class + submission sequence (ISSUE 13): seq keeps ordering
+        # FIFO within a class and lets the mesh packer count how many
+        # earlier-arrived INGRESS jobs a CONSENSUS job overtook
+        self.priority = priority
+        self.seq = seq
 
 
 class AsyncBatchVerifier:
@@ -194,12 +285,33 @@ class AsyncBatchVerifier:
             )
         self._pool = _dpool.DeviceBufferPool(pool_depth)
         self._d2h_async = _d2h_async_supported()
-        self._q: "queue.Queue[_Job]" = queue.Queue()
-        # (spans, prep_future, t_enqueue, ready_box) | None sentinel
-        self._dispatch_q: "queue.Queue" = queue.Queue()
-        self._resolve_q: "queue.Queue" = queue.Queue()
+        # job intake is priority-ordered too (ISSUE 13): a commit
+        # submitted behind a backlog of queued ingress windows reaches
+        # the coalescer first instead of waiting out the whole backlog
+        self._q = _PriorityQueue()
+        # QoS preemption visibility (ISSUE 13): total lower-priority
+        # batches bypassed while queued, plus caller hooks (the mempool
+        # ingress accumulator feeds MempoolMetrics.checktx_preemptions)
+        self.preempted_total = 0
+        self._preempt_mtx = threading.Lock()
+        self._preempt_hooks: List = []
+        # (spans, prep_future, t_enqueue, priority) | None sentinel —
+        # priority-ordered so a pending consensus batch overtakes queued
+        # ingress superbatches (never an in-flight launch)
+        self._dispatch_q = _PriorityQueue(on_bypass=self._note_preempt)
+        # resolve order is priority-ordered too: with batches of both
+        # classes in flight, the consensus verdict materializes first
+        # instead of queuing behind ingress readbacks
+        self._resolve_q = _PriorityQueue()
+        self._job_seq = itertools.count()
         self._stopped = threading.Event()
         self._sem = threading.Semaphore(self._depth)
+        # QoS reserved lane (ISSUE 13): INGRESS batches may occupy at
+        # most depth-1 of the launch slots, so a consensus commit never
+        # queues behind a device pipeline filled wall-to-wall with tx
+        # superbatches — its depth wait is ~0 instead of a full readback.
+        # Degenerate depth=1 disables the reservation (guarded at use).
+        self._ing_sem = threading.Semaphore(max(self._depth - 1, 1))
         self._mtx = _devcheck.lock("pipeline.inflight")
         self._inflight = 0
         # thread idents that ever launched a kernel — asserted single-
@@ -220,7 +332,23 @@ class AsyncBatchVerifier:
         self._dispatch_thread.start()
         self._resolve_thread.start()
 
-    def submit(self, entries, flow: Optional[int] = None) -> Future:
+    def add_preempt_hook(self, fn) -> None:
+        """Register fn(n_bypassed) — called whenever queued lower-priority
+        batches are overtaken by a higher-priority arrival (dispatch-queue
+        bypass or mesh-pack reorder)."""
+        self._preempt_hooks.append(fn)
+
+    def _note_preempt(self, n: int) -> None:
+        with self._preempt_mtx:
+            self.preempted_total += n
+        for fn in list(self._preempt_hooks):
+            try:
+                fn(n)
+            except Exception:  # noqa: BLE001 — observability never fatal
+                pass
+
+    def submit(self, entries, flow: Optional[int] = None,
+               priority: int = PRIORITY_CONSENSUS) -> Future:
         if self._stopped.is_set():
             raise RuntimeError("verifier is closed")
         block = as_block(entries)
@@ -230,8 +358,9 @@ class AsyncBatchVerifier:
             # submissions at the lane capacity so every chunk fits one
             max_b = min(max_b, _mesh.lane_cap())
         if len(block) > max_b:
-            return self._submit_chunked(block, max_b, flow)
-        job = _Job(block)
+            return self._submit_chunked(block, max_b, flow, priority)
+        job = _Job(block, priority=int(priority),
+                   seq=next(self._job_seq))
         if _trace.TRACER.enabled:
             if flow is not None:
                 # continue the CALLER's flow (ISSUE 11: the light
@@ -248,12 +377,13 @@ class AsyncBatchVerifier:
                 _trace.TRACER.flow_point(
                     "pipeline.submit", job.flow, "s", n=len(block)
                 )
-        self._q.put(job)
+        self._q.put(job, priority=job.priority)
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
 
     def _submit_chunked(self, block: EntryBlock, max_b: int,
-                        flow: Optional[int] = None) -> Future:
+                        flow: Optional[int] = None,
+                        priority: int = PRIORITY_CONSENSUS) -> Future:
         """An oversized job rides as zero-copy slices through the normal
         queue (the dispatcher stays the only device-touching thread; the
         old path ran a chunked synchronous fallback on the worker) and
@@ -261,7 +391,10 @@ class AsyncBatchVerifier:
         futs: List[Future] = []
         i = 0
         while i < len(block):
-            futs.append(self.submit(block[i : i + max_b], flow=flow))
+            futs.append(
+                self.submit(block[i : i + max_b], flow=flow,
+                            priority=priority)
+            )
             i += max_b
         agg: Future = Future()
         done_lock = threading.Lock()
@@ -503,6 +636,13 @@ class AsyncBatchVerifier:
         prep_pool = ThreadPoolExecutor(3, thread_name_prefix="verify-prep")
         hold: Optional[_Job] = None
         max_b = _backend.max_coalesce()
+        # QoS fuse cap (ISSUE 13): INGRESS-class rounds fuse only up to
+        # this many entries. Every non-preemptible stage a fused batch
+        # passes through — host prep, readback post-processing — scales
+        # with batch size, so an unbounded ingress fuse turns into
+        # head-of-line latency for the consensus class even with every
+        # queue priority-ordered. Consensus rounds keep the full bucket.
+        ing_cap = int(os.environ.get("TM_TPU_INGRESS_FUSE", "1024"))
         m = _backend._ops_m()
         try:
             while True:
@@ -531,7 +671,11 @@ class AsyncBatchVerifier:
                 # larger batches are strictly faster
                 busy = self._inflight > 0 or self._dispatch_q.qsize() > 0
                 deadline = time.monotonic() + 0.008 if busy else 0.0
-                while total < max_b:
+                limit = (
+                    max_b if job.priority <= PRIORITY_CONSENSUS
+                    else min(max_b, ing_cap)
+                )
+                while total < limit:
                     try:
                         nxt = self._q.get_nowait()
                     except queue.Empty:
@@ -543,7 +687,7 @@ class AsyncBatchVerifier:
                         except queue.Empty:
                             break
                     if (
-                        total + len(nxt.entries) > max_b
+                        total + len(nxt.entries) > limit
                         or nxt.entries.epoch_key != key0
                     ):
                         hold = nxt
@@ -580,8 +724,30 @@ class AsyncBatchVerifier:
                     if len(jobs) == 1
                     else EntryBlock.concat([j.entries for j in jobs])
                 )
-                fut = prep_pool.submit(self._prepare_timed, entries)
-                self._dispatch_q.put((spans, fut, time.perf_counter()))
+                # a fused batch inherits the most urgent class of its
+                # jobs: a consensus job fused with ingress stragglers
+                # lifts the whole batch rather than riding behind it
+                pri = min(j.priority for j in jobs)
+                if pri <= PRIORITY_CONSENSUS:
+                    # consensus prep runs INLINE: the prep pool is a FIFO,
+                    # so a commit's (small) prep submitted behind queued
+                    # ingress-superbatch preps would wait out every one of
+                    # them — the same inversion the priority queues fix,
+                    # one layer down. Inline prep hands the dispatcher an
+                    # already-resolved future; overlap with the in-flight
+                    # kernel is preserved (this thread isn't the
+                    # dispatcher), only drain-ahead is given up, and a
+                    # consensus round is small enough not to miss it.
+                    fut = Future()
+                    try:
+                        fut.set_result(self._prepare_timed(entries))
+                    except BaseException as e:  # noqa: BLE001
+                        fut.set_exception(e)
+                else:
+                    fut = prep_pool.submit(self._prepare_timed, entries)
+                self._dispatch_q.put(
+                    (spans, fut, time.perf_counter(), pri), priority=pri
+                )
                 m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 m.pipeline_queue_depth.set(self._q.qsize())
         finally:
@@ -644,6 +810,22 @@ class AsyncBatchVerifier:
                 # rule extended to the new packing stage): a poisoned
                 # pack fails ONLY the drained jobs' futures — the worker
                 # thread itself never dies on a batch's account.
+                # QoS reorder (ISSUE 13): pack order is (priority, seq)
+                # order, so a CONSENSUS commit drained in the same window
+                # as queued INGRESS superjobs packs — and launches — ahead
+                # of every one of them. `preempted` counts the ingress
+                # jobs that arrived earlier but were ordered behind (or
+                # pushed to the hold list by) this window's consensus
+                # work; an already-launched superbatch is never revoked.
+                jobs.sort(key=lambda j: (j.priority, j.seq))
+                min_pri = min(j.priority for j in jobs)
+                hi_seq = max(
+                    j.seq for j in jobs if j.priority == min_pri
+                )
+                preempted = sum(
+                    1 for j in jobs
+                    if j.priority > min_pri and j.seq < hi_seq
+                )
                 try:
                     plan, held = _mesh.pack_jobs(jobs, max_lanes, cap)
                     if not plan.lanes:
@@ -660,8 +842,11 @@ class AsyncBatchVerifier:
                     )
                     with _span("pipeline.mesh_pack", lanes=plan.n_lanes,
                                lane_bucket=plan.lane_bucket,
-                               live=plan.live, pad=plan.pad):
+                               live=plan.live, pad=plan.pad,
+                               preempted=preempted):
                         block, spans = _mesh.build_superblock(plan)
+                    if preempted:
+                        self._note_preempt(preempted)
                     m.mesh_lane_occupancy.set(plan.occupancy())
                     m.mesh_pad_waste_ratio.set(plan.pad_ratio())
                     fut = prep_pool.submit(
@@ -677,7 +862,10 @@ class AsyncBatchVerifier:
                     )
                     held = []
                     continue
-                self._dispatch_q.put((spans, fut, time.perf_counter()))
+                self._dispatch_q.put(
+                    (spans, fut, time.perf_counter(), min_pri),
+                    priority=min_pri,
+                )
                 m.dispatch_queue_depth.set(self._dispatch_q.qsize())
                 m.pipeline_queue_depth.set(self._q.qsize())
         finally:
@@ -721,7 +909,19 @@ class AsyncBatchVerifier:
             if item is None:
                 self._resolve_q.put(None)
                 break
-            spans, fut, t_enq = item
+            if item[0] == "xfered":
+                # a batch this loop already transferred, then requeued to
+                # let a higher-priority arrival overtake it at the depth
+                # block (ISSUE 13) — its pool slot and device buffers
+                # carry over; it re-enters directly at the launch stage
+                (_tag, spans, f, dev_args, rlc_entries, bucket,
+                 xslot, t_enq, pri, t_xfer_done) = item
+                fut = None
+            else:
+                spans, fut, t_enq = item[:3]
+                pri = item[3] if len(item) > 3 else PRIORITY_CONSENSUS
+                xslot = None
+                t_xfer_done = 0.0
             # Dispatcher survival invariant: NOTHING a single batch does —
             # prep failure, metrics accounting, the transfer, epoch-table
             # upload inside the kernel closure, the launch itself — may
@@ -731,75 +931,149 @@ class AsyncBatchVerifier:
             # semaphore AND its pool slot intact (sem_held/slot track
             # both so even the last-resort handler leaks neither).
             sem_held = False
-            slot = None
-            bucket = 0
+            ing_held = False
+            slot = xslot
+            if fut is not None:
+                bucket = 0
             try:
                 m.dispatch_queue_depth.set(self._dispatch_q.qsize())
-                try:
-                    prep, t_ready = fut.result()
-                    # mesh preps append per-arg transfer shardings as a
-                    # 5th element (lane-per-device placement); classic
-                    # preps stay 4-tuples
+                if fut is not None:
+                    # QoS preemption point A (ISSUE 13): the PREP wait.
+                    # Host prep of a fused ingress superbatch can run tens
+                    # of ms; nothing device-side is held yet, so when a
+                    # higher-priority batch queues up behind this wait the
+                    # untouched item requeues as-is and the urgent one is
+                    # served first.
+                    requeued = False
+                    prep_err = None
+                    while True:
+                        try:
+                            prep, t_ready = fut.result(timeout=0.002)
+                            break
+                        except _FutTimeout:
+                            best = self._dispatch_q.best_priority()
+                            if best is not None and best < pri:
+                                self._dispatch_q.put(
+                                    (spans, fut, t_enq, pri), priority=pri
+                                )
+                                self._note_preempt(1)
+                                requeued = True
+                                break
+                        except Exception as e:  # noqa: BLE001 — prep
+                            prep_err = e
+                            break
+                    if requeued:
+                        continue
+                    if prep_err is not None:
+                        self._fail_spans(spans, self._wrap_dispatch_err(
+                            "batch prep failed", prep_err, 0, spans))
+                        continue
+                    # mesh preps append per-arg transfer shardings as
+                    # a 5th element (lane-per-device placement);
+                    # classic preps stay 4-tuples
                     shardings = prep[4] if len(prep) > 4 else None
                     f, args, rlc_entries, bucket = prep[:4]
-                except Exception as e:  # noqa: BLE001 — prep-stage failure
-                    self._fail_spans(spans, self._wrap_dispatch_err(
-                        "batch prep failed", e, 0, spans))
-                    continue
-                try:
-                    # transfer accounting: host bytes this launch ships,
-                    # averaged over the commits fused into it — the gauge a
-                    # warm epoch cache visibly shrinks (/status, PERF_r07)
-                    m.h2d_bytes_per_commit.set(
-                        _backend.h2d_arg_bytes(args) / max(len(spans), 1)
-                    )
-                except Exception:  # noqa: BLE001 — accounting never fatal
-                    pass
-                self.dispatch_thread_idents.add(threading.get_ident())
-                # devcheck relay ownership (ISSUE 8): this thread claims
-                # the relay; any transfer/upload from another thread now
-                # asserts (no-op when TM_TPU_DEVCHECK is off)
-                _devcheck.claim_relay("verify-dispatch")
-                # -- stage 1: transfer (before the depth block) ----------
-                try:
-                    slot = self._pool.acquire(
-                        _dpool.layout_key(bucket, args),
-                        abort=self._stopped.is_set,
-                    )
-                    hidden = self._inflight > 0
-                    t_x0 = time.perf_counter()
-                    # positional call when unsharded: test doubles (and
-                    # any older transfer impl) keep their (args)-only
-                    # signature working
-                    if shardings is None:
-                        dev_args = _dpool.transfer(args)
-                    else:
-                        dev_args = _dpool.transfer(args, shardings=shardings)
-                    t_x1 = time.perf_counter()
-                    if slot is not None:
-                        slot.arrays = dev_args
-                    if _trace.TRACER.enabled:
-                        _trace.TRACER.record(
-                            "pipeline.transfer", t_x0, t_x1,
-                            {"bucket": bucket, "hidden": int(hidden)},
+                    try:
+                        # transfer accounting: host bytes this launch
+                        # ships, averaged over the commits fused into it —
+                        # the gauge a warm epoch cache visibly shrinks
+                        # (/status, PERF_r07)
+                        m.h2d_bytes_per_commit.set(
+                            _backend.h2d_arg_bytes(args) / max(len(spans), 1)
                         )
-                    overlap.add(t_x1 - t_x0 if hidden else 0.0, t_x1 - t_x0)
-                    busy.add(t_x1 - t_x0)
-                except Exception as e:  # noqa: BLE001
-                    self._pool.release(slot)
-                    slot = None
-                    self._fail_spans(spans, self._wrap_dispatch_err(
-                        "batch transfer failed", e, bucket, spans))
+                    except Exception:  # noqa: BLE001 — never fatal
+                        pass
+                    self.dispatch_thread_idents.add(threading.get_ident())
+                    # devcheck relay ownership (ISSUE 8): this thread
+                    # claims the relay; any transfer/upload from another
+                    # thread now asserts (no-op when TM_TPU_DEVCHECK off)
+                    _devcheck.claim_relay("verify-dispatch")
+                    # -- stage 1: transfer (before the depth block) ------
+                    try:
+                        slot = self._pool.acquire(
+                            _dpool.layout_key(bucket, args),
+                            abort=self._stopped.is_set,
+                        )
+                        hidden = self._inflight > 0
+                        t_x0 = time.perf_counter()
+                        # positional call when unsharded: test doubles
+                        # (and any older transfer impl) keep their
+                        # (args)-only signature working
+                        if shardings is None:
+                            dev_args = _dpool.transfer(args)
+                        else:
+                            dev_args = _dpool.transfer(
+                                args, shardings=shardings
+                            )
+                        t_x1 = time.perf_counter()
+                        if slot is not None:
+                            slot.arrays = dev_args
+                        if _trace.TRACER.enabled:
+                            _trace.TRACER.record(
+                                "pipeline.transfer", t_x0, t_x1,
+                                {"bucket": bucket, "hidden": int(hidden)},
+                            )
+                        overlap.add(
+                            t_x1 - t_x0 if hidden else 0.0, t_x1 - t_x0
+                        )
+                        busy.add(t_x1 - t_x0)
+                    except Exception as e:  # noqa: BLE001
+                        self._pool.release(slot)
+                        slot = None
+                        self._fail_spans(spans, self._wrap_dispatch_err(
+                            "batch transfer failed", e, bucket, spans))
+                        continue
+                    # -- stage 2: launch (behind the depth semaphore) ----
+                    t_xfer_done = time.perf_counter()
+                    t_enq = max(t_enq, t_ready)
+                # QoS preemption point B (ISSUE 13): while parked here with
+                # a lower-urgency batch in hand, a queued higher-priority
+                # batch may overtake — this batch requeues WITH its
+                # transferred state (pool slot + device buffers), so the
+                # consensus commit's wait shrinks to in-flight launches
+                # only, never the whole transferred backlog. An in-flight
+                # launch is never revoked. INGRESS batches additionally
+                # pass through the reserved-lane semaphore first, leaving
+                # one launch slot the tx flood can never fill.
+                requeued = False
+                if pri > PRIORITY_CONSENSUS and self._depth > 1:
+                    while not self._ing_sem.acquire(timeout=0.002):
+                        best = self._dispatch_q.best_priority()
+                        if best is not None and best < pri:
+                            self._dispatch_q.put(
+                                ("xfered", spans, f, dev_args, rlc_entries,
+                                 bucket, slot, t_enq, pri, t_xfer_done),
+                                priority=pri,
+                            )
+                            slot = None  # rode along with the item
+                            self._note_preempt(1)
+                            requeued = True
+                            break
+                    ing_held = not requeued
+                if not requeued:
+                    while not self._sem.acquire(timeout=0.002):
+                        best = self._dispatch_q.best_priority()
+                        if best is not None and best < pri:
+                            self._dispatch_q.put(
+                                ("xfered", spans, f, dev_args, rlc_entries,
+                                 bucket, slot, t_enq, pri, t_xfer_done),
+                                priority=pri,
+                            )
+                            slot = None  # ownership rode along
+                            if ing_held:
+                                self._ing_sem.release()
+                                ing_held = False
+                            self._note_preempt(1)
+                            requeued = True
+                            break
+                if requeued:
                     continue
-                # -- stage 2: launch (behind the depth semaphore) --------
-                t_xfer_done = time.perf_counter()
-                self._sem.acquire()  # depth: launched-but-unresolved bound
                 sem_held = True
                 t0 = time.perf_counter()
                 if _trace.TRACER.enabled:
                     _trace.TRACER.record(
                         "pipeline.queue_wait",
-                        max(t_enq, t_ready, t_xfer_done), t0,
+                        max(t_enq, t_xfer_done), t0,
                         {"bucket": bucket},
                     )
                 try:
@@ -828,6 +1102,9 @@ class AsyncBatchVerifier:
                     # depth slot + buffer slot and fail this batch alone
                     self._sem.release()
                     sem_held = False
+                    if ing_held:
+                        self._ing_sem.release()
+                        ing_held = False
                     self._pool.release(slot)
                     slot = None
                     self._fail_spans(spans, self._wrap_dispatch_err(
@@ -839,13 +1116,17 @@ class AsyncBatchVerifier:
                 now = time.perf_counter()
                 busy.add(now - t0)
                 self._resolve_q.put(
-                    (spans, rb, rlc_entries, now, bucket, slot)
+                    (spans, rb, rlc_entries, now, bucket, slot, ing_held),
+                    priority=pri,
                 )
                 sem_held = False  # resolver now owns the release
-                slot = None       # (semaphore and pool slot both)
+                ing_held = False  # (both semaphores and the pool slot)
+                slot = None
             except Exception as e:  # noqa: BLE001 — last-resort isolation
                 if sem_held:
                     self._sem.release()
+                if ing_held:
+                    self._ing_sem.release()
                 self._pool.release(slot)
                 self._fail_spans(spans, self._wrap_dispatch_err(
                     "dispatch bookkeeping failed", e, bucket, spans))
@@ -877,7 +1158,8 @@ class AsyncBatchVerifier:
             item = self._resolve_q.get()
             if item is None:
                 break
-            spans, rb, rlc_entries, t_dispatch, bucket, slot = item
+            spans, rb, rlc_entries, t_dispatch, bucket, slot = item[:6]
+            ing_held = item[6] if len(item) > 6 else False
             if _devcheck.inject_lintbug("owner"):
                 # test seam (ISSUE 8): touch the relay from the resolver
                 # thread — devcheck's ownership assertion must fire
@@ -893,6 +1175,8 @@ class AsyncBatchVerifier:
                     self._inflight -= 1
                     m.pipeline_inflight.set(self._inflight)
                 self._sem.release()
+                if ing_held:
+                    self._ing_sem.release()
 
 
 _shared: Optional[AsyncBatchVerifier] = None

@@ -170,7 +170,21 @@ class Environment:
             # north-star hot path) — counters only, no jax import, so a
             # /status poll stays cheap even mid-verification
             "verify_engine": self._verify_engine_stats(),
+            # ISSUE 13: device-batched CheckTx back-pressure — queue depth,
+            # window wait, preemptions. Same cheap-counters-only discipline.
+            "mempool_ingress": self._mempool_ingress_stats(),
         }
+
+    def _mempool_ingress_stats(self) -> dict:
+        try:
+            mp = getattr(self._node, "mempool", None)
+            if mp is not None and hasattr(mp, "ingress_stats"):
+                return mp.ingress_stats()
+            from ..mempool.ingress import ingress_stats
+
+            return ingress_stats()
+        except Exception as e:  # noqa: BLE001 — /status must not 500
+            return {"enabled": False, "error": str(e)}
 
     @staticmethod
     def _verify_engine_stats() -> dict:

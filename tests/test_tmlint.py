@@ -532,6 +532,112 @@ class TestLockDiscipline:
         """
         assert not lint(src, REACTOR_PATH, "lock-discipline")
 
+    # -- ISSUE 13: .result() under a state mutex ------------------------
+
+    def test_positive_result_under_mutex(self):
+        """The bad shape satellite 2 removed from the mempool: waiting on
+        a device verdict while holding the mempool's state mutex — the
+        completing thread (the ingress completer) needs that same lock to
+        finish CheckTx, so this deadlocks."""
+        src = """
+            def check_tx(self, tx):
+                fut = self._ingress.submit(tx)
+                with self._mtx:
+                    verdict = fut.result(timeout=300)
+                return verdict
+        """
+        fs = lint(src, "tendermint_tpu/mempool/fake_mod.py",
+                  "lock-discipline")
+        assert fs and "_mtx" in fs[0].message
+
+    def test_positive_result_under_module_level_mtx_name(self):
+        src = """
+            def f(mtx, fut):
+                with mtx:
+                    return fut.result()
+        """
+        assert rules_of(
+            lint(src, REACTOR_PATH, "lock-discipline")
+        ) == ["lock-discipline"]
+
+    def test_negative_result_outside_mutex(self):
+        """The fixed shape: resolve the future first, take the lock for
+        the state mutation only."""
+        src = """
+            def check_tx(self, tx):
+                fut = self._ingress.submit(tx)
+                verdict = fut.result(timeout=300)
+                with self._mtx:
+                    self._insert(tx, verdict)
+                return verdict
+        """
+        assert not lint(src, "tendermint_tpu/mempool/fake_mod.py",
+                        "lock-discipline")
+
+    def test_negative_result_under_coordination_lock(self):
+        """Locks NOT named *mtx* are out of scope: pipeline.py's chunked
+        submit collects sub-results under `done_lock` by design (the
+        completer there never needs that lock)."""
+        src = """
+            def _combine(done_lock, futs):
+                out = []
+                with done_lock:
+                    for f in futs:
+                        out.append(f.result())
+                return out
+        """
+        assert not lint(src, "tendermint_tpu/ops/fake_mod.py",
+                        "lock-discipline")
+
+    def test_negative_result_in_nested_def_under_mutex(self):
+        """A callback DEFINED under the lock runs later on another frame
+        — defining it is not waiting under the lock."""
+        src = """
+            def f(self, fut):
+                with self._mtx:
+                    def _done(f):
+                        return f.result()
+                    fut.add_done_callback(_done)
+        """
+        assert not lint(src, "tendermint_tpu/mempool/fake_mod.py",
+                        "lock-discipline")
+
+    # -- ISSUE 13: ingress accumulator relay discipline ------------------
+
+    def test_positive_ingress_wiring_mock_outside_whitelist(self):
+        """Wiring the mempool mocked-relay double into the pipeline from
+        production ingress code is a relay violation — only bench/gate
+        harnesses (and ops/_testing.py itself) may do that."""
+        src = """
+            from tendermint_tpu.ops._testing import mock_mempool_prepare
+
+            def fast_path(pl):
+                pl.AsyncBatchVerifier._prepare = mock_mempool_prepare(
+                    pl.AsyncBatchVerifier._prepare, 0.0
+                )
+        """
+        assert rules_of(
+            lint(src, "tendermint_tpu/mempool/ingress.py",
+                 "relay-ownership")
+        ) == ["relay-ownership"]
+
+    def test_negative_ingress_accumulator_submit_path(self):
+        """The real accumulator shape — EntryBlocks submitted to the
+        shared verifier with an ingress priority, verdicts via futures —
+        is clean: no relay entry point in sight."""
+        src = """
+            def _flush_device(self, batch):
+                block = self._pack(batch)
+                fut = self._verifier.submit(
+                    block, priority=1
+                )
+                fut.add_done_callback(
+                    self._on_device_done
+                )
+        """
+        assert not lint(src, "tendermint_tpu/mempool/ingress.py",
+                        "relay-ownership")
+
 
 # ---------------------------------------------------------------------------
 # framework mechanics

@@ -72,19 +72,26 @@ METRIC_KEYS = (
     # light-service artifacts (LIGHT_r*, ISSUE 11)
     "light_unique_headers_per_s", "light_sequential_headers_per_s",
     "vs_sequential", "memo_hit_ratio",
+    # mempool-ingress artifacts (MEMPOOL_r*, ISSUE 13)
+    "mempool_seq_sigs_per_s", "commit_p99_unloaded_ms",
+    "commit_p99_flood_ms", "flood_latency_ratio", "checktx_preemptions",
+    "ingress_windows", "ingress_batch_wait_ms_avg",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
-_LOWER_IS_BETTER = {"relay_rtt_ms"}
+_LOWER_IS_BETTER = {
+    "relay_rtt_ms", "commit_p99_unloaded_ms", "commit_p99_flood_ms",
+    "flood_latency_ratio",
+}
 
 # keys a COMPARE tracks by default (rate-like, present across most rounds)
 COMPARE_KEYS = (
     "value", "sustained_sigs_per_s", "kernel_stream_sigs_per_s",
     "pipelined_headers_per_s", "mixed_curve_sigs_per_s", "relay_rtt_ms",
-    "speedup_2v1", "light_unique_headers_per_s",
+    "speedup_2v1", "light_unique_headers_per_s", "flood_latency_ratio",
 )
 
-_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT)_r(\d+)", re.I)
+_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT|MEMPOOL)_r(\d+)", re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -198,6 +205,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "LIGHT_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "MEMPOOL_r*.json")))
     return paths
 
 
@@ -214,7 +222,7 @@ def validate(art: dict) -> List[str]:
     if art.get("unreadable"):
         probs.append("; ".join(art["notes"]))
         return probs
-    if art["kind"] not in ("bench", "multichip", "light"):
+    if art["kind"] not in ("bench", "multichip", "light", "mempool"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

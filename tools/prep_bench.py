@@ -807,6 +807,150 @@ def run_light(args) -> int:
     return rc
 
 
+def run_ingress(args) -> int:
+    """--ingress: the round-13 mempool-ingress gate on a mocked relay
+    (slow readback over REAL kernels — verdicts are live). Asserts the
+    three properties device-batched CheckTx must hold:
+
+      fuse       N flooded txs reach the device in <= K launches (the
+                 accumulator windows them, the coalescer fuses windows) —
+                 each per-tx dispatch would otherwise pay a full relay
+                 RTT, the ~25 tx/s sequential ceiling bench.py measures
+      QoS        a consensus-priority batch submitted mid-flood overtakes
+                 queued ingress work: preempted_total advances and the
+                 commit's verdict lands while ingress futures are still
+                 outstanding
+      no leak    every tx future resolves (a forged signature resolves
+                 FALSE, never silently dropped), and zero buffer-pool
+                 slots remain in flight once drained
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.mempool import ingress as ing
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.ops.entry_block import EntryBlock
+
+    n_txs, n_senders, max_batch = 256, 8, 64
+    resolve_delay = 0.15
+    print(f"prep_bench --ingress: txs={n_txs} senders={n_senders} "
+          f"batch={max_batch} resolve_delay={resolve_delay}s")
+    rc = 0
+    import hashlib
+
+    privs = [ed.gen_priv_key(seed=hashlib.sha256(b"ingress-gate-%d" % s)
+                             .digest()) for s in range(n_senders)]
+    stxs = []
+    for i in range(n_txs):
+        raw = ing.make_signed_tx(privs[i % n_senders],
+                                 b"gate_k%d=v%d" % (i, i),
+                                 nonce=i // n_senders + 1)
+        stxs.append(ing.parse_signed_tx(raw))
+    # one forged signature mid-flood: its future must resolve FALSE
+    forged_i = n_txs // 2
+    f = stxs[forged_i]
+    bad = bytearray(f.sig)
+    bad[0] ^= 0x5A
+    stxs[forged_i] = ing.SignedTx(f.scheme, f.pub, f.nonce, bytes(bad),
+                                  f.payload, f.raw)
+    commit_block = EntryBlock.from_entries(
+        [(s.pub, s.signed_bytes(), s.sig) for s in stxs[:32]
+         if ing.host_verify(s)]
+    )
+
+    _epoch.reset(4)
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay)
+    )
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    v = pl.AsyncBatchVerifier(depth=1, pool_depth=OVERLAP_POOL_DEPTH)
+    acc = ing.IngressAccumulator(verifier=v, max_batch=max_batch,
+                                 window_ms=8.0)
+    try:
+        # two waves: wave 1 launches and holds the single depth slot for
+        # resolve_delay; wave 2 transfers and parks on the semaphore.
+        # The commit then arrives against a genuinely occupied pipeline —
+        # the shape the preemption machinery exists for.
+        futs = [acc.submit(s) for s in stxs[:max_batch]]
+        acc.flush_now()
+        time.sleep(0.05)  # wave 1 is in flight on the device
+        futs += [acc.submit(s) for s in stxs[max_batch:]]
+        acc.flush_now()
+        time.sleep(0.02)  # wave 2 transferred, parked on the depth sem
+        cfut = v.submit(commit_block, priority=pl.PRIORITY_CONSENSUS)
+        commit_ok = bool(all(cfut.result(timeout=300)))
+        pending_at_commit = sum(1 for x in futs if not x.done())
+        verdicts = [x.result(timeout=300) for x in futs]
+        launches = sum(
+            1 for name, *_ in tr.TRACER.events()
+            if name == "pipeline.dispatch"
+        )
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+        preempts = v.preempted_total
+    finally:
+        tr.configure(enabled=False)
+        acc.close()
+        v.close()
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+
+    # -- fuse: N txs in <= K launches ------------------------------------
+    k_max = n_txs // max_batch + 2  # windows + the commit + slack
+    print(f"  txs flooded                : {n_txs}")
+    print(f"  device launches            : {launches} (gate: <= {k_max})")
+    if launches > k_max:
+        print(f"  FAIL: {launches} launches for {n_txs} txs — "
+              "ingress windows are not fusing", file=sys.stderr)
+        rc = 1
+
+    # -- QoS: the commit overtook queued ingress work --------------------
+    print(f"  commit verdict             : "
+          f"{'all-valid' if commit_ok else 'INVALID'}")
+    print(f"  ingress futures pending when commit landed: "
+          f"{pending_at_commit}")
+    print(f"  preempted_total            : {preempts}")
+    if not commit_ok:
+        print("  FAIL: consensus batch verdict wrong", file=sys.stderr)
+        rc = 1
+    if preempts <= 0:
+        print("  FAIL: consensus batch never preempted queued ingress "
+              "work", file=sys.stderr)
+        rc = 1
+    if pending_at_commit <= 0:
+        print("  FAIL: commit landed after the whole flood — no QoS "
+              "evidence", file=sys.stderr)
+        rc = 1
+
+    # -- verdict integrity + pool hygiene --------------------------------
+    bad_verdicts = [i for i, ok in enumerate(verdicts)
+                    if ok != (i != forged_i)]
+    print(f"  verdicts                   : {sum(verdicts)} valid / "
+          f"{len(verdicts) - sum(verdicts)} rejected "
+          f"(forged tx at {forged_i})")
+    print(f"  pool                       : {pool}")
+    if bad_verdicts:
+        print(f"  FAIL: wrong verdicts at {bad_verdicts[:4]} — the "
+              "forged tx must be the ONLY rejection", file=sys.stderr)
+        rc = 1
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -851,6 +995,14 @@ def main() -> int:
         "count, verdict/blame parity vs the sequential verifier, memoized "
         "resubmission launches nothing, zero pool-slot leak",
     )
+    ap.add_argument(
+        "--ingress",
+        action="store_true",
+        help="round-13 gate: device-batched mempool CheckTx on a mocked "
+        "relay — N flooded txs fuse into <= K launches, a mid-flood "
+        "consensus batch preempts queued ingress work, a forged tx "
+        "resolves FALSE (never dropped), zero pool-slot leak",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -862,6 +1014,8 @@ def main() -> int:
         return run_mesh(args)
     if args.light:
         return run_light(args)
+    if args.ingress:
+        return run_ingress(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

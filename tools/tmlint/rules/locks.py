@@ -17,6 +17,17 @@ Two shapes this repo has been burned by:
    whose body calls relay entry points — a thread that would touch the
    device without being the dispatch-owner. The runtime twin of this
    check is devcheck's relay-thread assertion.
+
+3. `fut.result()` under a mutex (ISSUE 13): a `.result()` call inside a
+   `with <...mtx...>:` block parks the lock across a device round-trip.
+   If the thread that completes that future ever needs the same lock
+   (the ingress completer finishing CheckTx needs the mempool's `_mtx`),
+   that's a deadlock, and even when it isn't, every other lock client
+   stalls for a full relay RTT. Scoped to receivers whose name contains
+   "mtx" — the repo's convention for state mutexes — so coordination
+   locks built FOR result-collection (pipeline.py's `done_lock`) don't
+   false-positive. Wait on futures outside the lock, or hand completion
+   to a dedicated thread (mempool/ingress.py's completer).
 """
 
 from __future__ import annotations
@@ -38,6 +49,29 @@ def _terminal_receiver(call: ast.Call) -> str:
         if isinstance(inner, ast.Name):
             return inner.id
     return ""
+
+
+def _ctx_name(expr: ast.AST) -> str:
+    """`with self._mtx:` / `with mtx:` -> the lock's terminal name."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _walk_same_frame(nodes) -> Iterator[ast.AST]:
+    """Walk statements WITHOUT descending into nested function/lambda
+    bodies — code in a `def` inside a `with` block runs later, on some
+    other thread's frame, not under this lock."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
 
 
 class LockDisciplineRule(Rule):
@@ -86,6 +120,26 @@ class LockDisciplineRule(Rule):
                         f"releases (cross-thread handoffs are what "
                         f"semaphores are for)",
                     )
+            # 3) `fut.result()` while holding a state mutex
+            if isinstance(node, ast.With):
+                lock = ""
+                for item in node.items:
+                    name = _ctx_name(item.context_expr)
+                    if "mtx" in name.lower():
+                        lock = name
+                        break
+                if lock:
+                    for sub in _walk_same_frame(node.body):
+                        if (isinstance(sub, ast.Call)
+                                and func_name(sub) == "result"):
+                            yield ctx.finding(
+                                self.name, sub,
+                                f"`.result()` inside `with {lock}:` parks "
+                                f"the mutex across a future's round-trip — "
+                                f"deadlock bait if the completing thread "
+                                f"needs {lock}; wait outside the lock or "
+                                f"complete on a dedicated thread",
+                            )
             # 2) thread targets
             if isinstance(node, ast.Call) and func_name(node) == "Thread":
                 if receiver_name(node) not in ("threading", ""):

@@ -67,6 +67,7 @@ ENTRY_POINTS = frozenset({
     # included) must route through AsyncBatchVerifier, never wire a mock
     "mock_light_prepare",
     "mock_mesh_prepare",
+    "mock_mempool_prepare",
     "slow_prepare",
     "slow_mesh_prepare",
 })
