@@ -18,6 +18,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -28,6 +29,22 @@ from ..types.block import Block
 from ..types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
 from ..types.validation import verify_commit_light
 from ..wire.proto import ProtoWriter, decode_message, field_bytes, field_int, to_signed64
+from .replay import ReplayEngine
+
+
+def _metrics():
+    from ..libs.metrics import blocksync_metrics
+
+    return blocksync_metrics()
+
+
+def _pipeline_error():
+    # lazy: blocksync importing ops.pipeline at module import would pull
+    # jax into every node start; by the time a speculation future can
+    # fail, the pipeline module is necessarily loaded already
+    from ..ops.pipeline import DispatchError
+
+    return DispatchError
 
 BLOCKSYNC_CHANNEL = 0x40
 BLOCKSYNC_DESC = ChannelDescriptor(
@@ -59,17 +76,40 @@ class _PendingRequest:
 
 
 class BlockPool:
-    """pool.go:69-250 (condensed): window of in-flight height requests."""
+    """pool.go:69-250 (condensed): window of in-flight height requests.
 
-    def __init__(self, start_height: int):
+    `clock` is injected (defaults to the wall clock) so simnet-driven
+    pools stay deterministic. Consumers register wake events via
+    `waker()`; every event is set whenever pool state changes in a way
+    the reactor loops care about (new block, new peer range, height
+    advance, redo) — the loops block on their event instead of polling
+    (each loop owns its event, so one loop's clear() can never swallow
+    another's wake)."""
+
+    def __init__(self, start_height: int, clock=None):
         self.height = start_height  # next height to apply
         self._requests: Dict[int, _PendingRequest] = {}
         self._peers: Dict[str, tuple] = {}  # peer_id -> (base, height)
         self._mtx = threading.RLock()
+        self._clock = clock if clock is not None else time.time
+        self._wakers: list = []
+
+    def waker(self) -> threading.Event:
+        ev = threading.Event()
+        with self._mtx:
+            self._wakers.append(ev)
+        return ev
+
+    def signal(self) -> None:
+        with self._mtx:
+            wakers = list(self._wakers)
+        for ev in wakers:
+            ev.set()
 
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
         with self._mtx:
             self._peers[peer_id] = (base, height)
+        self.signal()
 
     def remove_peer(self, peer_id: str) -> None:
         with self._mtx:
@@ -77,6 +117,7 @@ class BlockPool:
             for req in self._requests.values():
                 if req.peer_id == peer_id and req.block is None:
                     req.peer_id = ""  # re-requestable
+        self.signal()
 
     def max_peer_height(self) -> int:
         with self._mtx:
@@ -92,7 +133,7 @@ class BlockPool:
     def next_requests(self) -> Dict[int, str]:
         """Heights to (re)request and the peer to ask."""
         out: Dict[int, str] = {}
-        now = time.time()
+        now = self._clock()
         with self._mtx:
             peers = [
                 (pid, base, h) for pid, (base, h) in self._peers.items()
@@ -125,12 +166,14 @@ class BlockPool:
                 if h < self.height:
                     return False
                 self._requests[h] = _PendingRequest(height=h, peer_id=peer_id, block=block)
+                self.signal()
                 return True
             if req.block is not None:
                 return False
             req.peer_id = peer_id
             req.block = block
-            return True
+        self.signal()
+        return True
 
     def peek_two_blocks(self):
         """reactor.go:500-520: need (first, second) to verify first."""
@@ -147,10 +190,27 @@ class BlockPool:
                 b.block if b else None,
             )
 
+    def peek_run(self, max_blocks: int):
+        """The consecutive run of fetched blocks starting at the next
+        apply height — the raw material for a replay range (ISSUE 14).
+        Height h is only VERIFIABLE when block h+1 is also fetched, so a
+        run of k blocks yields k-1 replayable heights."""
+        out = []
+        with self._mtx:
+            h = self.height
+            while len(out) < max_blocks:
+                req = self._requests.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append(req.block)
+                h += 1
+        return out
+
     def pop_first(self) -> None:
         with self._mtx:
             self._requests.pop(self.height, None)
             self.height += 1
+        self.signal()
 
     def redo_request(self, height: int) -> None:
         """Invalid block: drop both candidate blocks and re-request."""
@@ -159,6 +219,7 @@ class BlockPool:
                 req = self._requests.pop(h, None)
                 if req is not None and req.peer_id:
                     self._peers.pop(req.peer_id, None)
+        self.signal()
 
 
 class BlockSyncReactor:
@@ -179,6 +240,12 @@ class BlockSyncReactor:
         self._state = initial_state
         self._on_caught_up = on_caught_up
         self._pool = BlockPool(initial_state.last_block_height + 1)
+        self._req_wake = self._pool.waker()
+        self._apply_wake = self._pool.waker()
+        self._engine = ReplayEngine()
+        # idle wake counters per loop — the no-hot-spin guard: with no
+        # work available the loops block on events, so these stay small
+        self.loop_wakes = {"request": 0, "apply": 0, "status": 0}
         self._stopped = threading.Event()
         # serving (answering block/status requests) continues for the
         # node's lifetime; CONSUMING (requesting + applying) stops when
@@ -203,10 +270,13 @@ class BlockSyncReactor:
 
     def stop(self) -> None:
         self._stopped.set()
+        self._pool.signal()  # unblock waiting loops
+        self._engine.close()
 
     def stop_consuming(self) -> None:
         """Stop requesting/applying blocks; keep serving peers."""
         self._consuming.clear()
+        self._pool.signal()
 
     def reset_to_state(self, state) -> None:
         """Re-point the pool after statesync restored a later state —
@@ -214,25 +284,37 @@ class BlockSyncReactor:
         against an app that is already at the snapshot height."""
         self._state = state
         self._pool = BlockPool(state.last_block_height + 1)
+        self._req_wake = self._pool.waker()
+        self._apply_wake = self._pool.waker()
 
     # -- loops ----------------------------------------------------------
 
     def _status_loop(self) -> None:
         while not self._stopped.is_set():
+            self.loop_wakes["status"] += 1
             self._ch.broadcast(_enc(4))  # status_request
             self._ch.broadcast(
                 _enc(5, {1: self._store.height(), 2: self._store.base()})
             )
-            time.sleep(1.0)
+            # event-wait, not sleep: stop() returns immediately
+            self._stopped.wait(1.0)
 
     def _request_loop(self) -> None:
+        """Wake-driven (ISSUE 14, the PR-2/PR-3 busy-poll removal): the
+        pool's wake event fires on new peer ranges, fetched blocks, and
+        height advances — the three things that change next_requests().
+        The timeout only re-arms the _PEER_TIMEOUT re-request scan."""
+        wake = self._req_wake
         while not self._stopped.is_set():
             if not self._consuming.is_set():
-                time.sleep(0.2)
+                wake.wait(timeout=1.0)
+                wake.clear()
                 continue
+            self.loop_wakes["request"] += 1
             for height, peer_id in self._pool.next_requests().items():
                 self._ch.send(peer_id, _enc(1, {1: height}))
-            time.sleep(0.05)
+            wake.wait(timeout=1.0)
+            wake.clear()
 
     def _recv_loop(self) -> None:
         while not self._stopped.is_set():
@@ -271,6 +353,12 @@ class BlockSyncReactor:
                 to_signed64(field_int(resp, 1)),
             )
 
+    # minimum fetched run (blocks) before the range engine takes over
+    # from the depth-1 speculative path — near the tip the classic path
+    # wins (it overlaps ONE verify with the ABCI apply; a 2-3 block
+    # "range" would just add planning overhead)
+    _REPLAY_MIN_BLOCKS = 4
+
     def _apply_loop(self) -> None:
         """reactor.go:500-560: verify first with second's LastCommit, apply.
 
@@ -279,13 +367,22 @@ class BlockSyncReactor:
         in flight on the device via the shared AsyncBatchVerifier —
         speculation is keyed on the validator-set hash and discarded if
         the applied block changed the validators (SURVEY.md §7 hard-part
-        4; the device analog of pool.go:127's fetch/verify overlap)."""
+        4; the device analog of pool.go:127's fetch/verify overlap).
+
+        Range mode (ISSUE 14): when the pool holds a run of ≥
+        _REPLAY_MIN_BLOCKS consecutive fetched blocks — a node deep in
+        catch-up — whole epoch ranges go through the ReplayEngine
+        instead: one mesh superbatch per ~bucket of signatures at
+        PRIORITY_REPLAY, store writes pipelined behind verification."""
         caught_up_reported = False
         spec = None  # (height, valset_hash, future) of a pre-verification
+        wake = self._apply_wake
         while not self._stopped.is_set():
             if not self._consuming.is_set():
-                time.sleep(0.2)
+                wake.wait(timeout=1.0)
+                wake.clear()
                 continue
+            self.loop_wakes["apply"] += 1
             first, second = self._pool.peek_two_blocks()
             if first is None or second is None:
                 if (
@@ -295,7 +392,17 @@ class BlockSyncReactor:
                 ):
                     caught_up_reported = True
                     self._on_caught_up(self._state)
-                time.sleep(0.05)
+                wake.wait(timeout=0.5)
+                wake.clear()
+                continue
+            run = self._pool.peek_run(self._engine.window + 1)
+            if len(run) >= self._REPLAY_MIN_BLOCKS:
+                if spec is not None:
+                    # the range engine supersedes any pending depth-1
+                    # speculation; count it as a discard
+                    _metrics().speculation_discards.inc()
+                    spec = None
+                self._replay_run(run)
                 continue
             parts = PartSet.from_data(first.encode(), BLOCK_PART_SIZE_BYTES)
             first_id = BlockID(hash=first.hash(), part_set_header=parts.header())
@@ -324,6 +431,48 @@ class BlockSyncReactor:
             self._state = self._block_exec.apply_block(self._state, first_id, first)
             self._pool.pop_first()
 
+    def _replay_run(self, run) -> None:
+        """Hand a consecutive fetched run to the ReplayEngine: range
+        verification through the dispatcher, store writes on the writer
+        thread, applies inline on this thread. The engine stops at epoch
+        cuts / window edges; the loop simply re-peeks and continues."""
+        eng = self._engine
+        before = (eng.ranges, eng.fallback_ranges)
+
+        def _apply(block_id, block):
+            self._state = self._block_exec.apply_block(
+                self._state, block_id, block
+            )
+            return self._state
+
+        def _applied(_height: int) -> None:
+            self._pool.pop_first()
+
+        state, out = eng.replay_blocks(
+            self._state,
+            run,
+            save=self._store.save_block,
+            apply=_apply,
+            applied=_applied,
+            should_stop=lambda: (
+                self._stopped.is_set() or not self._consuming.is_set()
+            ),
+        )
+        self._state = state
+        m = _metrics()
+        if out.range_heights:
+            m.replay_heights.inc(out.range_heights)
+        if out.sequential_heights:
+            m.replay_fallback_heights.inc(out.sequential_heights)
+        if eng.ranges > before[0]:
+            m.replay_ranges.inc(eng.ranges - before[0])
+        if eng.fallback_ranges > before[1]:
+            m.replay_fallback_ranges.inc(eng.fallback_ranges - before[1])
+        if out.failed_height is not None:
+            # identical to the sequential path's rejection: drop the bad
+            # block (and its successor carrying the commit) and re-request
+            self._pool.redo_request(out.failed_height)
+
     def _speculate_next(self, applied_height: int):
         """Pre-submit verification of the next pending block's commit,
         assuming the validator set does not change at applied_height."""
@@ -348,23 +497,39 @@ class BlockSyncReactor:
 
     def _take_speculation(self, spec, first, first_id, second):
         """Return True/False if the speculation covers (first, second) with
-        the current validator set, else None (caller verifies sync)."""
+        the current validator set, else None (caller verifies sync).
+
+        Metric semantics (ISSUE 14): a HIT is a usable device verdict
+        (either way — a confirmed-bad commit is still a useful answer); a
+        DISCARD is a speculation invalidated before use (height/valset/
+        hash mismatch, dispatch error, device timeout); a MISS is having
+        no speculation at all when one was needed."""
+        m = _metrics()
         if spec is None:
+            m.speculation_misses.inc()
             return None
         height, spec_vals, valhash, fhash, shash, fut = spec
         cur_vals = self._state.validators
         if height != first.header.height:
+            m.speculation_discards.inc()
             return None
         # identity first: the common no-valset-change case skips a full
         # Merkle rehash of the set on every applied block
         if spec_vals is not cur_vals and valhash != cur_vals.hash():
+            m.speculation_discards.inc()
             return None
         if fhash != first_id.hash or shash != second.hash():
+            m.speculation_discards.inc()
             return None
         try:
             valid = fut.result(timeout=300)
-        except Exception:  # noqa: BLE001
+        except (_pipeline_error(), FutureTimeoutError):
+            # device trouble is recoverable — fall back to the sync
+            # verify. Anything else (a bug, not an outcome) propagates:
+            # silently re-verifying would mask it forever.
+            m.speculation_discards.inc()
             return None
+        m.speculation_hits.inc()
         if not bool(valid.all()):
             return False
         # structural checks the speculative path skipped

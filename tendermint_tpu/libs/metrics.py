@@ -315,6 +315,47 @@ class MempoolMetrics:
         )
 
 
+class BlockSyncMetrics:
+    """Blocksync catch-up metric set (ISSUE 14): speculation-cache
+    accounting for the depth-1 pipelined path plus range-replay counters
+    for the ReplayEngine. Pushed from blocksync; surfaced in /status."""
+
+    def __init__(self, registry: Registry):
+        self.speculation_hits = registry.counter(
+            "blocksync", "speculation_hits",
+            "Pre-verified next-height speculations whose device verdict "
+            "was usable (height/valset/block hashes all matched).",
+        )
+        self.speculation_misses = registry.counter(
+            "blocksync", "speculation_misses",
+            "Heights applied with no speculation available (cold start, "
+            "fetch gap, or below the device threshold).",
+        )
+        self.speculation_discards = registry.counter(
+            "blocksync", "speculation_discards",
+            "Speculations invalidated before use: height/valset/hash "
+            "mismatch, dispatch error, or device timeout.",
+        )
+        self.replay_ranges = registry.counter(
+            "blocksync", "replay_ranges",
+            "Epoch ranges verified through the range-batched replay engine.",
+        )
+        self.replay_heights = registry.counter(
+            "blocksync", "replay_heights",
+            "Heights whose commit was verified as part of a replay range.",
+        )
+        self.replay_fallback_heights = registry.counter(
+            "blocksync", "replay_fallback_heights",
+            "Heights verified per-height (sequential fallback or "
+            "sub-threshold range) during replay catch-up.",
+        )
+        self.replay_fallback_ranges = registry.counter(
+            "blocksync", "replay_fallback_ranges",
+            "Replay ranges that fell back to sequential verification "
+            "(bad commit, prepare failure, or dispatch trouble).",
+        )
+
+
 class P2PMetrics:
     """p2p/metrics.go — the router metric set. peers is sampled by a
     registry collect hook at scrape time."""
@@ -484,6 +525,40 @@ def mempool_metrics() -> "MempoolMetrics":
         if _global_mempool is None:
             _global_mempool = MempoolMetrics(global_registry())
         return _global_mempool
+
+
+_global_blocksync: Optional["BlockSyncMetrics"] = None
+
+
+def blocksync_metrics() -> "BlockSyncMetrics":
+    """Process-wide BlockSyncMetrics — same sharing rationale as
+    mempool_metrics(): the catch-up engine rides the shared device
+    pipeline, so its counters live on the process registry."""
+    global _global_blocksync
+    with _global_mtx:
+        if _global_blocksync is None:
+            _global_blocksync = BlockSyncMetrics(global_registry())
+        return _global_blocksync
+
+
+def blocksync_stats() -> dict:
+    """Blocksync catch-up snapshot for /status — cheap counter reads."""
+    m = blocksync_metrics()
+    hits = int(m.speculation_hits.total())
+    misses = int(m.speculation_misses.total())
+    discards = int(m.speculation_discards.total())
+    rng = int(m.replay_heights.total())
+    seq = int(m.replay_fallback_heights.total())
+    return {
+        "speculation_hits": hits,
+        "speculation_misses": misses,
+        "speculation_discards": discards,
+        "replay_ranges": int(m.replay_ranges.total()),
+        "replay_fallback_ranges": int(m.replay_fallback_ranges.total()),
+        "replay_heights": rng,
+        "replay_fallback_heights": seq,
+        "replay_hit_rate": (rng / (rng + seq)) if (rng + seq) else 0.0,
+    }
 
 
 def ops_stats() -> dict:

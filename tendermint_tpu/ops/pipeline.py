@@ -110,13 +110,17 @@ def _alias_view(arr: np.ndarray) -> np.ndarray:
     return buf[:]  # non-owning view of the shared scratch
 
 
-# QoS priority classes (ISSUE 13): the dispatcher is multi-tenant now —
-# consensus commit batches share it with mempool CheckTx superbatches.
-# Lower value = more urgent. Two classes only: a pending CONSENSUS batch
-# overtakes every queued INGRESS superbatch (never an in-flight launch),
-# so a tx flood cannot push commit verification to the back of the line.
+# QoS priority classes (ISSUE 13/14): the dispatcher is multi-tenant —
+# consensus commit batches share it with blocksync replay ranges and
+# mempool CheckTx superbatches. Lower value = more urgent. A pending
+# CONSENSUS batch overtakes every queued REPLAY range and INGRESS
+# superbatch (never an in-flight launch), so neither a rejoining node's
+# catch-up flood nor a tx flood can push commit verification to the back
+# of the line. REPLAY sits above INGRESS: catch-up is a node-liveness
+# workload, user-tx ingress is best-effort.
 PRIORITY_CONSENSUS = 0
-PRIORITY_INGRESS = 1
+PRIORITY_REPLAY = 1
+PRIORITY_INGRESS = 2
 
 
 class _PriorityQueue:
@@ -643,6 +647,10 @@ class AsyncBatchVerifier:
         # head-of-line latency for the consensus class even with every
         # queue priority-ordered. Consensus rounds keep the full bucket.
         ing_cap = int(os.environ.get("TM_TPU_INGRESS_FUSE", "1024"))
+        # REPLAY fuses to the full bucket by default (ISSUE 14): range
+        # batching IS the catch-up win, and the preemption points below
+        # bound the head-of-line cost for consensus either way.
+        rep_cap = int(os.environ.get("TM_TPU_REPLAY_FUSE", str(max_b)))
         m = _backend._ops_m()
         try:
             while True:
@@ -671,10 +679,12 @@ class AsyncBatchVerifier:
                 # larger batches are strictly faster
                 busy = self._inflight > 0 or self._dispatch_q.qsize() > 0
                 deadline = time.monotonic() + 0.008 if busy else 0.0
-                limit = (
-                    max_b if job.priority <= PRIORITY_CONSENSUS
-                    else min(max_b, ing_cap)
-                )
+                if job.priority <= PRIORITY_CONSENSUS:
+                    limit = max_b
+                elif job.priority <= PRIORITY_REPLAY:
+                    limit = min(max_b, rep_cap)
+                else:
+                    limit = min(max_b, ing_cap)
                 while total < limit:
                     try:
                         nxt = self._q.get_nowait()

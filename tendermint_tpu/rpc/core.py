@@ -173,6 +173,9 @@ class Environment:
             # ISSUE 13: device-batched CheckTx back-pressure — queue depth,
             # window wait, preemptions. Same cheap-counters-only discipline.
             "mempool_ingress": self._mempool_ingress_stats(),
+            # ISSUE 14: catch-up replay — speculation hit/miss/discard and
+            # range-batched replay counters. Same cheap-counters-only rule.
+            "blocksync": self._blocksync_stats(),
         }
 
     def _mempool_ingress_stats(self) -> dict:
@@ -185,6 +188,15 @@ class Environment:
             return ingress_stats()
         except Exception as e:  # noqa: BLE001 — /status must not 500
             return {"enabled": False, "error": str(e)}
+
+    @staticmethod
+    def _blocksync_stats() -> dict:
+        try:
+            from ..libs.metrics import blocksync_stats
+
+            return blocksync_stats()
+        except Exception as e:  # noqa: BLE001 — /status must not 500
+            return {"error": str(e)}
 
     @staticmethod
     def _verify_engine_stats() -> dict:

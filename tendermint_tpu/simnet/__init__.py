@@ -22,6 +22,7 @@ from .faults import (  # noqa: F401
     rotation_schedule,
     smoke_schedule,
 )
+from .catchup import CatchupDriver  # noqa: F401
 from .harness import Cluster, SimNode, SimReport  # noqa: F401
 from .search import SearchResult, search_schedules, shrink_schedule  # noqa: F401
 from .transport import LinkConfig, SimNetwork, SimRouter  # noqa: F401

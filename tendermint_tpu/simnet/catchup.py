@@ -1,0 +1,211 @@
+"""Crash-rejoin catch-up through the ReplayEngine (ISSUE 14).
+
+The scenario ROADMAP item 3 names: a node that crashed early rejoins a
+mature network hundreds or thousands of heights behind and must replay
+the gap. `CatchupDriver` runs that replay LIVE inside the simulation —
+consensus keeps committing on the virtual clock while the driver chases
+the tip — through the same `blocksync.replay.ReplayEngine` the real
+blocksync reactor uses: epoch-cut range packing, device superbatches at
+`PRIORITY_REPLAY`, per-height sequential fallback.
+
+The crashed node stays crashed for consensus (no votes, no gossip, its
+links stay down); the driver rebuilds only the STORAGE half of
+`SimNode.build` (state store, block store, block executor — no
+ConsensusState) and advances it. Each scheduled step issues one
+range-fetch "request" against a live peer's block store; with
+probability `drop` the response is lost (the lossy-link model applied
+to the blocksync request path) and the same range is simply re-requested
+next step. Within `rejoin_gap` of the tip the driver restarts the node,
+which rebuilds from the now-advanced stores and rejoins consensus.
+
+Determinism contract (simnet-determinism lint applies here): every step
+rides `SimClock.call_later`, randomness comes from a `random.Random`
+seeded from the cluster seed, and the engine runs with the synchronous
+writer — same seed ⇒ byte-identical catch-up trajectory, fingerprint
+and `summary()` dict.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+
+class CatchupDriver:
+    """Catch one crashed SimNode up to the live tip, then rejoin it.
+
+    Construct AFTER the cluster (registers itself on
+    `cluster.catchup_drivers`, which `run_to_height` folds into
+    `SimReport.catchup`); the first step fires `start_after` virtual
+    seconds into the run, so schedule it past the crash fault.
+    """
+
+    def __init__(self, cluster, node_idx: int, *, window: Optional[int] = None,
+                 drop: float = 0.0, interval: float = 0.05,
+                 rejoin_gap: int = 2, start_after: float = 1.0,
+                 start_at_height: Optional[int] = None):
+        from ..blocksync.replay import ReplayEngine
+
+        self.cluster = cluster
+        self.node = cluster.nodes[node_idx]
+        self.rng = random.Random(cluster.seed * 1_000_003 + node_idx + 0xCA7)
+        self.engine = ReplayEngine(window=window, synchronous=True)
+        self.drop = float(drop)
+        self.interval = float(interval)
+        self.rejoin_gap = int(rejoin_gap)
+        # hold the first fetch until the live tip reaches this height —
+        # how the "rejoins N heights behind" scenario builds its gap
+        # (the node crashes early; replay begins once the gap exists)
+        self.start_at_height = start_at_height
+        self.behind_at_start: Optional[int] = None
+        self.steps = 0
+        self.fetches = 0          # blocks actually read from a peer store
+        self.dropped_requests = 0  # range requests lost to the link model
+        self.start_height: Optional[int] = None
+        self.rejoined_at: Optional[float] = None
+        self.failed: List[tuple] = []  # (height, error) per failed range
+        self.done = False
+        self._stats: Optional[dict] = None
+        self._state = None
+        self._bstore = None
+        self._ex = None
+        cluster.catchup_drivers.append(self)
+        cluster.clock.call_later(start_after, self._step)
+
+    # -- storage-only runtime (SimNode.build minus consensus) ------------
+
+    def _ensure_runtime(self) -> bool:
+        if self._state is not None:
+            return True
+        node = self.node
+        if not node.crashed:
+            return False  # not crashed (yet): nothing to catch up
+        from ..abci import LocalClient
+        from ..abci.kvstore import PersistentKVStoreApplication
+        from ..state import make_genesis_state
+        from ..state.execution import BlockExecutor
+        from ..state.store import StateStore
+        from ..store import BlockStore
+
+        app = PersistentKVStoreApplication(db=node.app_db)
+        sstore = StateStore(node.state_db)
+        state = sstore.load()
+        if state is None:  # crashed before the first state save
+            state = make_genesis_state(self.cluster.genesis_doc)
+        self._state = state
+        self._bstore = BlockStore(node.block_db)
+        self._ex = BlockExecutor(sstore, LocalClient(app),
+                                 block_store=self._bstore)
+        self.start_height = state.last_block_height
+        return True
+
+    def _save(self, block, parts, seen_commit) -> None:
+        # a crash between save and apply leaves the store one block ahead
+        # of state; re-saving that height on resume would double-write
+        if block.header.height > self._bstore.height():
+            self._bstore.save_block(block, parts, seen_commit)
+
+    def _apply(self, block_id, block):
+        self._state = self._ex.apply_block(self._state, block_id, block)
+        return self._state
+
+    # -- the fetch/replay loop -------------------------------------------
+
+    def _live_tip(self):
+        best = None
+        for n in self.cluster.nodes:
+            if n is self.node or n.crashed or n.bstore is None:
+                continue
+            if best is None or n.height() > best.height():
+                best = n
+        return best
+
+    def _fetch_run(self, peer, h0: int) -> list:
+        """One blocksync range request: up to window+1 consecutive blocks
+        from `peer`'s store starting at h0. Lost with probability `drop`
+        (whole response — one request per range), retried next step."""
+        if self.rng.random() < self.drop:
+            self.dropped_requests += 1
+            return []
+        run = []
+        top = peer.height()
+        for h in range(h0, min(h0 + self.engine.window + 1, top + 1)):
+            block = peer.bstore.load_block(h)
+            if block is None:
+                break
+            run.append(block)
+            self.fetches += 1
+        return run
+
+    def _step(self) -> None:
+        c = self.cluster
+        if self.done or c._stopped:
+            return
+        self.steps += 1
+        if not self.node.crashed and self._state is None:
+            # never crashed / externally restarted: nothing to drive
+            c.clock.call_later(self.interval, self._step)
+            return
+        if self._ensure_runtime():
+            peer = self._live_tip()
+            if peer is not None and self.behind_at_start is None:
+                if (self.start_at_height is not None
+                        and peer.height() < self.start_at_height):
+                    # gap still building: check back on a coarse cadence
+                    c.clock.call_later(max(self.interval, 1.0), self._step)
+                    return
+                self.behind_at_start = (
+                    peer.height() - self._state.last_block_height
+                )
+            if peer is not None:
+                mine = self._state.last_block_height
+                if (peer.height() - mine <= self.rejoin_gap
+                        and mine > (self.start_height or 0)):
+                    self._rejoin(mine)
+                    return
+                run = self._fetch_run(peer, mine + 1)
+                if len(run) >= 2:
+                    self._state, out = self.engine.replay_blocks(
+                        self._state, run, self._save, self._apply,
+                        should_stop=lambda: c._stopped,
+                    )
+                    if out.failed_height is not None:
+                        self.failed.append((out.failed_height, out.error))
+        c.clock.call_later(self.interval, self._step)
+
+    def _rejoin(self, height: int) -> None:
+        c = self.cluster
+        self._stats = dict(self.engine.stats())
+        self.engine.close()
+        self._state = self._bstore = self._ex = None
+        self.rejoined_at = c.clock.time()
+        self.done = True
+        c.faults_applied.append(
+            f"t={self.rejoined_at:.2f} catchup rejoin node "
+            f"{self.node.idx} at h{height}"
+        )
+        self.node.restart()
+
+    # -- reporting --------------------------------------------------------
+
+    def summary(self) -> dict:
+        s = self._stats if self._stats is not None else self.engine.stats()
+        return {
+            "node": self.node.idx,
+            "start_height": self.start_height,
+            "behind_at_start": self.behind_at_start,
+            "heights_applied": s["heights_applied"],
+            "ranges": s["ranges"],
+            "range_heights": s["range_heights"],
+            "sequential_heights": s["sequential_heights"],
+            "fallback_ranges": s["fallback_ranges"],
+            "sigs_submitted": s["sigs_submitted"],
+            "hit_rate": round(s["hit_rate"], 4),
+            "window": s["window"],
+            "steps": self.steps,
+            "fetches": self.fetches,
+            "dropped_requests": self.dropped_requests,
+            "rejoined": self.rejoined_at is not None,
+            "rejoined_at_virtual_s": self.rejoined_at,
+            "failed": list(self.failed),
+        }

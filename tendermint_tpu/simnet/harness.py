@@ -86,6 +86,10 @@ class SimReport:
     # "invariant broke at h=37" arrives with its own evidence attached
     height_timelines: List[dict] = _field(default_factory=list)
     flight_recorder: Optional[dict] = None
+    # chain-replay catch-up (ISSUE 14): one summary dict per registered
+    # CatchupDriver — replayed-range hit rate, fetch/drop counts and the
+    # rejoin point, all virtual-clock-derived (deterministic)
+    catchup: Optional[List[dict]] = None
     # the run ended because the REAL-time budget expired, not because the
     # virtual deadline passed or an invariant broke — machine-speed
     # dependent, so schedule search treats such a run as INCONCLUSIVE
@@ -379,6 +383,9 @@ class Cluster:
         # explicit restart fault) — run_to_height waits for these, while a
         # crash-stop node is simply excluded from the liveness target
         self._pending_restarts: set = set()
+        # CatchupDrivers (simnet/catchup.py) register here; run_to_height
+        # folds their summaries into SimReport.catchup
+        self.catchup_drivers: List = []
 
         # cluster tracing (ISSUE 10): None follows the process tracer's
         # enabled flag at start() time (tools/simnet_run.py --trace turns
@@ -958,6 +965,10 @@ class Cluster:
             epoch_cache=self.epoch_cache_delta(),
             wall_budget_hit=wall_hit,
             height_timelines=self.height_timelines(),
+            catchup=(
+                [d.summary() for d in self.catchup_drivers]
+                if self.catchup_drivers else None
+            ),
             # the flight recorder rides ONLY on invariant failures — a
             # green run keeps the report lean
             flight_recorder=(

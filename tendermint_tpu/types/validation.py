@@ -195,6 +195,35 @@ def prepare_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID,
     )
 
 
+def prepare_commit_range(chain_id: str, vals: ValidatorSet, items):
+    """Range form of the prepare seam (ISSUE 14): `items` is an ordered
+    iterable of (height, block_id, commit) all claimed to be signed by
+    the SAME validator set `vals` (the caller cut the range at every
+    valset-changing height). Returns (prepared, synced):
+
+      prepared  [(height, entries, conclude)] — device work per height,
+                in range order; each conclude reproduces the sequential
+                path's exact blame error for its height
+      synced    [height] — heights that rode the sub-threshold
+                single-signature path and are ALREADY fully verified
+
+    Host-side failures raise exactly what verify_commit_light raises for
+    the offending height (PrepareUnsupported included) — the caller is
+    expected to fall back to per-height sequential verification for the
+    range, which reproduces the same error byte-for-byte."""
+    prepared = []
+    synced = []
+    for height, block_id, commit in items:
+        entries, conclude = prepare_commit_light(
+            chain_id, vals, block_id, height, commit
+        )
+        if entries is None:
+            synced.append(height)
+        else:
+            prepared.append((height, entries, conclude))
+    return prepared, synced
+
+
 def prepare_commit_light_trusting(chain_id: str, vals: ValidatorSet,
                                   commit: Commit, trust_level: Fraction):
     """verify_commit_light_trusting's host half (ISSUE 11 seam): nil and

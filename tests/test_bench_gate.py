@@ -51,7 +51,9 @@ def test_pins_file_is_wellformed():
         assert art["kind"] == kind
 
 
-@pytest.mark.parametrize("kind", ["bench", "multichip", "light", "mempool"])
+@pytest.mark.parametrize(
+    "kind", ["bench", "multichip", "light", "mempool", "blocksync"]
+)
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.
     While the pin IS the newest round this is a self-compare (trivially

@@ -1,0 +1,549 @@
+"""Chain-replay catch-up (ISSUE 14): the range-batched ReplayEngine and
+the blocksync speculation/wake-event satellites.
+
+Covers: epoch-cut planning off header validators_hash, range verification
++ apply over a real hand-signed chain (device path through the shared
+pipeline), mid-range forged-commit fallback with error-string parity vs
+sequential verify_commit_light, valset rotation across ranges, the
+writer-thread save pipeline, speculation-invalidation edges (valset
+change at the speculated height, redo_request racing a pending future,
+narrow DispatchError/TimeoutError handling with hit/miss/discard
+metrics), and the no-hot-spin guard for the wake-event loops.
+
+Needs a working ed25519 signer: with the `cryptography` wheel the module
+runs directly; without it, tests/test_replay_isolated.py re-runs it in a
+subprocess under TM_TPU_PUREPY_CRYPTO=1.
+"""
+
+import importlib.util
+import os
+import queue
+import sys
+import threading
+import time
+
+import pytest
+
+if importlib.util.find_spec("cryptography") is None and not os.environ.get(
+    "TM_TPU_PUREPY_CRYPTO"
+):
+    pytest.skip(
+        "needs an ed25519 signer (cryptography wheel or the isolated runner)",
+        allow_module_level=True,
+    )
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tendermint_tpu.blocksync import (  # noqa: E402
+    BlockPool,
+    BlockSyncReactor,
+)
+from tendermint_tpu.blocksync.replay import (  # noqa: E402
+    ReplayEngine,
+    plan_epoch_range,
+)
+from tendermint_tpu.crypto import ed25519  # noqa: E402
+from tendermint_tpu.libs import metrics as _metrics_mod  # noqa: E402
+from tendermint_tpu.types import Validator, ValidatorSet  # noqa: E402
+from tendermint_tpu.types.block import (  # noqa: E402
+    Block,
+    BlockID,
+    Data,
+    Header,
+    PartSetHeader,
+    Version,
+)
+from tendermint_tpu.types.part_set import (  # noqa: E402
+    BLOCK_PART_SIZE_BYTES,
+    PartSet,
+)
+from tendermint_tpu.types.validation import verify_commit_light  # noqa: E402
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, Vote  # noqa: E402
+from tendermint_tpu.types.vote_set import VoteSet  # noqa: E402
+from tendermint_tpu.wire.canonical import Timestamp  # noqa: E402
+
+CHAIN_ID = "replay-chain"
+
+
+def _make_vals(n, seed):
+    pairs = []
+    for i in range(n):
+        sk = ed25519.gen_priv_key(bytes([seed + i]) * 32)
+        pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+    vset = ValidatorSet.new([v for _, v in pairs])
+    by_addr = {v.address: sk for sk, v in pairs}
+    return [by_addr[v.address] for v in vset.validators], vset
+
+
+def _sign_vote(sk, vset, height, block_id):
+    addr = sk.pub_key().address()
+    idx, _ = vset.get_by_address(addr)
+    vote = Vote(
+        type=PRECOMMIT_TYPE,
+        height=height,
+        round=0,
+        block_id=block_id,
+        timestamp=Timestamp(seconds=1_600_000_000, nanos=0),
+        validator_address=addr,
+        validator_index=idx,
+    )
+    sig = sk.sign(vote.sign_bytes(CHAIN_ID))
+    return Vote(**{**vote.__dict__, "signature": sig})
+
+
+def _make_chain(n_blocks, n_vals=4, rotate_at=()):
+    """Full blocks 1..n_blocks with real commit linkage: block h+1's
+    last_commit signs block h's BlockID (hash + part-set header of the
+    encoded block). `rotate_at` heights switch to a fresh validator set
+    from that height onward. Returns (blocks, vals_at, keys_at)."""
+    rotate_at = sorted(rotate_at)
+    vals_at, keys_at = {}, {}
+    seed, cur = 1, _make_vals(n_vals, 1)
+    for h in range(1, n_blocks + 2):
+        if h in rotate_at:
+            seed += n_vals
+            cur = _make_vals(n_vals, seed)
+        keys_at[h], vals_at[h] = cur
+    blocks = []
+    last_commit = None
+    prev_bid = BlockID()
+    for h in range(1, n_blocks + 1):
+        hdr = Header(
+            version=Version(block=11, app=0),
+            chain_id=CHAIN_ID,
+            height=h,
+            time=Timestamp(seconds=1_600_000_000 + h),
+            last_block_id=prev_bid,
+            validators_hash=vals_at[h].hash(),
+            next_validators_hash=vals_at[h + 1].hash(),
+            consensus_hash=b"\x01" * 32,
+            app_hash=b"",
+            proposer_address=vals_at[h].validators[0].address,
+        )
+        block = Block(header=hdr, data=Data(), last_commit=last_commit)
+        block.fill_header()
+        parts = PartSet.from_data(block.encode(), BLOCK_PART_SIZE_BYTES)
+        bid = BlockID(hash=block.hash(), part_set_header=parts.header())
+        vs = VoteSet(CHAIN_ID, h, 0, PRECOMMIT_TYPE, vals_at[h])
+        for sk in keys_at[h]:
+            vs.add_vote(_sign_vote(sk, vals_at[h], h, bid))
+        last_commit = vs.make_commit()
+        prev_bid = bid
+        blocks.append(block)
+    return blocks, vals_at, keys_at
+
+
+class _State:
+    def __init__(self, validators, height):
+        self.chain_id = CHAIN_ID
+        self.validators = validators
+        self.last_block_height = height
+
+
+def _run_engine(blocks, vals_at, engine=None, start=0):
+    """Drive an engine over the whole chain like the reactor would:
+    peek-run, replay, repeat. Returns (state, saves, outcomes)."""
+    eng = engine or ReplayEngine(synchronous=True)
+    st = _State(vals_at[blocks[start].header.height], blocks[start].header.height - 1)
+    saves = []
+
+    def _save(block, parts, seen_commit):
+        saves.append((block.header.height, seen_commit.height))
+
+    def _apply(bid, block):
+        h = block.header.height
+        st.last_block_height = h
+        st.validators = vals_at[h + 1]
+        return st
+
+    outcomes = []
+    i = start
+    while i < len(blocks) - 1:
+        st2, out = eng.replay_blocks(st, blocks[i:], _save, _apply)
+        outcomes.append(out)
+        if out.applied == 0:
+            break
+        i += out.applied
+    eng.close()
+    return st, saves, outcomes
+
+
+# -- epoch-cut planner ----------------------------------------------------
+
+
+class TestEpochPlanner:
+    def test_cut_at_rotation(self):
+        blocks, _, _ = _make_chain(12, n_vals=2, rotate_at=(6,))
+        # heights 1..5 share block 1's validators_hash; block 6 differs
+        assert plan_epoch_range(blocks, 64) == 5
+        assert plan_epoch_range(blocks[5:], 64) == 6  # 6..11 (12 carries commit)
+
+    def test_window_limit_and_short_runs(self):
+        blocks, _, _ = _make_chain(10, n_vals=2)
+        assert plan_epoch_range(blocks, 4) == 4
+        assert plan_epoch_range(blocks[:2], 64) == 1
+        assert plan_epoch_range(blocks[:1], 64) == 0
+        assert plan_epoch_range([], 64) == 0
+
+
+# -- the range engine over a real signed chain ----------------------------
+
+
+class TestReplayEngine:
+    def test_replays_whole_chain_device_path(self):
+        # prepare_commit_light stops at 2/3-of-power, so 8 vals give ~6
+        # entries per height: 19 heights × 6 = 114 sigs ≥ DEVICE_THRESHOLD
+        # — the range goes through the shared pipeline as superbatches
+        blocks, vals_at, _ = _make_chain(20, n_vals=8)
+        eng = ReplayEngine(synchronous=True)
+        st, saves, outs = _run_engine(blocks, vals_at, engine=eng)
+        assert st.last_block_height == 19
+        assert [h for h, _ in saves] == list(range(1, 20))
+        # every save carried the NEXT block's commit as seen-commit
+        assert all(seen == h for h, seen in saves)
+        assert sum(o.applied for o in outs) == 19
+        assert eng.range_heights == 19
+        assert eng.sequential_heights == 0
+        assert eng.sigs_submitted >= 64
+
+    def test_sub_threshold_range_stays_on_host(self):
+        blocks, vals_at, _ = _make_chain(6, n_vals=2)  # 10 sigs < 64
+        eng = ReplayEngine(synchronous=True)
+        st, _, _ = _run_engine(blocks, vals_at, engine=eng)
+        assert st.last_block_height == 5
+        assert eng.range_heights == 0
+        assert eng.sequential_heights == 5
+
+    def test_rotation_chain_cuts_and_crosses_epochs(self):
+        blocks, vals_at, _ = _make_chain(24, n_vals=4, rotate_at=(9, 17))
+        eng = ReplayEngine(synchronous=True)
+        st, saves, outs = _run_engine(blocks, vals_at, engine=eng)
+        assert st.last_block_height == 23
+        assert [h for h, _ in saves] == list(range(1, 24))
+        # three epochs → at least three replay_blocks rounds
+        assert len([o for o in outs if o.applied]) >= 3
+
+    def test_forged_commit_mid_range_error_parity(self):
+        # 23 verifiable heights × 4 sigs = 92 ≥ DEVICE_THRESHOLD: the
+        # range really goes to the device, fails there, and falls back
+        blocks, vals_at, _ = _make_chain(24, n_vals=4)
+        bad_h = 12
+        # forge one signature in the commit that vouches for height 8
+        commit = blocks[bad_h].last_commit  # block 9 carries h=8's commit
+        sig = commit.signatures[0]
+        forged = sig.__class__(
+            block_id_flag=sig.block_id_flag,
+            validator_address=sig.validator_address,
+            timestamp=sig.timestamp,
+            signature=bytes(64),
+        )
+        commit.signatures[0] = forged
+        eng = ReplayEngine(synchronous=True)
+        st, saves, outs = _run_engine(blocks, vals_at, engine=eng)
+        # heights before the forgery applied; the bad one rejected
+        assert st.last_block_height == bad_h - 1
+        bad = [o for o in outs if o.failed_height is not None]
+        assert bad and bad[-1].failed_height == bad_h
+        # error string byte-identical to the sequential path's
+        p = PartSet.from_data(blocks[bad_h - 1].encode(), BLOCK_PART_SIZE_BYTES)
+        bid = BlockID(hash=blocks[bad_h - 1].hash(), part_set_header=p.header())
+        with pytest.raises((ValueError, RuntimeError)) as ei:
+            verify_commit_light(
+                CHAIN_ID, vals_at[bad_h], bid, bad_h,
+                blocks[bad_h].last_commit,
+            )
+        assert bad[-1].error == str(ei.value)
+
+    def test_flight_recorder_flow_chain(self):
+        # satellite 6: one flow id rides a range end to end —
+        # blocksync.fetch (s) → replay.range_pack (t, heights attached)
+        # → pipeline.submit/dispatch → replay.apply (f)
+        from tendermint_tpu.observability import trace as tr
+
+        blocks, vals_at, _ = _make_chain(20, n_vals=8)
+        tr.configure(enabled=True)
+        try:
+            eng = ReplayEngine(synchronous=True)
+            _run_engine(blocks, vals_at, engine=eng)
+            doc = tr.TRACER.export_chrome()
+        finally:
+            tr.configure(enabled=False)
+        chains = [
+            [e["name"] for e in evs]
+            for evs in tr.flow_chains(doc).values()
+            if evs[0]["name"] == "blocksync.fetch"
+        ]
+        assert chains, "no replay flow chains recorded"
+        full = [
+            names for names in chains
+            if "replay.range_pack" in names
+            and "pipeline.submit" in names
+            and names[-1] == "replay.apply"
+        ]
+        assert full, chains
+        packs = [
+            ev for ev in doc["traceEvents"]
+            if ev.get("name") == "replay.range_pack" and ev.get("ph") == "X"
+        ]
+        assert packs and all(
+            ev["args"].get("heights", 0) > 0 for ev in packs
+        ), packs
+
+    def test_writer_thread_orders_saves(self):
+        blocks, vals_at, _ = _make_chain(12, n_vals=4)
+        eng = ReplayEngine()  # asynchronous: saves ride the writer thread
+        heights = []
+        lock = threading.Lock()
+        st = _State(vals_at[1], 0)
+
+        def _save(block, parts, seen_commit):
+            with lock:
+                if heights and block.header.height != heights[-1] + 1:
+                    raise AssertionError("out-of-order save")
+                heights.append(block.header.height)
+
+        def _apply(bid, block):
+            st.last_block_height = block.header.height
+            st.validators = vals_at[block.header.height + 1]
+            return st
+
+        st2, out = eng.replay_blocks(st, blocks, _save, _apply)
+        eng.close()
+        assert out.failed_height is None
+        # replay_blocks drains the writer before returning
+        assert heights == list(range(1, out.applied + 1))
+
+    def test_writer_error_propagates(self):
+        blocks, vals_at, _ = _make_chain(8, n_vals=2)
+        eng = ReplayEngine()
+        st = _State(vals_at[1], 0)
+
+        def _save(block, parts, seen_commit):
+            raise OSError("disk gone")
+
+        def _apply(bid, block):
+            st.last_block_height = block.header.height
+            return st
+
+        with pytest.raises(RuntimeError, match="replay writer failed"):
+            eng.replay_blocks(st, blocks, _save, _apply)
+        eng.close()
+
+    def test_consecutive_heights_enforced(self):
+        blocks, vals_at, _ = _make_chain(5, n_vals=2)
+        eng = ReplayEngine(synchronous=True)
+        st = _State(vals_at[1], 0)
+        with pytest.raises(ValueError, match="consecutive"):
+            eng.replay_blocks(
+                st, [blocks[0], blocks[2]], lambda *a: None, lambda *a: st
+            )
+
+
+# -- reactor satellites: speculation edges + wake events ------------------
+
+
+class _FakeChannel:
+    def broadcast(self, data):
+        pass
+
+    def send(self, peer_id, data):
+        pass
+
+    def receive(self, timeout=None):
+        time.sleep(timeout or 0.1)
+        raise queue.Empty
+
+
+class _FakeRouter:
+    def open_channel(self, desc):
+        return _FakeChannel()
+
+
+class _FakeStore:
+    def height(self):
+        return 0
+
+    def base(self):
+        return 0
+
+    def load_block(self, height):
+        return None
+
+
+def _mk_reactor(vset, height=0):
+    return BlockSyncReactor(
+        _FakeRouter(), block_store=_FakeStore(), block_exec=None,
+        initial_state=_State(vset, height),
+    )
+
+
+class _FakeFuture:
+    def __init__(self, exc=None, value=None):
+        self._exc, self._value = exc, value
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+def _spec_counts():
+    m = _metrics_mod.blocksync_metrics()
+    return (
+        int(m.speculation_hits.total()),
+        int(m.speculation_misses.total()),
+        int(m.speculation_discards.total()),
+    )
+
+
+class TestSpeculationEdges:
+    def _fixture(self):
+        blocks, vals_at, _ = _make_chain(4, n_vals=2)
+        first, second = blocks[1], blocks[2]  # verify height 2
+        parts = PartSet.from_data(first.encode(), BLOCK_PART_SIZE_BYTES)
+        first_id = BlockID(hash=first.hash(), part_set_header=parts.header())
+        return blocks, vals_at, first, first_id, second
+
+    def test_no_spec_counts_miss(self):
+        _, vals_at, first, first_id, second = self._fixture()
+        r = _mk_reactor(vals_at[2], 1)
+        h0, m0, d0 = _spec_counts()
+        assert r._take_speculation(None, first, first_id, second) is None
+        h1, m1, d1 = _spec_counts()
+        assert (h1 - h0, m1 - m0, d1 - d0) == (0, 1, 0)
+
+    def test_valset_change_at_speculated_height_discards(self):
+        # speculation was prepared under the OLD set; the applied block
+        # rotated validators → valhash mismatch → discard, sync verify
+        _, vals_at, first, first_id, second = self._fixture()
+        _, old_vset = _make_vals(2, 99)
+        r = _mk_reactor(vals_at[2], 1)
+        spec = (
+            first.header.height, old_vset, old_vset.hash(),
+            first.hash(), second.hash(), _FakeFuture(value=None),
+        )
+        h0, m0, d0 = _spec_counts()
+        assert r._take_speculation(spec, first, first_id, second) is None
+        h1, m1, d1 = _spec_counts()
+        assert (h1 - h0, d1 - d0) == (0, 1)
+
+    def test_redo_request_racing_pending_future(self):
+        # redo_request(h) dropped + re-fetched the blocks while a spec
+        # future for h was still pending: the re-fetched block hash no
+        # longer matches → the stale verdict must be discarded unused
+        blocks, vals_at, first, first_id, second = self._fixture()
+        r = _mk_reactor(vals_at[2], 1)
+        pool = r.pool
+        pool.set_peer_range("p1", 1, 4)
+        pool.next_requests()
+        for b in blocks:
+            pool.add_block("p1", b)
+        pool.height = 2
+        spec = (
+            first.header.height, vals_at[2], vals_at[2].hash(),
+            b"\xde" * 32,  # hash of the block the spec was taken against
+            second.hash(), _FakeFuture(value=None),
+        )
+        pool.redo_request(2)
+        a, b2 = pool.peek_two_blocks()
+        assert a is None and b2 is None  # both dropped, will re-fetch
+        h0, m0, d0 = _spec_counts()
+        assert r._take_speculation(spec, first, first_id, second) is None
+        h1, m1, d1 = _spec_counts()
+        assert d1 - d0 == 1
+
+    def test_dispatch_error_and_timeout_discard(self):
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        from tendermint_tpu.ops.pipeline import DispatchError
+
+        _, vals_at, first, first_id, second = self._fixture()
+        r = _mk_reactor(vals_at[2], 1)
+        for exc in (DispatchError("boom", bucket=128), FutTimeout()):
+            spec = (
+                first.header.height, vals_at[2], vals_at[2].hash(),
+                first.hash(), second.hash(), _FakeFuture(exc=exc),
+            )
+            h0, m0, d0 = _spec_counts()
+            assert r._take_speculation(spec, first, first_id, second) is None
+            h1, m1, d1 = _spec_counts()
+            assert d1 - d0 == 1
+
+    def test_unexpected_exception_propagates(self):
+        _, vals_at, first, first_id, second = self._fixture()
+        r = _mk_reactor(vals_at[2], 1)
+        spec = (
+            first.header.height, vals_at[2], vals_at[2].hash(),
+            first.hash(), second.hash(), _FakeFuture(exc=KeyError("bug")),
+        )
+        with pytest.raises(KeyError):
+            r._take_speculation(spec, first, first_id, second)
+
+    def test_usable_verdict_counts_hit(self):
+        import numpy as np
+
+        _, vals_at, first, first_id, second = self._fixture()
+        r = _mk_reactor(vals_at[2], 1)
+        spec = (
+            first.header.height, vals_at[2], vals_at[2].hash(),
+            first.hash(), second.hash(),
+            _FakeFuture(value=np.ones(2, dtype=bool)),
+        )
+        h0, m0, d0 = _spec_counts()
+        assert r._take_speculation(spec, first, first_id, second) is True
+        h1, m1, d1 = _spec_counts()
+        assert h1 - h0 == 1
+
+
+class TestWakeEvents:
+    def test_pool_wakers_fire_on_state_changes(self):
+        pool = BlockPool(1)
+        ev = pool.waker()
+        pool.set_peer_range("p", 1, 5)
+        assert ev.is_set()
+        ev.clear()
+        blocks, _, _ = _make_chain(2, n_vals=2)
+        pool.next_requests()
+        pool.add_block("p", blocks[0])
+        assert ev.is_set()
+        ev.clear()
+        pool.pop_first()
+        assert ev.is_set()
+
+    def test_peek_run_returns_consecutive_prefix(self):
+        blocks, _, _ = _make_chain(6, n_vals=2)
+        pool = BlockPool(1)
+        pool.set_peer_range("p", 1, 6)
+        pool.next_requests()
+        for b in blocks[:2] + blocks[3:]:  # gap at height 3
+            pool.add_block("p", b)
+        run = pool.peek_run(10)
+        assert [b.header.height for b in run] == [1, 2]
+
+    def test_injected_clock_drives_rerequest(self):
+        now = [1000.0]
+        pool = BlockPool(1, clock=lambda: now[0])
+        pool.set_peer_range("p1", 1, 3)
+        pool.set_peer_range("p2", 1, 3)
+        first = pool.next_requests()
+        assert first  # initial requests issued
+        assert pool.next_requests() == {}  # within the peer timeout
+        now[0] += 20.0  # past _PEER_TIMEOUT on the injected clock
+        assert pool.next_requests()  # re-requested without wall time
+
+    def test_loops_do_not_hot_spin_idle(self):
+        # the PR-2/PR-3 guard shape: with nothing to do, the wake-event
+        # loops park on events — an idle half-second must cost a handful
+        # of wakeups, not thousands of poll iterations
+        _, vset = _make_vals(2, 1)
+        r = _mk_reactor(vset, 0)
+        r.start()
+        try:
+            time.sleep(0.6)
+            assert r.loop_wakes["request"] < 20, r.loop_wakes
+            assert r.loop_wakes["apply"] < 20, r.loop_wakes
+            assert r.loop_wakes["status"] < 5, r.loop_wakes
+        finally:
+            r.stop()

@@ -76,12 +76,15 @@ METRIC_KEYS = (
     "mempool_seq_sigs_per_s", "commit_p99_unloaded_ms",
     "commit_p99_flood_ms", "flood_latency_ratio", "checktx_preemptions",
     "ingress_windows", "ingress_batch_wait_ms_avg",
+    # chain-replay artifacts (BLOCKSYNC_r*, ISSUE 14)
+    "replay_seq_heights_per_s", "kernel_serial_heights_per_s",
+    "vs_kernel_serial", "range_hit_rate", "fallback_ranges",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
 _LOWER_IS_BETTER = {
     "relay_rtt_ms", "commit_p99_unloaded_ms", "commit_p99_flood_ms",
-    "flood_latency_ratio",
+    "flood_latency_ratio", "fallback_ranges",
 }
 
 # keys a COMPARE tracks by default (rate-like, present across most rounds)
@@ -89,9 +92,11 @@ COMPARE_KEYS = (
     "value", "sustained_sigs_per_s", "kernel_stream_sigs_per_s",
     "pipelined_headers_per_s", "mixed_curve_sigs_per_s", "relay_rtt_ms",
     "speedup_2v1", "light_unique_headers_per_s", "flood_latency_ratio",
+    "vs_kernel_serial",
 )
 
-_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT|MEMPOOL)_r(\d+)", re.I)
+_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC)_r(\d+)",
+                      re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -206,6 +211,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "LIGHT_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "MEMPOOL_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "BLOCKSYNC_r*.json")))
     return paths
 
 
@@ -222,7 +228,8 @@ def validate(art: dict) -> List[str]:
     if art.get("unreadable"):
         probs.append("; ".join(art["notes"]))
         return probs
-    if art["kind"] not in ("bench", "multichip", "light", "mempool"):
+    if art["kind"] not in ("bench", "multichip", "light", "mempool",
+                           "blocksync"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -951,6 +951,223 @@ def run_ingress(args) -> int:
     return rc
 
 
+def _build_replay_chain(n_blocks: int, n_vals: int, chain_id: str,
+                        rotate_at=()):
+    """Fully-linked signed chain for the replay gate: block h+1's
+    last_commit signs block h's BlockID (hash + part-set header of the
+    encoded block), real keys, optional valset rotation."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.types.block import (
+        Block,
+        BlockID,
+        Data,
+        Header,
+        Version,
+    )
+    from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import PRECOMMIT_TYPE, Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    def mk_vals(seed):
+        pairs = []
+        for i in range(n_vals):
+            sk = ed25519.gen_priv_key(bytes([seed + i]) * 32)
+            pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+        vset = ValidatorSet.new([v for _, v in pairs])
+        by_addr = {v.address: sk for sk, v in pairs}
+        return [by_addr[v.address] for v in vset.validators], vset
+
+    rotate_at = sorted(rotate_at)
+    vals_at, keys_at = {}, {}
+    seed, cur = 1, mk_vals(1)
+    for h in range(1, n_blocks + 2):
+        if h in rotate_at:
+            seed += n_vals
+            cur = mk_vals(seed)
+        keys_at[h], vals_at[h] = cur
+    blocks, last_commit, prev_bid = [], None, BlockID()
+    for h in range(1, n_blocks + 1):
+        hdr = Header(
+            version=Version(block=11, app=0), chain_id=chain_id, height=h,
+            time=Timestamp(seconds=1_600_000_000 + h), last_block_id=prev_bid,
+            validators_hash=vals_at[h].hash(),
+            next_validators_hash=vals_at[h + 1].hash(),
+            consensus_hash=b"\x01" * 32, app_hash=b"",
+            proposer_address=vals_at[h].validators[0].address,
+        )
+        block = Block(header=hdr, data=Data(), last_commit=last_commit)
+        block.fill_header()
+        parts = PartSet.from_data(block.encode(), BLOCK_PART_SIZE_BYTES)
+        bid = BlockID(hash=block.hash(), part_set_header=parts.header())
+        vs = VoteSet(chain_id, h, 0, PRECOMMIT_TYPE, vals_at[h])
+        for sk in keys_at[h]:
+            addr = sk.pub_key().address()
+            idx, _ = vals_at[h].get_by_address(addr)
+            vote = Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=Timestamp(seconds=1_600_000_000, nanos=0),
+                validator_address=addr, validator_index=idx,
+            )
+            sig = sk.sign(vote.sign_bytes(chain_id))
+            vs.add_vote(Vote(**{**vote.__dict__, "signature": sig}))
+        last_commit = vs.make_commit()
+        prev_bid = bid
+        blocks.append(block)
+    return blocks, vals_at
+
+
+def run_replay(args) -> int:
+    """--replay: the round-14 chain-replay gate on a mocked relay (slow
+    readback over REAL kernels — verdicts are live). Asserts the three
+    properties range-batched blocksync must hold:
+
+      pack       a window of W same-epoch heights reaches the device in
+                 ceil(W*sigs/bucket) launches, NOT W — the whole point
+                 of range batching vs the verify-one-ahead path
+      parity     a forged commit mid-range falls back to per-height
+                 sequential verification whose rejection error is
+                 byte-identical to verify_commit_light's, and every
+                 height before the forgery still applies
+      no leak    zero buffer-pool slots remain in flight once drained
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.blocksync.replay import ReplayEngine
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import backend
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.types.block import BlockID
+    from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+    from tendermint_tpu.types.validation import verify_commit_light
+
+    chain_id = "replay-gate"
+    n_blocks, n_vals = 13, 8  # 12 verifiable heights x ~6 light-path sigs
+    resolve_delay = 0.05
+    print(f"prep_bench --replay: blocks={n_blocks} vals={n_vals} "
+          f"resolve_delay={resolve_delay}s")
+    rc = 0
+    blocks, vals_at = _build_replay_chain(n_blocks, n_vals, chain_id)
+
+    class _St:
+        def __init__(self):
+            self.chain_id = chain_id
+            self.validators = vals_at[1]
+            self.last_block_height = 0
+
+    def mk_cbs(st):
+        saves = []
+
+        def save(block, parts, seen_commit):
+            saves.append(block.header.height)
+
+        def apply(bid, block):
+            st.last_block_height = block.header.height
+            st.validators = vals_at[block.header.height + 1]
+            return st
+
+        return saves, save, apply
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay)
+    )
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    v = pl.AsyncBatchVerifier(depth=2, pool_depth=OVERLAP_POOL_DEPTH)
+    try:
+        # -- pack: W same-epoch heights -> ceil(W*sigs/bucket) launches --
+        eng = ReplayEngine(synchronous=True, verifier=v)
+        st = _St()
+        saves, save, apply = mk_cbs(st)
+        st, out = eng.replay_blocks(st, blocks, save, apply)
+        launches = sum(1 for name, *_ in tr.TRACER.events()
+                       if name == "pipeline.dispatch")
+        w = n_blocks - 1
+        sigs = eng.sigs_submitted
+        bucket = backend.quantized_bucket(max(sigs, 1))
+        expect = max(1, -(-sigs // bucket))
+        print(f"  heights replayed           : {out.applied} "
+              f"(range-verified {out.range_heights})")
+        print(f"  sigs submitted             : {sigs} (bucket {bucket})")
+        print(f"  device launches            : {launches} "
+              f"(gate: <= {expect + 1}, sequential would be {w})")
+        if out.applied != w or out.range_heights != w:
+            print(f"  FAIL: expected {w} range-verified heights, got "
+                  f"{out.range_heights}", file=sys.stderr)
+            rc = 1
+        if saves != list(range(1, w + 1)):
+            print("  FAIL: save order broken", file=sys.stderr)
+            rc = 1
+        if launches > expect + 1:
+            print(f"  FAIL: {launches} launches for {w} heights — range "
+                  "packing is not fusing", file=sys.stderr)
+            rc = 1
+
+        # -- parity: forged commit mid-range falls back byte-identically -
+        blocks2, vals2 = _build_replay_chain(n_blocks, n_vals, chain_id)
+        bad_h = 6
+        commit = blocks2[bad_h].last_commit  # block 7 vouches for h=6
+        s0 = commit.signatures[0]
+        commit.signatures[0] = s0.__class__(
+            block_id_flag=s0.block_id_flag,
+            validator_address=s0.validator_address,
+            timestamp=s0.timestamp, signature=bytes(64),
+        )
+        eng2 = ReplayEngine(synchronous=True, verifier=v)
+        st2 = _St()
+        saves2, save2, apply2 = mk_cbs(st2)
+        st2, out2 = eng2.replay_blocks(st2, blocks2, save2, apply2)
+        p = PartSet.from_data(blocks2[bad_h - 1].encode(),
+                              BLOCK_PART_SIZE_BYTES)
+        bid = BlockID(hash=blocks2[bad_h - 1].hash(),
+                      part_set_header=p.header())
+        seq_err = None
+        try:
+            verify_commit_light(chain_id, vals2[bad_h], bid, bad_h,
+                                blocks2[bad_h].last_commit)
+        except (ValueError, RuntimeError) as e:
+            seq_err = str(e)
+        print(f"  forged commit at height    : {bad_h}")
+        print(f"  applied before rejection   : {out2.applied} "
+              f"(gate: {bad_h - 1})")
+        print(f"  fallback error             : {out2.error!r}")
+        if out2.applied != bad_h - 1 or out2.failed_height != bad_h:
+            print(f"  FAIL: fallback applied {out2.applied}, failed at "
+                  f"{out2.failed_height}; want {bad_h - 1}/{bad_h}",
+                  file=sys.stderr)
+            rc = 1
+        if seq_err is None or out2.error != seq_err:
+            print(f"  FAIL: error mismatch vs sequential path:\n"
+                  f"    replay    : {out2.error!r}\n"
+                  f"    sequential: {seq_err!r}", file=sys.stderr)
+            rc = 1
+
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+    finally:
+        tr.configure(enabled=False)
+        v.close()
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+
+    # -- pool hygiene ----------------------------------------------------
+    print(f"  pool                       : {pool}")
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -1003,6 +1220,14 @@ def main() -> int:
         "consensus batch preempts queued ingress work, a forged tx "
         "resolves FALSE (never dropped), zero pool-slot leak",
     )
+    ap.add_argument(
+        "--replay",
+        action="store_true",
+        help="round-14 gate: range-batched blocksync replay on a mocked "
+        "relay — W same-epoch heights fuse into ceil(W*sigs/bucket) "
+        "launches, a forged commit mid-range falls back per-height with "
+        "verify_commit_light's exact error, zero pool-slot leak",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -1016,6 +1241,8 @@ def main() -> int:
         return run_light(args)
     if args.ingress:
         return run_ingress(args)
+    if args.replay:
+        return run_replay(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

@@ -121,6 +121,16 @@ def run_once(args, faults, link=None, want_trace=False) -> tuple:
         args, faults, link=link,
         tracing=want_trace if args.trace else None,
     )
+    if getattr(args, "replay_node", -1) >= 0:
+        from tendermint_tpu.simnet import CatchupDriver
+
+        rdrop = getattr(args, "replay_drop", -1.0)
+        CatchupDriver(
+            cluster, args.replay_node,
+            drop=rdrop if rdrop >= 0 else args.drop,
+            start_after=5.0,
+            start_at_height=getattr(args, "replay_at", 0) or None,
+        )
     merged = None
     try:
         with _trace.span("simnet.run", seed=args.seed, nodes=args.nodes):
@@ -336,6 +346,25 @@ def main() -> int:
         default="",
         help="re-introduce a known-fixed gossip bug (TM_TPU_GOSSIP_BUG_* "
         "seam) so the search demonstrably rediscovers and shrinks it",
+    )
+    # -- chain-replay catch-up (ISSUE 14) ---------------------------------
+    ap.add_argument(
+        "--replay-node", type=int, default=-1,
+        help="attach a CatchupDriver to this node index: after it crashes "
+        "(schedule a crash fault via --faults/--preset), replay the gap "
+        "live through the blocksync ReplayEngine and rejoin at the tip; "
+        "the verdict's `catchup` list carries the range hit-rate",
+    )
+    ap.add_argument(
+        "--replay-at", type=int, default=0,
+        help="hold the first replay fetch until the live tip reaches this "
+        "height, so the rejoin happens N heights behind (0 = chase "
+        "immediately)",
+    )
+    ap.add_argument(
+        "--replay-drop", type=float, default=-1.0,
+        help="P(range-fetch response lost) on the replay request path "
+        "(default: --drop)",
     )
     ap.add_argument(
         "--devcheck",

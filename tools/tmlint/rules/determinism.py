@@ -10,10 +10,13 @@ instance), reads OS entropy (`os.urandom`, `uuid.uuid4`, `secrets`), or
 lets a Python `set`'s hash-order feed a scheduling decision.
 
 Scope: tendermint_tpu/simnet/, tendermint_tpu/consensus/ (the modules
-the simnet harness drives) and tendermint_tpu/light/ (ISSUE 11:
+the simnet harness drives), tendermint_tpu/light/ (ISSUE 11:
 simnet-driven light clients and the batched verification service — their
 wall-clock default lives in libs/timeutil and rides in via the `now_fn`
-seams, so the light modules themselves lint clean without suppressions).
+seams, so the light modules themselves lint clean without suppressions)
+and tendermint_tpu/blocksync/ (ISSUE 14: the simnet rejoin scenario
+drives the replay engine and BlockPool; the pool's wall-clock default
+rides in via its injected `clock` seam).
 The injection seams are the allowlist: clocks ride `self._now` / injected
 `clock` objects, randomness rides seeded `random.Random` instances —
 neither matches these patterns, so correctly injected code lints clean by
@@ -49,7 +52,7 @@ class SimnetDeterminismRule(Rule):
     def applies_to(self, relpath: str) -> bool:
         return relpath.startswith(
             ("tendermint_tpu/simnet/", "tendermint_tpu/consensus/",
-             "tendermint_tpu/light/")
+             "tendermint_tpu/light/", "tendermint_tpu/blocksync/")
         )
 
     # -- call patterns ---------------------------------------------------
