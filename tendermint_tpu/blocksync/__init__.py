@@ -271,7 +271,12 @@ class BlockSyncReactor:
     def stop(self) -> None:
         self._stopped.set()
         self._pool.signal()  # unblock waiting loops
-        self._engine.close()
+        # the replay engine (and its writer thread) is closed by
+        # _apply_loop on its way out, never here: closing it mid-replay
+        # would queue the writer's shutdown sentinel ahead of still-
+        # arriving save_blocks (silently dropping them — state advanced
+        # past the store) and leave the post-range drain() waiting on a
+        # writer that already exited.
 
     def stop_consuming(self) -> None:
         """Stop requesting/applying blocks; keep serving peers."""
@@ -304,8 +309,11 @@ class BlockSyncReactor:
         pool's wake event fires on new peer ranges, fetched blocks, and
         height advances — the three things that change next_requests().
         The timeout only re-arms the _PEER_TIMEOUT re-request scan."""
-        wake = self._req_wake
         while not self._stopped.is_set():
+            # re-read every iteration: reset_to_state() swaps the pool
+            # and mints fresh wake events — a cached local would leave
+            # this loop waiting on an event the new pool never signals
+            wake = self._req_wake
             if not self._consuming.is_set():
                 wake.wait(timeout=1.0)
                 wake.clear()
@@ -376,8 +384,10 @@ class BlockSyncReactor:
         PRIORITY_REPLAY, store writes pipelined behind verification."""
         caught_up_reported = False
         spec = None  # (height, valset_hash, future) of a pre-verification
-        wake = self._apply_wake
         while not self._stopped.is_set():
+            # re-read every iteration (see _request_loop): reset_to_state
+            # replaces the pool's wake events
+            wake = self._apply_wake
             if not self._consuming.is_set():
                 wake.wait(timeout=1.0)
                 wake.clear()
@@ -430,6 +440,9 @@ class BlockSyncReactor:
             self._store.save_block(first, parts, second.last_commit)
             self._state = self._block_exec.apply_block(self._state, first_id, first)
             self._pool.pop_first()
+        # this loop owns the engine: only close it after the last
+        # replay_blocks has returned (and drained its writer) — see stop()
+        self._engine.close()
 
     def _replay_run(self, run) -> None:
         """Hand a consecutive fetched run to the ReplayEngine: range

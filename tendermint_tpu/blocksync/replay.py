@@ -61,10 +61,17 @@ def plan_epoch_range(blocks: List[Block], limit: int) -> int:
     i-1 changes the validator set: the range ends there and the next
     range starts under the post-apply set.
 
+    A block whose header announces a valset change via
+    next_validators_hash also ends the range after its height: applying
+    it installs a new set, so later heights cannot share this range's
+    verification key material.
+
     Header hashes are a grouping HEURISTIC only — verification authority
-    stays with the applied state's validator set, and a chain that lies
-    about validators_hash simply fails device verification and falls
-    back to the sequential path (same errors, same rejection)."""
+    stays with the applied state's validator set. A chain that lies
+    about its hashes can at worst form a range whose commits verify
+    under stale keys; the apply step then rejects the block under the
+    live valset and the engine falls back to the sequential path (same
+    errors, same rejection — see _apply_verified)."""
     n = min(len(blocks) - 1, limit)
     if n <= 0:
         return 0
@@ -72,6 +79,9 @@ def plan_epoch_range(blocks: List[Block], limit: int) -> int:
     cut = 1
     while cut < n:
         if bytes(blocks[cut].header.validators_hash) != first:
+            break
+        nxt = bytes(blocks[cut - 1].header.next_validators_hash)
+        if nxt and nxt != first:
             break
         cut += 1
     return cut
@@ -98,6 +108,13 @@ class ReplayOutcome:
         )
 
 
+class _ApplyRejected(Exception):
+    """apply() rejected a verified block (InvalidBlockError, a
+    ValueError): wrapped so the range/sequential drivers can tell an
+    apply rejection (fall back / surface failed_height) apart from a
+    save failure (propagate — the store diverged, abort catch-up)."""
+
+
 class _Writer:
     """Ordered store-write pipeline: save_block (which enforces strictly
     sequential heights itself) runs on this thread while the caller is
@@ -109,6 +126,7 @@ class _Writer:
     def __init__(self, depth: int = 128):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._err: Optional[BaseException] = None
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="replay-writer", daemon=True
         )
@@ -130,17 +148,31 @@ class _Writer:
                 self._q.task_done()
 
     def put(self, save: Callable, block, parts, seen_commit) -> None:
+        if self._closed:
+            # the sentinel is already queued: a save enqueued behind it
+            # would never run (state advanced past the store on disk)
+            raise RuntimeError("replay writer closed")
         if self._err is not None:
             raise RuntimeError("replay writer failed") from self._err
         self._q.put((save, (block, parts, seen_commit)))
 
     def drain(self) -> None:
-        """Block until every queued save has run; raise the first error."""
-        self._q.join()
+        """Block until every queued save has run; raise the first error.
+        Never hangs on a writer thread that already exited — a dead
+        writer with queued saves is an error, not a deadlock."""
+        q = self._q
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                if not self._thread.is_alive():
+                    break
+                q.all_tasks_done.wait(0.05)
         if self._err is not None:
             raise RuntimeError("replay writer failed") from self._err
+        if q.unfinished_tasks:
+            raise RuntimeError("replay writer exited with pending saves")
 
     def close(self, timeout: float = 10.0) -> None:
+        self._closed = True
         self._q.put(None)
         self._thread.join(timeout=timeout)
 
@@ -373,20 +405,36 @@ class ReplayEngine:
                     )
                 verdicts[height] = True
             # apply the verified prefix of this chunk
-            state, ok = self._apply_verified(
+            state, fallback = self._apply_verified(
                 state, blocks, parts, ids, verdicts, synced_set, n,
                 save, apply, applied, out,
             )
-            if not ok:
-                return state
+            if fallback:
+                # apply rejected a range-verified block: the headers lied
+                # about their valset epoch. Re-verify the rest under the
+                # LIVE post-apply set — the sequential path's authority —
+                # which reproduces its exact rejection for that height.
+                self.fallback_ranges += 1
+                return self._apply_sequential(
+                    state, blocks, parts, ids,
+                    self._range_resume(blocks, state), n,
+                    save, apply, applied, should_stop, out,
+                )
             if should_stop is not None and should_stop():
                 return state
         # heights verified sub-threshold (synced) interleave with device
         # heights; a trailing run of them may remain unapplied
-        state, _ = self._apply_verified(
+        state, fallback = self._apply_verified(
             state, blocks, parts, ids, verdicts, synced_set, n,
-            save, apply, applied, out, final=True,
+            save, apply, applied, out,
         )
+        if fallback:
+            self.fallback_ranges += 1
+            return self._apply_sequential(
+                state, blocks, parts, ids,
+                self._range_resume(blocks, state), n,
+                save, apply, applied, should_stop, out,
+            )
         return state
 
     def _range_resume(self, blocks, state) -> int:
@@ -398,9 +446,19 @@ class ReplayEngine:
 
     def _apply_verified(self, state, blocks, parts, ids, verdicts,
                         synced_set, n, save, apply, applied,
-                        out: ReplayOutcome, final: bool = False):
+                        out: ReplayOutcome):
         """Apply the contiguous verified prefix starting at the first
-        unapplied height. Returns (state, keep_going)."""
+        unapplied height. Returns (state, fallback_needed).
+
+        Commit verification in this range ran under the valset the FIRST
+        header claimed; that is a grouping heuristic, not authority. A
+        chain forged with stale valset keys passes device verification
+        but is rejected here by apply (InvalidBlockError, a ValueError)
+        under the live state — in that case nothing is saved (the save
+        is only enqueued after apply succeeds) and fallback_needed=True
+        sends the caller to _apply_sequential, which re-verifies under
+        the live post-apply set and surfaces the sequential path's exact
+        failed_height/error for redo_request."""
         i = self._range_resume(blocks, state)
         while i < n:
             h = blocks[i].header.height
@@ -410,12 +468,13 @@ class ReplayEngine:
                 via_range = True
             else:
                 break  # later chunk still in flight
-            state = self._save_and_apply(
-                state, blocks[i], parts[i], ids[i],
-                blocks[i + 1].last_commit, save, apply, applied, out,
-            )
-            if state is None:
-                return None, False
+            try:
+                state = self._save_and_apply(
+                    state, blocks[i], parts[i], ids[i],
+                    blocks[i + 1].last_commit, save, apply, applied, out,
+                )
+            except _ApplyRejected:
+                return state, True
             if via_range:
                 out.range_heights += 1
                 self.range_heights += 1
@@ -423,7 +482,7 @@ class ReplayEngine:
                 out.sequential_heights += 1
                 self.sequential_heights += 1
             i += 1
-        return state, True
+        return state, False
 
     def _apply_sequential(self, state, blocks, parts, ids, start, n,
                           save, apply, applied, should_stop,
@@ -446,12 +505,19 @@ class ReplayEngine:
                 out.failed_height = h
                 out.error = str(e)
                 return state
-            state = self._save_and_apply(
-                state, blocks[i], parts[i], ids[i],
-                blocks[i + 1].last_commit, save, apply, applied, out,
-            )
-            if state is None:
-                return None
+            try:
+                state = self._save_and_apply(
+                    state, blocks[i], parts[i], ids[i],
+                    blocks[i + 1].last_commit, save, apply, applied, out,
+                )
+            except _ApplyRejected as e:
+                # commit verified but apply rejected the block body
+                # (InvalidBlockError): surface it like a verification
+                # failure so the reactor redo_requests instead of the
+                # apply thread dying with the block half-persisted
+                out.failed_height = h
+                out.error = str(e)
+                return state
             out.sequential_heights += 1
             self.sequential_heights += 1
             i += 1
@@ -459,13 +525,21 @@ class ReplayEngine:
 
     def _save_and_apply(self, state, block, parts, block_id, seen_commit,
                         save, apply, applied, out: ReplayOutcome):
+        """Apply FIRST, save after: apply is the authority (it re-checks
+        the block under live state), so a block it rejects must never
+        reach the store — a persisted-but-invalid block would wedge the
+        node on restart. Saves still pipeline: height h's store write
+        runs on the writer thread while h+1 applies."""
+        try:
+            state = apply(block_id, block)
+        except ValueError as e:
+            raise _ApplyRejected(str(e)) from e
         if self._synchronous:
             save(block, parts, seen_commit)
         else:
             if self._writer is None:
                 self._writer = _Writer()
             self._writer.put(save, block, parts, seen_commit)
-        state = apply(block_id, block)
         out.applied += 1
         self.heights_applied += 1
         if applied is not None:

@@ -187,6 +187,21 @@ class TestEpochPlanner:
         assert plan_epoch_range(blocks[:1], 64) == 0
         assert plan_epoch_range([], 64) == 0
 
+    def test_cut_at_next_validators_hash_announcement(self):
+        # a header announcing a valset change via next_validators_hash
+        # ends the range after its height even when later headers keep
+        # claiming the old validators_hash (an inconsistent/forged chain)
+        import dataclasses
+
+        blocks, _, _ = _make_chain(10, n_vals=2)
+        blocks[3] = dataclasses.replace(
+            blocks[3],
+            header=dataclasses.replace(
+                blocks[3].header, next_validators_hash=b"\x07" * 32
+            ),
+        )
+        assert plan_epoch_range(blocks, 64) == 4
+
 
 # -- the range engine over a real signed chain ----------------------------
 
@@ -330,6 +345,51 @@ class TestReplayEngine:
         with pytest.raises(RuntimeError, match="replay writer failed"):
             eng.replay_blocks(st, blocks, _save, _apply)
         eng.close()
+
+    def test_apply_rejection_mid_range_falls_back(self):
+        # device verification accepted the range under the headers'
+        # claimed epoch, but apply — the authority, re-validating under
+        # live state — rejects height 9 (the forged-valset shape). The
+        # engine must not persist the rejected block, must not let the
+        # rejection escape (the reactor's apply thread would die), and
+        # must surface failed_height/error like the sequential path so
+        # the reactor redo_requests.
+        blocks, vals_at, _ = _make_chain(20, n_vals=8)
+        bad_h = 9
+        eng = ReplayEngine(synchronous=True)
+        st = _State(vals_at[1], 0)
+        saves = []
+
+        def _save(block, parts, seen_commit):
+            saves.append(block.header.height)
+
+        def _apply(bid, block):
+            h = block.header.height
+            if h == bad_h:
+                raise ValueError("wrong Header.ValidatorsHash")
+            st.last_block_height = h
+            st.validators = vals_at[h + 1]
+            return st
+
+        st2, out = eng.replay_blocks(st, blocks, _save, _apply)
+        eng.close()
+        assert out.failed_height == bad_h
+        assert out.error == "wrong Header.ValidatorsHash"
+        assert saves == list(range(1, bad_h))  # rejected block never saved
+        assert st.last_block_height == bad_h - 1
+        assert eng.fallback_ranges >= 1
+
+    def test_writer_put_after_close_raises_drain_never_hangs(self):
+        from tendermint_tpu.blocksync.replay import _Writer
+
+        ran = []
+        w = _Writer()
+        w.put(lambda *a: ran.append(a), 1, 2, 3)
+        w.close()
+        assert ran  # saves queued before close still run
+        with pytest.raises(RuntimeError, match="closed"):
+            w.put(lambda *a: ran.append(a), 4, 5, 6)
+        w.drain()  # writer thread already exited: returns, no hang
 
     def test_consecutive_heights_enforced(self):
         blocks, vals_at, _ = _make_chain(5, n_vals=2)
@@ -532,6 +592,35 @@ class TestWakeEvents:
         assert pool.next_requests() == {}  # within the peer timeout
         now[0] += 20.0  # past _PEER_TIMEOUT on the injected clock
         assert pool.next_requests()  # re-requested without wall time
+
+    def test_reset_to_state_rebinds_loop_wake_events(self):
+        # after a statesync reset the loops must park on the NEW pool's
+        # wake events — a signal on the new pool wakes them well under
+        # the 1s fallback timeout (a loop still caching the old event
+        # would only advance on timeout polls)
+        _, vset = _make_vals(2, 1)
+        r = _mk_reactor(vset, 0)
+        r.start()
+        try:
+            r.reset_to_state(_State(vset, 100))
+            # wait for an iteration AFTER the reset: the loop re-reads
+            # the wake event at the top of every iteration
+            before = r.loop_wakes["request"]
+            deadline = time.time() + 3.0
+            while time.time() < deadline and r.loop_wakes["request"] == before:
+                time.sleep(0.01)
+            assert r.loop_wakes["request"] > before
+            # it is now parked on the new pool's event
+            before = r.loop_wakes["request"]
+            r.pool.set_peer_range("p", 1, 200)
+            deadline = time.time() + 0.4
+            while time.time() < deadline and r.loop_wakes["request"] == before:
+                time.sleep(0.01)
+            assert r.loop_wakes["request"] > before, (
+                "request loop missed the new pool's wake event"
+            )
+        finally:
+            r.stop()
 
     def test_loops_do_not_hot_spin_idle(self):
         # the PR-2/PR-3 guard shape: with nothing to do, the wake-event
