@@ -1904,6 +1904,225 @@ def blocksync_main(argv) -> None:
             fh.write("\n")
 
 
+def votes_main(argv) -> None:
+    """`bench.py votes` — device-batched live-vote ingress (ISSUE 15).
+
+    Floods gossiped prevotes through the FULL AddVote split path (host
+    check_vote, vote-ingress windowing, EntryBlock packing, verdict
+    application into real VoteSets) with the device mocked behind a
+    fixed per-launch relay RTT (mock_vote_prepare — real windowing,
+    packing, host prep and transfer; the launch's verdict matures
+    rtt_ms after launch). Headline: vote signature verdicts/s through
+    the windowed accumulator, measured to the LAST verdict applied.
+    The honest baseline is the SAME mocked engine driven per-vote
+    (window=0, batch=1 — one relay launch per vote, the shape AddVote
+    had before the accumulator), under the TM_TPU_FORCE_DEVICE
+    discipline so neither column quietly routes to host crypto.
+
+    Prints ONE JSON line; --out also writes it as an artifact file
+    (VOTES_r*.json, schema_version 1, rendered by tools/bench_report.py
+    --trajectory and gated by --compare)."""
+    import argparse
+    import threading
+
+    ap = argparse.ArgumentParser(prog="bench.py votes")
+    ap.add_argument("--votes", type=int, default=4096,
+                    help="signed votes in the flood (default 4096)")
+    ap.add_argument("--vals", type=int, default=64,
+                    help="validators in the set (default 64)")
+    ap.add_argument("--batch", type=int, default=256,
+                    help="accumulator max batch (default 256)")
+    ap.add_argument("--window-ms", type=float, default=2.0,
+                    help="accumulator window (default 2)")
+    ap.add_argument("--rtt-ms", type=float, default=40.0,
+                    help="mocked relay round-trip per launch (default 40)")
+    ap.add_argument("--seq-votes", type=int, default=48,
+                    help="votes for the per-vote baseline (default 48)")
+    ap.add_argument("--real", action="store_true",
+                    help="run live kernels instead of the mocked relay")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON to this path")
+    args = ap.parse_args(argv)
+
+    from tendermint_tpu.libs import jaxcache
+
+    import jax
+
+    jaxcache.enable(jax, os.path.dirname(os.path.abspath(__file__)))
+
+    from tendermint_tpu.consensus import vote_ingress as _vi
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.ops._testing import mock_vote_prepare
+    from tendermint_tpu.types import (
+        BlockID,
+        PartSetHeader,
+        Timestamp,
+        Validator,
+        ValidatorSet,
+        Vote,
+        VoteSet,
+    )
+    from tendermint_tpu.types.vote import PREVOTE_TYPE
+
+    chain_id = "votes-bench"
+    height = 10
+    n_rounds = -(-args.votes // args.vals)
+    n_votes = n_rounds * args.vals
+    print(f"# signing {n_votes} votes ({args.vals} vals x {n_rounds} "
+          "rounds)", file=sys.stderr)
+    pairs = []
+    for i in range(args.vals):
+        sk = _ed.gen_priv_key(bytes([(i % 255) + 1]) * 31 +
+                              bytes([i // 255 + 1]))
+        pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+    vset = ValidatorSet.new([v for _, v in pairs])
+    by_addr = {v.address: sk for sk, v in pairs}
+    sks = [by_addr[v.address] for v in vset.validators]
+    bid = BlockID(hash=b"\x07" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x07" * 32))
+    votes = []
+    for r in range(n_rounds):
+        for i, sk in enumerate(sks):
+            vote = Vote(
+                type=PREVOTE_TYPE, height=height, round=r, block_id=bid,
+                timestamp=Timestamp(seconds=1_600_000_000, nanos=0),
+                validator_address=vset.validators[i].address,
+                validator_index=i,
+            )
+            msg = vote.sign_bytes(chain_id)
+            votes.append((
+                Vote(**{**vote.__dict__, "signature": sk.sign(msg)}), msg,
+            ))
+
+    def fresh_sets():
+        return {r: VoteSet(chain_id, height, r, PREVOTE_TYPE, vset)
+                for r in range(n_rounds)}
+
+    _epoch.reset(8)
+    _epoch.note_valset(vset)  # register
+    _epoch.note_valset(vset)  # warm: windows attach val_idx + epoch_key
+    real_prepare = _pl.AsyncBatchVerifier._prepare
+    if not args.real:
+        _pl.AsyncBatchVerifier._prepare = staticmethod(
+            mock_vote_prepare(real_prepare, args.rtt_ms / 1e3)
+        )
+    # both columns under the force-device discipline (see mempool_main)
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    _swi = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    v = _pl.AsyncBatchVerifier(depth=3)
+
+    def make_apply(sets, counter, done):
+        def apply(batch, verdicts, error):
+            for i, p in enumerate(batch):
+                if error is None and verdicts[i]:
+                    try:
+                        sets[p.vote.round].apply_vote_verdict(p.vote, True)
+                    except Exception:  # noqa: BLE001 — tally only
+                        pass
+                counter[0] += 1
+            if counter[0] >= counter[1]:
+                done.set()
+        return apply
+
+    try:
+        # -- column A: the headline — windowed flood ---------------------
+        sets = fresh_sets()
+        done = threading.Event()
+        counter = [0, n_votes]
+        acc = _vi.VoteIngress(make_apply(sets, counter, done), verifier=v,
+                              max_batch=args.batch,
+                              window_ms=args.window_ms)
+        try:
+            t0 = time.perf_counter()
+            for vote, msg in votes:
+                chk = sets[vote.round].check_vote(vote)  # host stage
+                assert chk is not None
+                acc.submit(_vi.PendingVote(
+                    vote, "bench-peer", chk.pub_key.bytes(), msg,
+                    t_enq=time.perf_counter(),
+                ), vset)
+            acc.flush_now()
+            if not done.wait(timeout=600):
+                raise RuntimeError(
+                    f"only {counter[0]}/{n_votes} verdicts arrived"
+                )
+            dt = time.perf_counter() - t0
+            rate = n_votes / dt
+            stats = acc.stats()
+            n_applied = sum(
+                1 for r in range(n_rounds) for i in range(args.vals)
+                if sets[r].bit_array().get_index(i)
+            )
+            if n_applied != n_votes:
+                print(f"# WARNING: {n_votes - n_applied} votes not "
+                      "applied", file=sys.stderr)
+        finally:
+            acc.close()
+
+        # -- baseline: per-vote dispatch on the SAME mocked engine -------
+        seq_sets = fresh_sets()
+        seq_n = min(args.seq_votes, n_votes)
+        seq_done = threading.Event()
+        seq_counter = [0, seq_n]
+        seq_acc = _vi.VoteIngress(
+            make_apply(seq_sets, seq_counter, seq_done), verifier=v,
+            max_batch=1, window_ms=0.0,
+        )
+        try:
+            t0 = time.perf_counter()
+            for vote, msg in votes[:seq_n]:
+                chk = seq_sets[vote.round].check_vote(vote)
+                want = seq_counter[0] + 1
+                seq_acc.submit(_vi.PendingVote(
+                    vote, "bench-peer", chk.pub_key.bytes(), msg,
+                    t_enq=time.perf_counter(),
+                ), vset)
+                seq_acc.flush_now()
+                # sequential shape: wait for THIS vote's verdict before
+                # the next — one relay launch per vote
+                deadline = time.perf_counter() + 300
+                while (seq_counter[0] < want
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.0005)
+            seq_rate = seq_n / (time.perf_counter() - t0)
+        finally:
+            seq_acc.close()
+    finally:
+        v.close()
+        sys.setswitchinterval(_swi)
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        _pl.AsyncBatchVerifier._prepare = real_prepare
+
+    out = {
+        "schema_version": 1,
+        "metric": "vote_ingress_votes_per_s",
+        "value": round(rate, 1),
+        "unit": "votes/s",
+        "mode": "real" if args.real else "mocked-relay",
+        "backend": os.environ.get("JAX_PLATFORMS", "") or "cpu",
+        "votes": n_votes,
+        "vals": args.vals,
+        "rounds": n_rounds,
+        "ingress_batch": args.batch,
+        "ingress_window_ms": args.window_ms,
+        "relay_rtt_ms": args.rtt_ms if not args.real else None,
+        "votes_seq_votes_per_s": round(seq_rate, 1),
+        "vs_sequential": round(rate / seq_rate, 2) if seq_rate else None,
+        "ingress_windows": stats["batches"],
+        "ingress_batch_wait_ms_avg": round(stats["batch_wait_ms_avg"], 2),
+        "window_dups": stats["window_dups"],
+        "memo_hits": stats["memo_hits"],
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+            fh.write("\n")
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip"]:
         multichip_main(sys.argv[2:])
@@ -1913,6 +2132,8 @@ if __name__ == "__main__":
         mempool_main(sys.argv[2:])
     elif sys.argv[1:2] == ["blocksync"]:
         blocksync_main(sys.argv[2:])
+    elif sys.argv[1:2] == ["votes"]:
+        votes_main(sys.argv[2:])
     elif os.environ.get("TM_TPU_BENCH_WORKER") == "1":
         worker()
     else:

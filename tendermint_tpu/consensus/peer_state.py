@@ -193,6 +193,23 @@ class PeerState:
                 learned = bits.copy()
             self._put_vote_bits_locked(height, round_, type_, learned)
 
+    def apply_has_vote_bits(
+        self, height: int, round_: int, type_: int, bits: BitArray
+    ) -> None:
+        """Coalesced HasVote (ISSUE 15 traffic diet): one bit-array summary
+        replaces a burst of per-index HasVote messages. Unlike VoteSetBits
+        responses, these are the sender's own authoritative "I hold these
+        votes" bits, so they are always OR-learned — never a wholesale
+        replace — to compose with bits we learned from earlier sends."""
+        with self._mtx:
+            cur = self._get_vote_bits_locked(height, round_, type_)
+            if cur is None:
+                self._ensure_vote_bits_locked(height, round_, type_, bits.size())
+                cur = self._get_vote_bits_locked(height, round_, type_)
+            if cur is None:
+                return
+            self._put_vote_bits_locked(height, round_, type_, bits.or_(cur))
+
     # -- bookkeeping after our own sends --------------------------------
 
     def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:

@@ -17,6 +17,12 @@ Wire (internal/consensus/msgs.go oneofs, field numbers ours):
                              4 parts_bits, 5 is_commit}
            | 3 HasVote{1 height, 2 round, 3 type, 4 index}
            | 4 VoteSetMaj23{1 height, 2 round, 3 type, 4 block_id}
+           | 5 HasVoteBits{1 height, 2 round, 3 type, 4 bits}
+             (ISSUE 15 traffic diet: one bit-array summary per
+             (height, round, type) per gossip sweep replaces the
+             per-vote HasVote broadcast — the PR-6 O(N²·V)
+             state-channel hotspot; field 3 remains understood inbound
+             for mixed-version peers)
   Data ch:   1 Proposal | 2 BlockPart{1 height, 2 round, 3 part}
            | 3 ProposalPOL{1 height, 2 pol_round, 3 bits}
   Vote ch:   1 Vote
@@ -121,6 +127,13 @@ class ConsensusReactor:
         self._dirty_mtx = threading.Lock()
         self._last_nrs = None  # last broadcast (height, round, step, lcr)
         self._last_nvb = None  # last broadcast NewValidBlock key
+        # HasVote traffic diet (ISSUE 15): votes added between sweeps
+        # accumulate their (height, round, type) keys here; gossip_once
+        # drains the dict and broadcasts ONE HasVoteBits summary per key
+        # instead of one HasVote per vote. Insertion-ordered for simnet
+        # determinism.
+        self._pending_has_vote: Dict[tuple, None] = {}
+        self._pending_hv_mtx = threading.Lock()
         self._handlers = {
             DATA_CHANNEL: self._handle_data,
             VOTE_CHANNEL: self._handle_vote,
@@ -260,13 +273,13 @@ class ConsensusReactor:
         self._mark_all_dirty()  # new valid-block/parts state to serve
 
     def _broadcast_has_vote(self, vote: Vote) -> None:
-        """reactor.go:1031 broadcastHasVoteMessage."""
-        w = ProtoWriter()
-        w.write_varint(1, vote.height)
-        w.write_varint(2, vote.round)
-        w.write_varint(3, vote.type)
-        w.write_varint(4, vote.validator_index)
-        self._state_ch.broadcast(_wrap(3, w.bytes()))
+        """reactor.go:1031 broadcastHasVoteMessage — coalesced (ISSUE 15):
+        instead of broadcasting one HasVote per added vote (O(N²·V) on the
+        state channel at cluster scale), record the vote's (height, round,
+        type) key; the next gossip_once sweep broadcasts ONE HasVoteBits
+        bit-array summary per recorded key."""
+        with self._pending_hv_mtx:
+            self._pending_has_vote[(vote.height, vote.round, vote.type)] = None
         # a vote entered OUR state: peers at (or below) its height may be
         # missing it. The height read is deliberately lock-free — a stale
         # read only means a spurious mark (harmless) or a missed one
@@ -280,6 +293,44 @@ class ConsensusReactor:
             with self._dirty_mtx:
                 for pid in marks:
                     self._dirty[pid] = None
+
+    def _flush_has_vote(self) -> None:
+        """Drain the pending HasVote keys and broadcast one HasVoteBits
+        summary per (height, round, type) — our VoteSet's CURRENT bit
+        array, so a summary sent once covers every vote added since the
+        last sweep (and any the per-key coalescing folded together).
+        Deterministic under simnet: the pending dict is insertion-ordered
+        and drained atomically at the sweep boundary."""
+        with self._pending_hv_mtx:
+            if not self._pending_has_vote:
+                return
+            pending = list(self._pending_has_vote)
+            self._pending_has_vote.clear()
+        rs = self._cs.rs
+        for h, r, t in pending:
+            bits = None
+            if h == rs.height and rs.votes is not None:
+                vs = (rs.votes.prevotes(r) if t == PREVOTE_TYPE
+                      else rs.votes.precommits(r))
+                if vs is not None:
+                    bits = vs.bit_array()
+            elif (
+                h + 1 == rs.height
+                and rs.last_commit is not None
+                and t == PRECOMMIT_TYPE
+                and r == rs.last_commit.round
+            ):
+                bits = rs.last_commit.bit_array()
+            if bits is None:
+                # height moved on mid-sweep: the NewRoundStep broadcast +
+                # catchup gossip already cover what peers need
+                continue
+            w = ProtoWriter()
+            w.write_varint(1, h)
+            w.write_varint(2, r)
+            w.write_varint(3, t)
+            w.write_message(4, bits.encode(), always=True)
+            self._state_ch.broadcast(_wrap(5, w.bytes()))
 
     # -- gossip loop (the per-peer goroutines, folded) --------------------
 
@@ -304,6 +355,7 @@ class ConsensusReactor:
             self._last_nvb = None
         self._maybe_broadcast_new_round_step()
         self._maybe_broadcast_new_valid_block()
+        self._flush_has_vote()
         if query_maj23:
             with self._dirty_mtx:
                 self._dirty.clear()
@@ -640,6 +692,20 @@ class ConsensusReactor:
                 to_signed32(field_int(r, 2)),
                 field_int(r, 3),
                 field_int(r, 4),
+            )
+        elif 5 in f:  # HasVoteBits (ISSUE 15 coalesced HasVote summary)
+            r = decode_message(field_bytes(f, 5))
+            height = to_signed64(field_int(r, 1))
+            rs = self._cs.rs
+            if rs.validators is not None:
+                ps.ensure_vote_bit_arrays(
+                    height, len(rs.validators.validators)
+                )
+            ps.apply_has_vote_bits(
+                height,
+                to_signed32(field_int(r, 2)),
+                field_int(r, 3),
+                BitArray.decode(field_bytes(r, 4)),
             )
         elif 4 in f:  # VoteSetMaj23 -> record + respond with VoteSetBits
             r = decode_message(field_bytes(f, 4))

@@ -91,6 +91,20 @@ class VoteMessage:
 
 
 @dataclass
+class VoteVerdictMessage:
+    """A vote ingress verdict re-entering the pump (ISSUE 15). The
+    original VoteMessage was WAL-logged before dispatch; verdicts are
+    NOT (``_wal_write_msg`` skips unknown kinds), so a replayed WAL
+    re-verifies the vote through the sequential path instead of trusting
+    a stale device verdict. ``valid`` is None iff ``error`` is set — the
+    poisoned-window shape, re-driven through the per-vote fallback."""
+
+    pend: object  # vote_ingress.PendingVote
+    valid: Optional[bool] = None
+    error: Optional[BaseException] = None
+
+
+@dataclass
 class HeightTimeline:
     """Per-height consensus latency attribution (ISSUE 10): the timestamps
     of every phase transition one height passes through, read off the
@@ -230,6 +244,11 @@ class ConsensusState(BaseService):
         # (reactor.go:1031 broadcastHasVoteMessage).
         self.vote_added_hooks: List[Callable] = []
 
+        # Live-vote ingress (ISSUE 15): attach_vote_ingress() wires the
+        # device-batched verify lane; None = every vote rides the
+        # sequential host path, byte-identically to pre-ISSUE-15.
+        self._vote_ingress = None
+
         self._update_to_state(state)
 
     # ------------------------------------------------------------------
@@ -259,6 +278,7 @@ class ConsensusState(BaseService):
 
     def on_stop(self) -> None:
         self._ticker.stop()
+        self._close_vote_ingress()
         self._queue.put(("quit", None))
         self._msg_ready.set()
         if self._thread is not None:
@@ -270,8 +290,18 @@ class ConsensusState(BaseService):
         """Tear down a start_stepped() node (ticker + WAL; no thread)."""
         self._quit.set()
         self._ticker.stop()
+        self._close_vote_ingress()
         if self._wal is not None:
             self._wal.stop()
+
+    def _close_vote_ingress(self) -> None:
+        ing = self._vote_ingress
+        if ing is not None:
+            self._vote_ingress = None
+            try:
+                ing.close(timeout=2.0)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
     # ------------------------------------------------------------------
     # external inputs
@@ -300,6 +330,117 @@ class ConsensusState(BaseService):
             msg.flow = tr.flow  # the delivery's flow rides with the vote
         self._queue.put((msg, peer_id))
         self._wake()
+
+    # ------------------------------------------------------------------
+    # live-vote ingress (ISSUE 15)
+
+    def attach_vote_ingress(self, verifier=None, stepped: bool = False,
+                            max_batch=None, window_ms=None, metrics=None):
+        """Wire the device-batched vote-verify lane: peer votes for the
+        current height run HeightVoteSet.check_vote on the pump, then
+        window through consensus/vote_ingress.py; verdicts re-enter the
+        queue and apply in submission order. Attach AFTER start — WAL
+        replay must ride the sequential path."""
+        from . import vote_ingress as _vi
+
+        ing = _vi.VoteIngress(
+            self._on_vote_verdicts, verifier=verifier, stepped=stepped,
+            max_batch=max_batch, window_ms=window_ms, metrics=metrics,
+        )
+        self._vote_ingress = ing
+        return ing
+
+    @property
+    def vote_ingress(self):
+        return self._vote_ingress
+
+    def _on_vote_verdicts(self, batch, verdicts, error) -> None:
+        """VoteIngress apply callback — may run on the pipeline resolver
+        thread, so it ONLY enqueues (the deadlock rule from
+        mempool/ingress.py). A full queue drops the verdict instead of
+        blocking the resolver: re-gossip re-delivers the vote, so a drop
+        costs latency, never correctness."""
+        ing = self._vote_ingress
+        for i, pend in enumerate(batch):
+            msg = VoteVerdictMessage(
+                pend,
+                None if error is not None else bool(verdicts[i]),
+                error,
+            )
+            try:
+                self._queue.put_nowait((msg, pend.peer_id))
+            except queue.Full:
+                if ing is not None:
+                    ing.apply_drops += 1
+        self._wake()
+
+    def _ingress_submit(self, vote: Vote, peer_id: str,
+                        flow: Optional[int]) -> bool:
+        """Host stage of the batched vote path. Returns True when the
+        vote was consumed (queued for device verify, answered from the
+        memo, or rejected by a host-stage check with the same outcome
+        the sequential path produces); False routes it to the sequential
+        path (wrong height shape, non-ed25519 key)."""
+        rs = self.rs
+        if vote.height != rs.height:
+            return False  # catchup / future-height shapes stay sync
+        ing = self._vote_ingress
+        tr = self._tracer
+        fid = None
+        if tr.enabled:
+            fid = flow if flow is not None else tr.flow
+            span = tr.span(
+                "consensus.verify_dispatch", flow=fid,
+                flow_phase="t" if fid is not None else None,
+                height=vote.height, round=vote.round, type=vote.type,
+            )
+        else:
+            span = None
+        try:
+            if span is not None:
+                with span:
+                    chk = rs.votes.check_vote(vote, peer_id)
+            else:
+                chk = rs.votes.check_vote(vote, peer_id)
+        except ErrVoteNonDeterministicSignature:
+            return True  # sequential outcome: swallowed, returns False
+        except ErrVoteConflictingVotes as e:
+            self._record_conflicting_votes(vote, e)
+            return True
+        if chk is None:
+            return True  # exact duplicate / invalid type: a no-op add
+        pub = chk.pub_key
+        if pub.type() != "ed25519":
+            return False  # host lane for exotic keys
+        from . import vote_ingress as _vi
+
+        pend = _vi.PendingVote(
+            vote, peer_id, pub.bytes(),
+            vote.sign_bytes(self._state.chain_id),
+            flow=fid, t_enq=_time.perf_counter(),
+        )
+        ing.submit(pend, rs.validators)
+        return True
+
+    def _apply_vote_verdict_msg(self, msg: VoteVerdictMessage,
+                                peer_id: str) -> None:
+        pend = msg.pend
+        vote = pend.vote
+        if msg.error is not None:
+            # poisoned window (DispatchError): exactly these votes
+            # re-drive through the full sequential per-vote path
+            self._try_add_vote(vote, peer_id, flow=pend.flow)
+            return
+        tr = self._tracer
+        if tr.enabled:
+            fid = pend.flow
+            with tr.span("consensus.verify_apply", flow=fid,
+                         flow_phase="f" if fid is not None else None,
+                         height=vote.height, round=vote.round,
+                         type=vote.type, valid=bool(msg.valid)):
+                self._try_add_vote_impl(vote, peer_id, verdict=msg.valid)
+        else:
+            self._try_add_vote_impl(vote, peer_id, verdict=msg.valid)
 
     def _send_internal(self, msg) -> None:
         self._internal_queue.put((msg, ""))
@@ -377,6 +518,17 @@ class ConsensusState(BaseService):
                 break
             item = self._pop_msg()
             if item is None:
+                # Stepped-mode vote-ingress flush point (ISSUE 15): the
+                # queue draining IS the deterministic window boundary —
+                # flush_pending() host-verifies every open window in
+                # submission order and enqueues the verdicts, which the
+                # next loop iterations apply before anything else can
+                # arrive. Replay-exact: flush timing is a pure function
+                # of message arrival order.
+                ing = self._vote_ingress
+                if (ing is not None and ing.stepped
+                        and ing.flush_pending()):
+                    continue
                 break
             msg, peer_id = item
             if msg == "quit":
@@ -436,7 +588,15 @@ class ConsensusState(BaseService):
                     self.rs.proposal_block_parts.is_complete():
                 pass  # handled inside _add_proposal_block_part
         elif isinstance(msg, VoteMessage):
+            if (
+                self._vote_ingress is not None
+                and peer_id != ""  # own votes stay sync (WAL-synced)
+                and self._ingress_submit(msg.vote, peer_id, msg.flow)
+            ):
+                return
             self._try_add_vote(msg.vote, peer_id, flow=msg.flow)
+        elif isinstance(msg, VoteVerdictMessage):
+            self._apply_vote_verdict_msg(msg, peer_id)
         else:
             raise ValueError(f"unknown msg type {type(msg)}")
 
@@ -1019,33 +1179,48 @@ class ConsensusState(BaseService):
                 return self._try_add_vote_impl(vote, peer_id)
         return self._try_add_vote_impl(vote, peer_id)
 
-    def _try_add_vote_impl(self, vote: Vote, peer_id: str) -> bool:
+    def _try_add_vote_impl(self, vote: Vote, peer_id: str,
+                           verdict: Optional[bool] = None) -> bool:
         try:
-            return self._add_vote(vote, peer_id)
+            return self._add_vote(vote, peer_id, verdict=verdict)
         except ErrVoteNonDeterministicSignature:
             return False
         except ErrVoteConflictingVotes as e:
-            # evidence: our own double-sign would be fatal; peers' recorded
-            if (
-                self._priv_validator_pub_key is not None
-                and vote.validator_address == self._priv_validator_pub_key.address()
-            ):
-                return False
-            if self._evpool is not None:
-                from ..types.evidence import DuplicateVoteEvidence
-
-                try:
-                    ev = DuplicateVoteEvidence.new(
-                        e.vote_a, e.vote_b, self._state.last_block_time,
-                        self._state.validators,
-                    )
-                    self._evpool.add_evidence(ev)
-                except ValueError:
-                    pass
+            self._record_conflicting_votes(vote, e)
             return False
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
-        """state.go:2007-2180."""
+    def _record_conflicting_votes(self, vote: Vote,
+                                  e: ErrVoteConflictingVotes) -> bool:
+        """The ErrVoteConflictingVotes arm of state.go:1959-2005 —
+        evidence: our own double-sign would be fatal; peers' recorded.
+        Shared by the sequential path and the ingress host/apply stages."""
+        if (
+            self._priv_validator_pub_key is not None
+            and vote.validator_address == self._priv_validator_pub_key.address()
+        ):
+            return False
+        if self._evpool is not None:
+            from ..types.evidence import DuplicateVoteEvidence
+
+            try:
+                ev = DuplicateVoteEvidence.new(
+                    e.vote_a, e.vote_b, self._state.last_block_time,
+                    self._state.validators,
+                )
+                self._evpool.add_evidence(ev)
+            except ValueError:
+                pass
+        return False
+
+    def _add_vote(self, vote: Vote, peer_id: str,
+                  verdict: Optional[bool] = None) -> bool:
+        """state.go:2007-2180. `verdict` is the device signature verdict
+        from the ingress lane (ISSUE 15): None = sequential host verify;
+        a bool routes through HeightVoteSet.apply_vote_verdict, which
+        re-runs the host checks and applies. A verdict that arrives after
+        the height moved on falls into the catchup/stale branches below —
+        those always re-verify sequentially, never trusting a verdict
+        produced against a different height's vote sets."""
         rs = self.rs
         # A precommit for the previous height (catchup for commit-timeout)
         if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
@@ -1064,7 +1239,10 @@ class ConsensusState(BaseService):
         if vote.height != rs.height:
             return False
 
-        added = rs.votes.add_vote(vote, peer_id)
+        if verdict is None:
+            added = rs.votes.add_vote(vote, peer_id)
+        else:
+            added = rs.votes.apply_vote_verdict(vote, peer_id, verdict)
         if not added:
             return False
         if self._event_bus is not None:

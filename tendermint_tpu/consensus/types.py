@@ -101,6 +101,43 @@ class HeightVoteSet:
                     )
             return vs.add_vote(vote)
 
+    def check_vote(self, vote: Vote, peer_id: str):
+        """Host stage of add_vote (ISSUE 15): the same routing —
+        type-validity, catchup-round registration with its 2-round peer
+        budget — followed by VoteSet.check_vote. Returns the CheckedVote
+        (or None for a type-invalid vote / exact duplicate: both shapes
+        where sequential add_vote returns False without raising). The
+        catchup round is registered at CHECK time so the verdict always
+        has a VoteSet to land in."""
+        with self._mtx:
+            if not is_vote_type_valid(vote.type):
+                return None
+            vs = self._get_vote_set(vote.round, vote.type)
+            if vs is None:
+                rndz = self._peer_catchup_rounds.get(peer_id, [])
+                if len(rndz) < 2:
+                    self._add_round(vote.round)
+                    vs = self._get_vote_set(vote.round, vote.type)
+                    self._peer_catchup_rounds[peer_id] = rndz + [vote.round]
+                else:
+                    raise ErrGotVoteFromUnwantedRound(
+                        "peer has sent a vote that does not match our round for more than one round"
+                    )
+            return vs.check_vote(vote)
+
+    def apply_vote_verdict(self, vote: Vote, peer_id: str, valid: bool) -> bool:
+        """Verdict-application stage of add_vote (ISSUE 15). The round's
+        VoteSet was registered by check_vote; if it has since vanished
+        (height advanced resets this object — callers guard on height)
+        fall back to the full sequential add path, which re-verifies."""
+        with self._mtx:
+            if not is_vote_type_valid(vote.type):
+                return False
+            vs = self._get_vote_set(vote.round, vote.type)
+            if vs is None:
+                return self.add_vote(vote, peer_id)
+            return vs.apply_vote_verdict(vote, valid)
+
     def prevotes(self, round_: int) -> Optional[VoteSet]:
         with self._mtx:
             return self._get_vote_set(round_, PREVOTE_TYPE)

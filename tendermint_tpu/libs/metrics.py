@@ -273,6 +273,45 @@ class ConsensusMetrics:
         )
 
 
+class VoteIngressMetrics:
+    """Live-vote ingress (ISSUE 15): the consensus/vote_ingress.py
+    accumulator's device-batching counters. A separate set (not
+    ConsensusMetrics) because the accumulator is shared machinery like
+    the mempool ingress — benches and multi-node sims use the
+    process-wide instance."""
+
+    def __init__(self, registry: Registry):
+        self.batches = registry.counter(
+            "consensus", "vote_ingress_batches",
+            "Vote windows flushed to the device pipeline.",
+        )
+        self.batch_sigs = registry.counter(
+            "consensus", "vote_ingress_sigs",
+            "Vote signatures verified through ingress windows.",
+        )
+        self.batch_wait_ms = registry.histogram(
+            "consensus", "vote_ingress_batch_wait_ms",
+            "Milliseconds the oldest vote of each window waited before "
+            "its flush.",
+            buckets=[0.5, 1, 2.5, 5, 10, 25, 50, 100, 250],
+        )
+        self.memo_hits = registry.counter(
+            "consensus", "vote_ingress_memo_hits",
+            "Votes answered from the signature memo without re-dispatch "
+            "(re-gossiped duplicates).",
+        )
+        self.sync_fallbacks = registry.counter(
+            "consensus", "vote_ingress_sync_fallbacks",
+            "Vote windows verified on the host (below "
+            "BATCH_VERIFY_THRESHOLD, engine absent, or stepped mode).",
+        )
+        self.dispatch_errors = registry.counter(
+            "consensus", "vote_ingress_dispatch_errors",
+            "Vote windows poisoned by a DispatchError and re-driven "
+            "through the per-vote fallback.",
+        )
+
+
 class MempoolMetrics:
     """internal/mempool/metrics.go — the mempool metric set. size/
     size_bytes are sampled by a registry collect hook at scrape time; the
@@ -525,6 +564,20 @@ def mempool_metrics() -> "MempoolMetrics":
         if _global_mempool is None:
             _global_mempool = MempoolMetrics(global_registry())
         return _global_mempool
+
+
+_global_vote_ingress: Optional["VoteIngressMetrics"] = None
+
+
+def vote_ingress_metrics() -> "VoteIngressMetrics":
+    """Process-wide VoteIngressMetrics — same sharing rationale as
+    mempool_metrics(): many consensus states (simnet nodes, benches) can
+    feed one shared device pipeline."""
+    global _global_vote_ingress
+    with _global_mtx:
+        if _global_vote_ingress is None:
+            _global_vote_ingress = VoteIngressMetrics(global_registry())
+        return _global_vote_ingress
 
 
 _global_blocksync: Optional["BlockSyncMetrics"] = None

@@ -167,6 +167,32 @@ def mock_mempool_prepare(real_prepare, rtt_s: float):
     return prep
 
 
+def mock_vote_prepare(real_prepare, rtt_s: float):
+    """Mocked-relay DEVICE for `bench.py votes` and the
+    `tools/prep_bench.py --votes` gate (ISSUE 15): the real vote-ingress
+    windowing, EntryBlock packing, host prep and H2D transfer run
+    unchanged, but the launch returns an all-accept verdict row that
+    matures `rtt_s` after launch (DeadlineReadback) instead of running
+    the kernel. Both bench columns — the windowed accumulator and the
+    per-vote baseline — pay this same relay latency per LAUNCH, so the
+    ratio measures exactly what device-batched AddVote adds: live-vote
+    signatures fused per relay command."""
+    import numpy as np
+
+    def prep(entries):
+        _f, args, rlc, bucket = real_prepare(entries)
+
+        def launch(*_xs):
+            return DeadlineReadback(
+                np.ones((bucket,), dtype=bool),
+                time.perf_counter() + rtt_s,
+            )
+
+        return launch, args, rlc, bucket
+
+    return prep
+
+
 def drain_pool(pool, timeout: float = 5.0) -> None:
     """Wait for every in-flight slot to return. The resolver completes a
     batch's futures BEFORE releasing its pool slot, so a caller waking

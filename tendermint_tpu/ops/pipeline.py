@@ -299,6 +299,14 @@ class AsyncBatchVerifier:
         self.preempted_total = 0
         self._preempt_mtx = threading.Lock()
         self._preempt_hooks: List = []
+        # per-lane intake accounting (ISSUE 15): the CONSENSUS class is
+        # now multi-producer — commit batches AND live-vote ingress
+        # windows share it — so lane counters are the only way /status
+        # can show votes actually cross-coalescing through the QoS lanes
+        self._lane_mtx = threading.Lock()
+        self._lane_submitted = {
+            PRIORITY_CONSENSUS: 0, PRIORITY_REPLAY: 0, PRIORITY_INGRESS: 0,
+        }
         # (spans, prep_future, t_enqueue, priority) | None sentinel —
         # priority-ordered so a pending consensus batch overtakes queued
         # ingress superbatches (never an in-flight launch)
@@ -381,9 +389,25 @@ class AsyncBatchVerifier:
                 _trace.TRACER.flow_point(
                     "pipeline.submit", job.flow, "s", n=len(block)
                 )
+        with self._lane_mtx:
+            self._lane_submitted[
+                min(job.priority, PRIORITY_INGRESS)
+            ] = self._lane_submitted.get(
+                min(job.priority, PRIORITY_INGRESS), 0
+            ) + 1
         self._q.put(job, priority=job.priority)
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
+
+    def lane_counts(self) -> dict:
+        """Jobs accepted per QoS class since start — keys 'consensus'
+        (commit batches + live-vote windows), 'replay', 'ingress'."""
+        with self._lane_mtx:
+            return {
+                "consensus": self._lane_submitted[PRIORITY_CONSENSUS],
+                "replay": self._lane_submitted[PRIORITY_REPLAY],
+                "ingress": self._lane_submitted[PRIORITY_INGRESS],
+            }
 
     def _submit_chunked(self, block: EntryBlock, max_b: int,
                         flow: Optional[int] = None,

@@ -176,6 +176,10 @@ class Environment:
             # ISSUE 14: catch-up replay — speculation hit/miss/discard and
             # range-batched replay counters. Same cheap-counters-only rule.
             "blocksync": self._blocksync_stats(),
+            # ISSUE 15: live-vote ingress — window batching, memo hits,
+            # fallbacks, and the QoS lane intake split proving votes ride
+            # the consensus lane. Same cheap-counters-only rule.
+            "vote_ingress": self._vote_ingress_stats(),
         }
 
     def _mempool_ingress_stats(self) -> dict:
@@ -186,6 +190,22 @@ class Environment:
             from ..mempool.ingress import ingress_stats
 
             return ingress_stats()
+        except Exception as e:  # noqa: BLE001 — /status must not 500
+            return {"enabled": False, "error": str(e)}
+
+    @staticmethod
+    def _vote_ingress_stats() -> dict:
+        try:
+            from ..consensus.vote_ingress import vote_ingress_stats
+
+            stats = vote_ingress_stats()
+            # lane split only when a pipeline already exists — /status
+            # must never be the thing that spins the engine up
+            from ..ops import pipeline as _pl
+
+            if _pl._shared is not None:
+                stats["pipeline_lanes"] = _pl._shared.lane_counts()
+            return stats
         except Exception as e:  # noqa: BLE001 — /status must not 500
             return {"enabled": False, "error": str(e)}
 

@@ -252,6 +252,10 @@ class SimNode:
             self.reactor.add_peer(peer.node_id)
             peer.reactor.add_peer(self.node_id)
         self.cs.start_stepped()
+        if self.cluster.vote_ingress:
+            # AFTER start_stepped: WAL replay (inside build) must ride
+            # the sequential path; live peer votes window from here on
+            self.cs.attach_vote_ingress(stepped=True)
         self._schedule_gossip()
 
     def crash(self) -> None:
@@ -341,6 +345,7 @@ class Cluster:
         n_validators: Optional[int] = None,
         sig_memo: Optional[bool] = None,
         tracing: Optional[bool] = None,
+        vote_ingress: Optional[bool] = None,
     ):
         from ..types import Timestamp
         from ..types.genesis import GenesisDoc, GenesisValidator
@@ -376,6 +381,12 @@ class Cluster:
         # from 12 nodes up
         self._sig_memo_wanted = n_nodes >= 12 if sig_memo is None else sig_memo
         self._sig_memo: Optional[_SigMemo] = None
+        # live-vote ingress (ISSUE 15): stepped accumulators — votes
+        # window on each node and flush deterministically when its pump
+        # drains, so runs stay replay-exact. Default follows the env knob.
+        if vote_ingress is None:
+            vote_ingress = bool(os.environ.get("TM_TPU_SIMNET_VOTE_INGRESS"))
+        self.vote_ingress = bool(vote_ingress)
         # (height, fault) for fired val_* faults that must change the set
         self._rotations_fired: List[tuple] = []
         self._epoch_stats0 = self._epoch_stats()

@@ -56,10 +56,17 @@ class Vote:
             timestamp=self.timestamp,
         )
 
-    def verify(self, chain_id: str, pub_key: PubKey) -> None:
-        """vote.go:147-165: address match + signature over sign bytes."""
+    def verify_address(self, pub_key: PubKey) -> None:
+        """The host half of Verify (vote.go:148-153): the signer address
+        must match the public key. Split out so the batched ingress path
+        (consensus/vote_ingress.py) can run it BEFORE device dispatch and
+        raise the same error the sequential path raises."""
         if pub_key.address() != self.validator_address:
             raise ErrVoteInvalidValidatorAddress("invalid validator address")
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """vote.go:147-165: address match + signature over sign bytes."""
+        self.verify_address(pub_key)
         if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
             raise ErrVoteInvalidSignature("invalid signature")
 

@@ -18,7 +18,12 @@ from typing import Dict, List, Optional, Tuple
 from ..libs.bits import BitArray
 from .block import BlockID, Commit, CommitSig
 from .validator_set import ValidatorSet
-from .vote import PRECOMMIT_TYPE, Vote, is_vote_type_valid
+from .vote import (
+    PRECOMMIT_TYPE,
+    ErrVoteInvalidSignature,
+    Vote,
+    is_vote_type_valid,
+)
 
 MAX_VOTES_COUNT = 10000  # vote_set.go:18
 
@@ -48,6 +53,20 @@ class ErrVoteConflictingVotes(ValueError):
                          f"{vote_a.validator_address.hex().upper()}")
         self.vote_a = vote_a
         self.vote_b = vote_b
+
+
+@dataclass(frozen=True)
+class CheckedVote:
+    """Host-stage result for one vote (ISSUE 15): everything add_vote
+    establishes BEFORE the signature check. `pub_key` drives either the
+    inline host verify (sequential path) or an EntryBlock row (batched
+    ingress); `block_key`/`voting_power` feed the verdict-application
+    stage."""
+
+    vote: Vote
+    pub_key: object  # crypto.PubKey
+    voting_power: int
+    block_key: bytes
 
 
 class _BlockVotes:
@@ -109,6 +128,42 @@ class VoteSet:
             return self._add_vote(vote)
 
     def _add_vote(self, vote: Optional[Vote]) -> bool:
+        chk = self._check_vote(vote)
+        if chk is None:
+            return False  # duplicate
+        # Check signature (the per-vote hot path).
+        valid = chk.pub_key.verify_signature(
+            vote.sign_bytes(self.chain_id), vote.signature
+        )
+        return self._apply_checked(vote, chk, valid)
+
+    def check_vote(self, vote: Optional[Vote]) -> Optional[CheckedVote]:
+        """Host stage of add_vote (ISSUE 15): every check that does NOT
+        need the signature verdict — index/address/step validation, the
+        exact-duplicate and non-deterministic-signature checks, the
+        pubkey-vs-address match. Returns None for an exact duplicate
+        (sequential add_vote would return False); raises exactly what
+        add_vote raises for each malformed shape. The returned CheckedVote
+        feeds either a device EntryBlock row or apply_vote_verdict."""
+        with self._mtx:
+            return self._check_vote(vote)
+
+    def apply_vote_verdict(self, vote: Vote, valid: bool) -> bool:
+        """Verdict-application stage of add_vote (ISSUE 15). Re-runs the
+        host checks under the lock — VoteSet state may have moved between
+        dispatch and verdict (a re-gossiped copy landing first turns this
+        call into the duplicate=False / non-deterministic-signature case,
+        exactly as if the votes had arrived sequentially) — then applies
+        the device verdict: False raises the same ErrVoteInvalidSignature
+        Vote.verify raises, True runs _add_verified_vote with its
+        ErrVoteConflictingVotes semantics."""
+        with self._mtx:
+            chk = self._check_vote(vote)
+            if chk is None:
+                return False  # duplicate
+            return self._apply_checked(vote, chk, bool(valid))
+
+    def _check_vote(self, vote: Optional[Vote]) -> Optional[CheckedVote]:
         if vote is None:
             raise ValueError("nil vote")
         val_index = vote.validator_index
@@ -141,14 +196,26 @@ class VoteSet:
         existing = self._get_vote(val_index, block_key)
         if existing is not None:
             if existing.signature == vote.signature:
-                return False  # duplicate
+                return None  # duplicate
             raise ErrVoteNonDeterministicSignature(
                 f"existing vote: {existing}; new vote: {vote}"
             )
-        # Check signature (the per-vote hot path).
-        vote.verify(self.chain_id, val.pub_key)
+        # The host half of vote.Verify (address-vs-pubkey) stays in check
+        # order: after the duplicate check, before any signature math.
+        vote.verify_address(val.pub_key)
+        return CheckedVote(
+            vote=vote,
+            pub_key=val.pub_key,
+            voting_power=val.voting_power,
+            block_key=block_key,
+        )
 
-        added, conflicting = self._add_verified_vote(vote, block_key, val.voting_power)
+    def _apply_checked(self, vote: Vote, chk: CheckedVote, valid: bool) -> bool:
+        if not valid:
+            raise ErrVoteInvalidSignature("invalid signature")
+        added, conflicting = self._add_verified_vote(
+            vote, chk.block_key, chk.voting_power
+        )
         if conflicting is not None:
             raise ErrVoteConflictingVotes(conflicting, vote)
         if not added:

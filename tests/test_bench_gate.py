@@ -52,7 +52,7 @@ def test_pins_file_is_wellformed():
 
 
 @pytest.mark.parametrize(
-    "kind", ["bench", "multichip", "light", "mempool", "blocksync"]
+    "kind", ["bench", "multichip", "light", "mempool", "blocksync", "votes"]
 )
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.

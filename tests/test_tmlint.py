@@ -638,6 +638,84 @@ class TestLockDiscipline:
         assert not lint(src, "tendermint_tpu/mempool/ingress.py",
                         "relay-ownership")
 
+    # -- ISSUE 15: vote-ingress submit path ------------------------------
+
+    def test_positive_vote_submit_under_window_mutex(self):
+        """The shape _flush_window must never regress to: submitting the
+        packed EntryBlock while still holding the accumulator's window
+        mutex. submit() blocks on the pipeline depth semaphore under
+        backpressure, and the verdict pump needs _mtx to stage the next
+        window — a full stall of live-vote ingress."""
+        src = """
+            def _flush_window(self, key):
+                with self._mtx:
+                    batch = self._windows.pop(key)
+                    fut = self._ensure_verifier().submit(
+                        batch.block, priority=0
+                    )
+                return fut
+        """
+        fs = lint(src, "tendermint_tpu/consensus/fake_ingress.py",
+                  "lock-discipline")
+        assert fs and "depth semaphore" in fs[0].message
+
+    def test_positive_vote_verdict_wait_under_mutex(self):
+        """Waiting for a vote verdict under the VoteSet mutex is the
+        ISSUE-13 shape resurfacing on the consensus side."""
+        src = """
+            def add_vote(self, vote):
+                fut = self._ingress.submit(vote)
+                with self._mtx:
+                    return fut.result(timeout=60)
+        """
+        fs = lint(src, "tendermint_tpu/consensus/fake_ingress.py",
+                  "lock-discipline")
+        assert fs and "_mtx" in fs[0].message
+
+    def test_negative_vote_ingress_stage_then_submit(self):
+        """The real accumulator discipline: stage under _mtx, pop the
+        window, RELEASE, then submit — clean."""
+        src = """
+            def _flush_window(self, key):
+                with self._mtx:
+                    batch = self._windows.pop(key)
+                    self._inflight += 1
+                fut = self._ensure_verifier().submit(
+                    batch.block, priority=0
+                )
+                return fut
+        """
+        assert not lint(src, "tendermint_tpu/consensus/fake_ingress.py",
+                        "lock-discipline")
+
+    def test_negative_executor_pool_submit_under_lock(self):
+        """Executor-pool submits are non-blocking enqueues, not pipeline
+        dispatches — out of shape-4 scope even under a mutex."""
+        src = """
+            def f(self, entries):
+                with self._mtx:
+                    fut = prep_pool.submit(self._prepare, entries)
+                return fut
+        """
+        assert not lint(src, "tendermint_tpu/ops/fake_mod.py",
+                        "lock-discipline")
+
+    def test_positive_vote_mock_wired_from_consensus(self):
+        """mock_vote_prepare is a bench/gate double: wiring it from
+        production consensus code is a relay violation."""
+        src = """
+            from tendermint_tpu.ops._testing import mock_vote_prepare
+
+            def fast_votes(pl):
+                pl.AsyncBatchVerifier._prepare = mock_vote_prepare(
+                    pl.AsyncBatchVerifier._prepare, 0.0
+                )
+        """
+        assert rules_of(
+            lint(src, "tendermint_tpu/consensus/vote_ingress.py",
+                 "relay-ownership")
+        ) == ["relay-ownership"]
+
 
 # ---------------------------------------------------------------------------
 # framework mechanics

@@ -79,6 +79,8 @@ METRIC_KEYS = (
     # chain-replay artifacts (BLOCKSYNC_r*, ISSUE 14)
     "replay_seq_heights_per_s", "kernel_serial_heights_per_s",
     "vs_kernel_serial", "range_hit_rate", "fallback_ranges",
+    # live-vote-ingress artifacts (VOTES_r*, ISSUE 15)
+    "votes_seq_votes_per_s", "window_dups", "memo_hits",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -95,8 +97,8 @@ COMPARE_KEYS = (
     "vs_kernel_serial",
 )
 
-_NAME_RE = re.compile(r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC)_r(\d+)",
-                      re.I)
+_NAME_RE = re.compile(
+    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES)_r(\d+)", re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -212,6 +214,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "LIGHT_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "MEMPOOL_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "BLOCKSYNC_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "VOTES_r*.json")))
     return paths
 
 
@@ -229,7 +232,7 @@ def validate(art: dict) -> List[str]:
         probs.append("; ".join(art["notes"]))
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
-                           "blocksync"):
+                           "blocksync", "votes"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -951,6 +951,161 @@ def run_ingress(args) -> int:
     return rc
 
 
+def run_votes(args) -> int:
+    """--votes: the round-15 live-vote-ingress gate on a mocked relay
+    (slow readback over REAL kernels — verdicts are live). Asserts the
+    three properties device-batched AddVote must hold:
+
+      fuse       N gossiped votes reach the device in <= K launches (the
+                 accumulator windows them by (height, valset epoch), the
+                 coalescer fuses windows) — per-vote dispatch would pay a
+                 full relay RTT each
+      parity     a forged signature mid-flood resolves FALSE and is the
+                 ONLY rejection — blame lands on exactly the forged vote
+      no leak    every vote's verdict arrives (none silently dropped)
+                 and zero buffer-pool slots remain in flight once drained
+    """
+    import threading
+
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.consensus import vote_ingress as vi
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import PREVOTE_TYPE, Vote
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    chain_id = "votes-gate"
+    n_vals, n_rounds, max_batch = 32, 8, 64
+    n_votes = n_vals * n_rounds
+    resolve_delay = 0.15
+    print(f"prep_bench --votes: votes={n_votes} vals={n_vals} "
+          f"rounds={n_rounds} batch={max_batch} "
+          f"resolve_delay={resolve_delay}s")
+    rc = 0
+
+    pairs = []
+    for i in range(n_vals):
+        sk = ed.gen_priv_key(bytes([i + 1]) * 32)
+        pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+    vset = ValidatorSet.new([v for _, v in pairs])
+    by_addr = {v.address: sk for sk, v in pairs}
+    sks = [by_addr[v.address] for v in vset.validators]
+    bid = BlockID(hash=b"\x07" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x07" * 32))
+    height = 10
+
+    pends = []
+    for r in range(n_rounds):
+        for i, sk in enumerate(sks):
+            vote = Vote(
+                type=PREVOTE_TYPE, height=height, round=r, block_id=bid,
+                timestamp=Timestamp(seconds=1_600_000_000, nanos=0),
+                validator_address=vset.validators[i].address,
+                validator_index=i,
+            )
+            msg = vote.sign_bytes(chain_id)
+            vote = Vote(**{**vote.__dict__, "signature": sk.sign(msg)})
+            pends.append(vi.PendingVote(
+                vote, "gate-peer", sk.pub_key().bytes(), msg,
+                t_enq=time.perf_counter(),
+            ))
+    # one forged signature mid-flood: its verdict must be the ONLY False
+    forged_i = n_votes // 2
+    f = pends[forged_i]
+    bad = bytearray(f.vote.signature)
+    bad[0] ^= 0x5A
+    fv = Vote(**{**f.vote.__dict__, "signature": bytes(bad)})
+    pends[forged_i] = vi.PendingVote(fv, f.peer_id, f.pub, f.msg,
+                                     t_enq=f.t_enq)
+
+    _epoch.reset(4)
+    _epoch.note_valset(vset)  # register
+    _epoch.note_valset(vset)  # warm: windows attach val_idx + epoch_key
+    verdicts: dict = {}
+    done = threading.Event()
+
+    def collect(batch, vds, err):
+        for i, p in enumerate(batch):
+            key = (p.vote.round, p.vote.validator_index)
+            verdicts[key] = None if err is not None else bool(vds[i])
+        if len(verdicts) >= n_votes:
+            done.set()
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay)
+    )
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    v = pl.AsyncBatchVerifier(depth=2, pool_depth=OVERLAP_POOL_DEPTH)
+    acc = vi.VoteIngress(collect, verifier=v, max_batch=max_batch,
+                         window_ms=8.0)
+    try:
+        for p in pends:
+            acc.submit(p, vset)
+        acc.flush_now()
+        if not done.wait(timeout=300):
+            print(f"  FAIL: only {len(verdicts)}/{n_votes} verdicts "
+                  "arrived", file=sys.stderr)
+            rc = 1
+        launches = sum(1 for name, *_ in tr.TRACER.events()
+                       if name == "pipeline.dispatch")
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+        stats = acc.stats()
+    finally:
+        tr.configure(enabled=False)
+        acc.close()
+        v.close()
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+
+    # -- fuse: N votes in <= K launches ----------------------------------
+    k_max = n_votes // max_batch + 2  # windows + slack
+    print(f"  votes flooded              : {n_votes}")
+    print(f"  device launches            : {launches} (gate: <= {k_max}, "
+          f"per-vote would be {n_votes})")
+    print(f"  ingress stats              : batches={stats['batches']} "
+          f"sigs={stats['sigs']} sync_fallbacks={stats['sync_fallbacks']}")
+    if launches > k_max:
+        print(f"  FAIL: {launches} launches for {n_votes} votes — vote "
+              "windows are not fusing", file=sys.stderr)
+        rc = 1
+
+    # -- parity: exactly the forged vote rejected ------------------------
+    bad_keys = [k for k, ok in verdicts.items()
+                if ok != ((k[0], k[1]) != (forged_i // n_vals,
+                                           forged_i % n_vals))]
+    n_ok = sum(1 for x in verdicts.values() if x)
+    print(f"  verdicts                   : {n_ok} valid / "
+          f"{len(verdicts) - n_ok} rejected (forged vote at round "
+          f"{forged_i // n_vals} idx {forged_i % n_vals})")
+    if bad_keys:
+        print(f"  FAIL: wrong verdicts at {bad_keys[:4]} — the forged "
+              "vote must be the ONLY rejection", file=sys.stderr)
+        rc = 1
+
+    # -- pool hygiene ----------------------------------------------------
+    print(f"  pool                       : {pool}")
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _build_replay_chain(n_blocks: int, n_vals: int, chain_id: str,
                         rotate_at=()):
     """Fully-linked signed chain for the replay gate: block h+1's
@@ -1228,6 +1383,13 @@ def main() -> int:
         "launches, a forged commit mid-range falls back per-height with "
         "verify_commit_light's exact error, zero pool-slot leak",
     )
+    ap.add_argument(
+        "--votes",
+        action="store_true",
+        help="round-15 gate: device-batched live-vote ingress on a mocked "
+        "relay — N gossiped votes fuse into <= K launches, a forged "
+        "signature mid-flood is the ONLY rejection, zero pool-slot leak",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -1243,6 +1405,8 @@ def main() -> int:
         return run_ingress(args)
     if args.replay:
         return run_replay(args)
+    if args.votes:
+        return run_votes(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

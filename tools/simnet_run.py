@@ -73,6 +73,7 @@ def build_cluster(args, faults, link=None, tracing=None):
         txs_per_node=args.txs,
         n_validators=args.validators or None,
         tracing=tracing,
+        vote_ingress=getattr(args, "vote_ingress", None) or None,
     )
 
 
@@ -326,6 +327,12 @@ def main() -> int:
     ap.add_argument(
         "--generators", default="mixed,churn",
         help="comma list of schedule generators (mixed, churn)",
+    )
+    ap.add_argument(
+        "--vote-ingress", action="store_true",
+        help="attach the stepped live-vote ingress accumulator on every "
+             "node (ISSUE 15) — flush points ride the pump, so runs stay "
+             "replay-exact",
     )
     ap.add_argument("--no-shrink", action="store_true")
     ap.add_argument(

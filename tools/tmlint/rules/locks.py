@@ -28,6 +28,19 @@ Two shapes this repo has been burned by:
    locks built FOR result-collection (pipeline.py's `done_lock`) don't
    false-positive. Wait on futures outside the lock, or hand completion
    to a dedicated thread (mempool/ingress.py's completer).
+
+4. Dispatch `submit()` under a mutex (ISSUE 15): submitting to the
+   shared verifier can BLOCK on the pipeline's depth semaphore when the
+   device queue is full, so a `<verifier>.submit(...)` inside a
+   `with <...mtx...>:` block parks the state mutex across the
+   dispatcher's backpressure — and the verdict callback that would
+   relieve it usually needs that same lock (the vote accumulator's
+   window mutex, the mempool's `_mtx`). The vote-ingress submit path is
+   the reference shape: stage under `_mtx`, pop the window, release,
+   THEN submit (consensus/vote_ingress.py's `_flush_window`). Scoped to
+   verifier-ish receivers ("verifier"/"ingress" in the name, the `_v`
+   handle convention, or a `shared_verifier()`/`_ensure_verifier()`
+   chain) so executor pools (`prep_pool.submit`) stay out of scope.
 """
 
 from __future__ import annotations
@@ -58,6 +71,30 @@ def _ctx_name(expr: ast.AST) -> str:
     if isinstance(expr, ast.Name):
         return expr.id
     return ""
+
+
+# shape-4 scoping: which `.submit()` receivers count as a pipeline
+# dispatch (vs. an executor pool, which is non-blocking bookkeeping)
+_DISPATCH_RECEIVER_SUBSTR = ("verifier", "ingress")
+_DISPATCH_RECEIVER_EXACT = ("_v", "v")
+_DISPATCH_CHAIN_CALLS = ("shared_verifier", "_ensure_verifier")
+
+
+def _is_dispatch_submit(call: ast.Call) -> bool:
+    """`<verifier-ish>.submit(...)` — including the repo's
+    `self._ensure_verifier().submit(...)` / `shared_verifier().submit(...)`
+    lazy-handle chains, whose immediate receiver is a Call, not a Name."""
+    if func_name(call) != "submit":
+        return False
+    recv = receiver_name(call)
+    if recv:
+        low = recv.lower()
+        return (any(s in low for s in _DISPATCH_RECEIVER_SUBSTR)
+                or recv in _DISPATCH_RECEIVER_EXACT)
+    if isinstance(call.func, ast.Attribute) and isinstance(
+            call.func.value, ast.Call):
+        return func_name(call.func.value) in _DISPATCH_CHAIN_CALLS
+    return False
 
 
 def _walk_same_frame(nodes) -> Iterator[ast.AST]:
@@ -139,6 +176,19 @@ class LockDisciplineRule(Rule):
                                 f"deadlock bait if the completing thread "
                                 f"needs {lock}; wait outside the lock or "
                                 f"complete on a dedicated thread",
+                            )
+                        # 4) dispatch submit while holding the mutex
+                        elif (isinstance(sub, ast.Call)
+                                and _is_dispatch_submit(sub)):
+                            yield ctx.finding(
+                                self.name, sub,
+                                f"pipeline `submit()` inside `with {lock}:` "
+                                f"— submit blocks on the dispatcher's depth "
+                                f"semaphore under backpressure, parking "
+                                f"{lock} until the device drains; stage "
+                                f"under the lock, release, then submit "
+                                f"(see consensus/vote_ingress.py "
+                                f"_flush_window)",
                             )
             # 2) thread targets
             if isinstance(node, ast.Call) and func_name(node) == "Thread":

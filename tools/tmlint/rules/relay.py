@@ -68,6 +68,7 @@ ENTRY_POINTS = frozenset({
     "mock_light_prepare",
     "mock_mesh_prepare",
     "mock_mempool_prepare",
+    "mock_vote_prepare",
     "slow_prepare",
     "slow_mesh_prepare",
 })
