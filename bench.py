@@ -2123,6 +2123,116 @@ def votes_main(argv) -> None:
             fh.write("\n")
 
 
+def soak_main(argv) -> None:
+    """`bench.py soak` — one cluster, all four workloads, SLO verdict
+    (ISSUE 16).
+
+    Runs the simnet soak harness (tendermint_tpu/simnet/soak.py): a live
+    consensus cluster drives commit-echo verification, light-client
+    request fleets, signed-tx floods through a partition/heal fault, and
+    a crash-rejoin catch-up — all through ONE shared AsyncBatchVerifier
+    — for a configurable virtual duration, with time-series telemetry
+    sampled on the virtual clock and declarative per-lane SLO budgets
+    evaluated at the end. The relay is MOCKED by default
+    (mock_mempool_prepare: real packing, host prep and transfer; the
+    launch's all-accept verdict matures rtt_ms after launch), so the
+    bench measures the harness and the QoS queue, not kernel time;
+    --real runs live kernels.
+
+    Prints ONE JSON summary line; --out writes the FULL artifact
+    (SOAK_r*.json, schema_version 1: per-lane latency percentiles over
+    time windows, gauge time series, final SLO verdict — rendered by
+    tools/soak_report.py, gated by tools/bench_report.py --compare).
+    Exits nonzero when the verdict is not green."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py soak")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="virtual seconds of combined load (default 30)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--catchup-at", type=int, default=0,
+                    help="hold the catch-up replay until the live tip "
+                    "reaches this height, so the node rejoins N heights "
+                    "behind (0 = chase immediately)")
+    ap.add_argument("--sample-s", type=float, default=1.0,
+                    help="telemetry sampler cadence, virtual s (default 1)")
+    ap.add_argument("--rtt-ms", type=float, default=4.0,
+                    help="mocked relay round-trip per launch (default 4)")
+    ap.add_argument("--real", action="store_true",
+                    help="run live kernels instead of the mocked relay")
+    ap.add_argument("--max-wall-s", type=float, default=1800.0)
+    ap.add_argument("--out", default="",
+                    help="also write the full artifact JSON to this path")
+    args = ap.parse_args(argv)
+
+    from tendermint_tpu.libs import jaxcache
+
+    import jax
+
+    jaxcache.enable(jax, os.path.dirname(os.path.abspath(__file__)))
+
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.ops._testing import drain_pool, mock_mempool_prepare
+    from tendermint_tpu.simnet.soak import SoakConfig, SoakDriver
+
+    real_prepare = _pl.AsyncBatchVerifier._prepare
+    if not args.real:
+        _pl.AsyncBatchVerifier._prepare = staticmethod(
+            mock_mempool_prepare(real_prepare, args.rtt_ms / 1e3)
+        )
+        os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    v = _pl.AsyncBatchVerifier(depth=2)
+    try:
+        cfg = SoakConfig.from_env(
+            duration_s=args.duration, seed=args.seed, n_nodes=args.nodes,
+            sample_every_s=args.sample_s, max_wall_s=args.max_wall_s,
+            catchup_at_height=args.catchup_at or None,
+        )
+        rec = SoakDriver(v, cfg).run()
+        leaked = None
+        if not args.real:
+            drain_pool(v._pool)
+            leaked = v._pool.stats()["in_flight"]
+    finally:
+        v.close()
+        if not args.real:
+            os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        _pl.AsyncBatchVerifier._prepare = real_prepare
+
+    rec["mode"] = "real" if args.real else "mocked-relay"
+    rec["relay_rtt_ms"] = args.rtt_ms if not args.real else None
+    rec["backend"] = os.environ.get("JAX_PLATFORMS", "") or "cpu"
+    rec["pool_slots_leaked"] = leaked
+    # the ratchet block (tools/bench_report.py SOAK kind): direction-
+    # aware compare keys — the p99s regress on RISE, heights/s on FALL
+    lp = rec.get("lane_percentiles", {})
+    rec["metric"] = "soak_slo_ok"
+    rec["value"] = 1 if rec["ok"] else 0
+    rec["unit"] = "verdict"
+    rec["consensus_commit_p99_ms"] = lp.get("consensus", {}).get("p99_ms")
+    rec["light_verdict_p99_ms"] = lp.get("light", {}).get("p99_ms")
+    rec["ingress_admission_p99_ms"] = lp.get("ingress", {}).get("p99_ms")
+    summary = {
+        k: rec.get(k)
+        for k in (
+            "schema_version", "metric", "value", "unit", "ok", "reason",
+            "mode", "relay_rtt_ms", "backend", "seed", "duration_s",
+            "virtual_s", "wall_s", "heights", "sampler_ticks",
+            "consensus_commit_p99_ms", "light_verdict_p99_ms",
+            "ingress_admission_p99_ms", "replay_heights_per_s",
+            "pool_slots_leaked",
+        )
+    }
+    print(json.dumps(summary, default=str))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rec, fh, indent=1, default=str)
+            fh.write("\n")
+    if not rec["ok"] or leaked:
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip"]:
         multichip_main(sys.argv[2:])
@@ -2134,6 +2244,8 @@ if __name__ == "__main__":
         blocksync_main(sys.argv[2:])
     elif sys.argv[1:2] == ["votes"]:
         votes_main(sys.argv[2:])
+    elif sys.argv[1:2] == ["soak"]:
+        soak_main(sys.argv[2:])
     elif os.environ.get("TM_TPU_BENCH_WORKER") == "1":
         worker()
     else:

@@ -72,6 +72,16 @@ class _Metric:
         with self._mtx:
             return dict(self._values)
 
+    def sample(self) -> dict:
+        """Plain-dict point-in-time read for Registry.snapshot(): label
+        strings (exposition-format, e.g. 'lane="ingress"'; '' for the
+        unlabeled series) -> current value. Lock-safe, no text parsing."""
+        with self._mtx:
+            return {
+                "type": self.type,
+                "values": {_fmt_labels(k): v for k, v in self._values.items()},
+            }
+
 
 class Counter(_Metric):
     def __init__(self, name: str, help_: str = ""):
@@ -182,6 +192,21 @@ class Histogram(_Metric):
         with self._mtx:
             return sum(s[1] for s in self._series.values())
 
+    def sample(self) -> dict:
+        """Histogram shape of _Metric.sample(): per-labelset sum/count
+        plus raw (non-cumulative) bucket counts, keyed like sample()."""
+        with self._mtx:
+            return {
+                "type": self.type,
+                "buckets": list(self.buckets),
+                "series": {
+                    _fmt_labels(k): {
+                        "sum": s[1], "count": s[2], "bucket_counts": list(s[0]),
+                    }
+                    for k, s in self._series.items()
+                },
+            }
+
 
 class Registry:
     def __init__(self, namespace: str = "tendermint"):
@@ -230,6 +255,23 @@ class Registry:
         for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """Lock-safe structured read of every metric: name -> sample()
+        dict. Runs the collect hooks first (same contract as expose(), so
+        pull-style gauges are fresh), then reads each metric under its
+        own lock. The soak sampler and /status handlers consume this
+        instead of re-parsing exposition text; expose() stays the only
+        text path and its bytes are untouched."""
+        with self._mtx:
+            hooks = list(self._collect_hooks)
+            metrics = list(self._metrics)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a snapshot must never throw
+                pass
+        return {m.name: m.sample() for m in metrics}
 
 
 class ConsensusMetrics:
@@ -521,6 +563,17 @@ class OpsMetrics:
             "ops", "mesh_pad_waste_ratio",
             "Identity-padding fraction of the last mesh superbatch.",
         )
+        # QoS lane queue wait (ISSUE 16): seconds a prepared batch sat in
+        # the dispatch queue before winning its launch slot, by lane.
+        # Before this, only the consensus lane's wait was observable (via
+        # pipeline.queue_wait spans) — ingress starvation was invisible
+        # to a scrape.
+        self.queue_wait_seconds = registry.histogram(
+            "ops", "queue_wait_seconds",
+            "Dispatch-queue wait before launch, by QoS lane label "
+            "(consensus|replay|ingress).",
+            buckets=self._TIME_BUCKETS, labeled=True,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -649,6 +702,15 @@ def ops_stats() -> dict:
         "buffer_pool_misses": int(m.buffer_pool_misses.total()),
         "mesh_lane_occupancy": float(m.mesh_lane_occupancy.value()),
         "mesh_pad_waste_ratio": float(m.mesh_pad_waste_ratio.value()),
+        # per-QoS-lane dispatch-queue wait (ISSUE 16) — sits next to the
+        # lane_counts() intake split in /status verify_engine
+        "queue_wait_by_lane": {
+            (dict(k).get("lane", "") or "unlabeled"): {
+                "count": int(c),
+                "avg_ms": (s / c * 1000.0) if c else 0.0,
+            }
+            for k, (s, c) in m.queue_wait_seconds.snapshot().items()
+        },
     }
 
 

@@ -1,0 +1,333 @@
+"""Time-series telemetry + declarative SLO budgets (ISSUE 16).
+
+The soak harness needs three instruments the repo already half-has:
+
+- **TelemetrySampler** — snapshots the existing gauge/counter surfaces
+  (`Registry.snapshot()`, no exposition-text parsing) on a SimClock
+  cadence into bounded rings. Tick scheduling rides `clock.call_later`,
+  so the tick count and timestamps are pure functions of the virtual
+  duration and cadence — deterministic under replay even though some
+  sampled VALUES (wall-clock-derived counters) are not.
+- **LatencyRecorder** — per-lane latency samples stamped with the
+  virtual submit time (for windowing/localization) and the wall time
+  (for correlating a breach window with tracer spans).
+- **SLOBudget / evaluate_slos** — declarative per-lane budgets
+  (latency p99 ceilings, rate floors) evaluated over the recorder;
+  a breach is localized to the worst time window and, when span data
+  is available, to the dominating span category inside that window.
+
+Per-workload latency attribution sources: `HeightTimeline` rings give
+the consensus lane's per-height commit latency in VIRTUAL seconds
+(deterministic); the wall-clock tracer's `pipeline.*` spans (mesh_pack,
+transfer, dispatch, queue_wait, device.wait) attribute where a wall
+latency breach actually went.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..libs import metrics as _metrics
+
+# Metric surfaces the sampler tracks by default — the ISSUE 16 list:
+# epoch-cache traffic, dispatch/pipeline depth, transfer overlap, pool
+# recycling, CheckTx preemptions, mesh packing efficiency.
+DEFAULT_SERIES = (
+    "tendermint_ops_epoch_cache_hits_total",
+    "tendermint_ops_epoch_cache_misses_total",
+    "tendermint_ops_epoch_cache_evictions_total",
+    "tendermint_ops_dispatch_queue_depth",
+    "tendermint_ops_pipeline_queue_depth",
+    "tendermint_ops_pipeline_inflight",
+    "tendermint_ops_dispatch_busy_ratio",
+    "tendermint_ops_transfer_overlap_ratio",
+    "tendermint_ops_buffer_pool_hits_total",
+    "tendermint_ops_buffer_pool_misses_total",
+    "tendermint_mempool_checktx_preemptions",
+    "tendermint_ops_mesh_lane_occupancy",
+    "tendermint_ops_mesh_pad_waste_ratio",
+)
+
+
+def _scalar(sample: dict) -> float:
+    """Collapse one Registry.snapshot() metric entry to a scalar: sum
+    across labelsets for counters/gauges, observation count for
+    histograms (their sums/percentiles have dedicated readers)."""
+    if sample.get("type") == "histogram":
+        return float(sum(s["count"] for s in sample.get("series", {}).values()))
+    return float(sum(sample.get("values", {}).values()))
+
+
+class TelemetrySampler:
+    """Bounded-ring gauge sampler on an injected (virtual) clock.
+
+    `start()` schedules the first tick one cadence out; every tick
+    re-schedules itself until `stop()`. Ticks read `registry.snapshot()`
+    plus any registered extra sources (callables returning a float —
+    e.g. a lane_counts() split) and append `(virtual_t, value)` to each
+    series' ring. Ring capacity bounds memory for arbitrarily long
+    soaks; `ticks` counts every tick ever taken (cadence determinism is
+    `ticks == floor(duration / cadence)` — the prep_bench gate).
+    """
+
+    def __init__(self, clock, *, cadence_s: float = 1.0,
+                 capacity: int = 600, registry=None,
+                 series: Sequence[str] = DEFAULT_SERIES,
+                 extra_sources: Optional[Dict[str, Callable[[], float]]] = None):
+        self._clock = clock
+        self.cadence_s = float(cadence_s)
+        self.capacity = int(capacity)
+        self._registry = registry  # None -> global_registry() at tick time
+        self._names = tuple(series)
+        self._extra: Dict[str, Callable[[], float]] = dict(extra_sources or {})
+        self._rings: Dict[str, collections.deque] = {}
+        self.ticks = 0
+        self._stopped = False
+
+    def add_source(self, name: str, fn: Callable[[], float]) -> None:
+        self._extra[name] = fn
+
+    def start(self) -> None:
+        self._clock.call_later(self.cadence_s, self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _append(self, name: str, t: float, v: float) -> None:
+        ring = self._rings.get(name)
+        if ring is None:
+            ring = self._rings[name] = collections.deque(maxlen=self.capacity)
+        ring.append((t, v))
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        self.ticks += 1
+        t = self._clock.time()
+        reg = self._registry if self._registry is not None \
+            else _metrics.global_registry()
+        snap = reg.snapshot()
+        for name in self._names:
+            s = snap.get(name)
+            if s is not None:
+                self._append(name, t, _scalar(s))
+        for name, fn in self._extra.items():
+            try:
+                v = float(fn())
+            except Exception:  # noqa: BLE001 — a source must not kill ticks
+                continue
+            self._append(name, t, v)
+        self._clock.call_later(self.cadence_s, self._tick)
+
+    def series(self) -> Dict[str, List[Tuple[float, float]]]:
+        return {name: list(ring) for name, ring in self._rings.items()}
+
+
+# ---------------------------------------------------------------------------
+# Latency samples + percentiles + windows
+# ---------------------------------------------------------------------------
+
+
+def percentile(vals: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile over an unsorted sample list."""
+    if not vals:
+        return 0.0
+    sv = sorted(vals)
+    k = (len(sv) - 1) * q
+    lo = int(k)
+    hi = min(lo + 1, len(sv) - 1)
+    frac = k - lo
+    return sv[lo] * (1 - frac) + sv[hi] * frac
+
+
+class LatencyRecorder:
+    """Per-lane latency samples: (t_virtual, latency_ms, t_wall).
+
+    `t_virtual` places the sample on the run's deterministic timeline
+    (windowing, breach localization); `t_wall` (the recording clock's
+    perf_counter reading, 0.0 when not supplied) lets a breach window be
+    correlated with wall-clock tracer spans. Bounded per lane.
+    """
+
+    def __init__(self, capacity_per_lane: int = 200_000):
+        self._cap = int(capacity_per_lane)
+        self._by_lane: Dict[str, collections.deque] = {}
+
+    def record(self, lane: str, t_virtual: float, latency_ms: float,
+               t_wall: float = 0.0) -> None:
+        ring = self._by_lane.get(lane)
+        if ring is None:
+            ring = self._by_lane[lane] = collections.deque(maxlen=self._cap)
+        ring.append((float(t_virtual), float(latency_ms), float(t_wall)))
+
+    def lanes(self) -> List[str]:
+        return list(self._by_lane)
+
+    def samples(self, lane: str) -> List[Tuple[float, float, float]]:
+        return list(self._by_lane.get(lane, ()))
+
+    def latencies(self, lane: str) -> List[float]:
+        return [ms for _, ms, _ in self._by_lane.get(lane, ())]
+
+
+def window_stats(samples: Sequence[Tuple[float, float, float]],
+                 window_s: float) -> List[dict]:
+    """Bucket (t_virtual, ms, t_wall) samples into fixed windows aligned
+    to the earliest sample; per-window count/p50/p99 plus the wall-time
+    extent covered by the window's samples (for span correlation)."""
+    if not samples:
+        return []
+    w = max(float(window_s), 1e-9)
+    t_base = min(t for t, _, _ in samples)
+    buckets: Dict[int, List[Tuple[float, float, float]]] = {}
+    for t, ms, tw in samples:
+        buckets.setdefault(int((t - t_base) / w), []).append((t, ms, tw))
+    out = []
+    for i in sorted(buckets):
+        grp = buckets[i]
+        lats = [ms for _, ms, _ in grp]
+        walls = [tw for _, _, tw in grp if tw > 0.0]
+        ends = [tw + ms / 1e3 for _, ms, tw in grp if tw > 0.0]
+        out.append({
+            "t0": t_base + i * w,
+            "t1": t_base + (i + 1) * w,
+            "count": len(grp),
+            "p50_ms": percentile(lats, 0.50),
+            "p99_ms": percentile(lats, 0.99),
+            "max_ms": max(lats),
+            "wall_range": [min(walls), max(ends)] if walls else None,
+        })
+    return out
+
+
+def timeline_latencies(timelines: Sequence[dict]
+                       ) -> List[Tuple[float, float, float]]:
+    """LatencyRecorder-shaped samples from HeightTimeline dicts: one
+    (t_applied_virtual, total_ms, 0.0) per fully-applied height — the
+    consensus lane's commit latency, in deterministic virtual time."""
+    out = []
+    for tl in timelines:
+        total = tl.get("total_s")
+        t_applied = tl.get("t_applied")
+        if total is None or t_applied is None:
+            continue
+        out.append((float(t_applied), float(total) * 1e3, 0.0))
+    return out
+
+
+def attribute_spans(events: Sequence[tuple],
+                    wall_range: Optional[Sequence[float]] = None
+                    ) -> Dict[str, dict]:
+    """Aggregate SpanTracer records (5-tuples: name, start, end, tid,
+    args) by span name — total/count ms, sorted nothing, plain dict.
+    With `wall_range=[w0, w1]`, only spans overlapping that interval
+    count: that is how a breach window names its dominating category."""
+    agg: Dict[str, dict] = {}
+    w0, w1 = (wall_range if wall_range else (None, None))
+    for rec in events:
+        name, start, end = rec[0], rec[1], rec[2]
+        if w0 is not None and (end < w0 or start > w1):
+            continue
+        a = agg.get(name)
+        if a is None:
+            a = agg[name] = {"count": 0, "total_ms": 0.0}
+        a["count"] += 1
+        a["total_ms"] += (end - start) * 1e3
+    return agg
+
+
+def dominant_span(agg: Dict[str, dict]) -> Optional[str]:
+    """The span category carrying the most total time (pipeline.* spans
+    preferred — they name a stage of the verify engine, which is what a
+    lane-latency breach wants attributed)."""
+    if not agg:
+        return None
+    pipeline = {k: v for k, v in agg.items() if k.startswith("pipeline.")}
+    pool = pipeline or agg
+    return max(pool.items(), key=lambda kv: (kv[1]["total_ms"], kv[0]))[0]
+
+
+# ---------------------------------------------------------------------------
+# Declarative SLO budgets
+# ---------------------------------------------------------------------------
+
+KIND_P99_MS_MAX = "p99_ms_max"   # breach when observed p99 RISES past limit
+KIND_RATE_MIN = "rate_min"       # breach when observed rate FALLS below limit
+
+
+@dataclass
+class SLOBudget:
+    """One declarative budget: `lane` names a LatencyRecorder lane (for
+    p99 kinds) or a key in the `rates` dict (for rate floors)."""
+
+    name: str
+    lane: str
+    kind: str
+    limit: float
+    min_samples: int = 1  # p99 over fewer samples than this is a breach
+    description: str = ""
+
+
+def evaluate_slos(budgets: Sequence[SLOBudget], recorder: LatencyRecorder,
+                  rates: Optional[Dict[str, float]] = None,
+                  window_s: float = 5.0,
+                  span_events: Optional[Sequence[tuple]] = None
+                  ) -> List[dict]:
+    """One verdict dict per budget. Latency breaches are localized to the
+    worst window (max p99) and, when `span_events` is supplied, carry the
+    dominating span category overlapping that window's wall extent."""
+    rates = rates or {}
+    out = []
+    for b in budgets:
+        v = {
+            "slo": b.name, "lane": b.lane, "kind": b.kind,
+            "limit": b.limit, "ok": True, "observed": None,
+        }
+        if b.kind == KIND_RATE_MIN:
+            observed = rates.get(b.lane)
+            v["observed"] = observed
+            v["ok"] = observed is not None and observed >= b.limit
+        elif b.kind == KIND_P99_MS_MAX:
+            samples = recorder.samples(b.lane)
+            v["samples"] = len(samples)
+            if len(samples) < b.min_samples:
+                v["ok"] = False
+                v["reason"] = (f"only {len(samples)} samples "
+                               f"(min {b.min_samples}) — lane starved or idle")
+            else:
+                observed = percentile([ms for _, ms, _ in samples], 0.99)
+                v["observed"] = observed
+                v["ok"] = observed <= b.limit
+            if not v["ok"] and samples:
+                wins = window_stats(samples, window_s)
+                worst = max(wins, key=lambda wd: wd["p99_ms"])
+                v["breach_window"] = {
+                    "t0": worst["t0"], "t1": worst["t1"],
+                    "count": worst["count"], "p99_ms": worst["p99_ms"],
+                }
+                if span_events is not None:
+                    agg = attribute_spans(span_events, worst["wall_range"])
+                    dom = dominant_span(agg)
+                    if dom is not None:
+                        v["breach_window"]["dominant_span"] = dom
+                        v["breach_window"]["span_totals_ms"] = {
+                            k: round(a["total_ms"], 3)
+                            for k, a in sorted(agg.items())
+                        }
+        else:
+            v["ok"] = False
+            v["reason"] = f"unknown SLO kind {b.kind!r}"
+        out.append(v)
+    return out
+
+
+def slo_verdict(results: Sequence[dict]) -> dict:
+    breaches = [r for r in results if not r["ok"]]
+    return {
+        "ok": not breaches,
+        "evaluated": len(results),
+        "breaches": breaches,
+        "results": list(results),
+    }

@@ -122,6 +122,14 @@ PRIORITY_CONSENSUS = 0
 PRIORITY_REPLAY = 1
 PRIORITY_INGRESS = 2
 
+# lane label per priority class — the queue_wait_seconds histogram and
+# lane_counts() speak the same vocabulary (ISSUE 16)
+_LANE_NAMES = {
+    PRIORITY_CONSENSUS: "consensus",
+    PRIORITY_REPLAY: "replay",
+    PRIORITY_INGRESS: "ingress",
+}
+
 
 class _PriorityQueue:
     """Priority-ordered hand-off queue (ISSUE 13): items pop in
@@ -1071,7 +1079,29 @@ class AsyncBatchVerifier:
                 # one launch slot the tx flood can never fill.
                 requeued = False
                 if pri > PRIORITY_CONSENSUS and self._depth > 1:
-                    while not self._ing_sem.acquire(timeout=0.002):
+                    # test seam (ISSUE 16, gated like the alias/owner
+                    # seams): with the "starve" lintbug armed the
+                    # reserved-lane semaphore is broken for ingress —
+                    # its acquire never succeeds, so tx batches park
+                    # here forever while consensus/replay keep
+                    # overtaking. The soak harness must catch this via
+                    # its ingress-admission SLO, not by luck.
+                    starved = (pri >= PRIORITY_INGRESS
+                               and _devcheck.inject_lintbug("starve"))
+                    while starved or not self._ing_sem.acquire(timeout=0.002):
+                        if starved:
+                            time.sleep(0.002)
+                        if self._stopped.is_set():
+                            # shutdown while parked: fail the batch and
+                            # return its slot instead of wedging close()
+                            self._pool.release(slot)
+                            slot = None
+                            self._fail_spans(
+                                spans, self._wrap_dispatch_err(
+                                    "pipeline stopped while queued",
+                                    RuntimeError("shutdown"), bucket, spans))
+                            requeued = True
+                            break
                         best = self._dispatch_q.best_priority()
                         if best is not None and best < pri:
                             self._dispatch_q.put(
@@ -1104,6 +1134,15 @@ class AsyncBatchVerifier:
                     continue
                 sem_held = True
                 t0 = time.perf_counter()
+                # per-QoS-lane queue wait (ISSUE 16): the scrapeable
+                # counterpart of the queue_wait span — ingress starvation
+                # shows up here as a fat ingress tail, visible to /status
+                # and the soak sampler without tracing enabled
+                m.queue_wait_seconds.observe(
+                    max(t0 - max(t_enq, t_xfer_done), 0.0),
+                    lane=_LANE_NAMES.get(min(pri, PRIORITY_INGRESS),
+                                         "ingress"),
+                )
                 if _trace.TRACER.enabled:
                     _trace.TRACER.record(
                         "pipeline.queue_wait",

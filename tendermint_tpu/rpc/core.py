@@ -226,6 +226,14 @@ class Environment:
         stats = ops_stats()
         stats["tracing"] = _trace.TRACER.enabled
         stats["trace_spans_recorded"] = _trace.TRACER.recorded_total
+        # ISSUE 16: the per-lane intake split next to the per-lane
+        # queue-wait histogram summary (queue_wait_by_lane, from
+        # ops_stats) — a scrape now sees ingress starvation directly.
+        # Same no-spin-up rule as _vote_ingress_stats.
+        from ..ops import pipeline as _pl
+
+        if _pl._shared is not None:
+            stats["lane_counts"] = _pl._shared.lane_counts()
         return stats
 
     def _own_voting_power(self) -> int:

@@ -43,13 +43,17 @@ class CatchupDriver:
     def __init__(self, cluster, node_idx: int, *, window: Optional[int] = None,
                  drop: float = 0.0, interval: float = 0.05,
                  rejoin_gap: int = 2, start_after: float = 1.0,
-                 start_at_height: Optional[int] = None):
+                 start_at_height: Optional[int] = None, verifier=None):
         from ..blocksync.replay import ReplayEngine
 
         self.cluster = cluster
         self.node = cluster.nodes[node_idx]
         self.rng = random.Random(cluster.seed * 1_000_003 + node_idx + 0xCA7)
-        self.engine = ReplayEngine(window=window, synchronous=True)
+        # verifier: injected AsyncBatchVerifier (the soak harness passes
+        # its shared engine so replay traffic rides the same QoS queue as
+        # every other lane); None keeps the shared_verifier() default
+        self.engine = ReplayEngine(window=window, synchronous=True,
+                                   verifier=verifier)
         self.drop = float(drop)
         self.interval = float(interval)
         self.rejoin_gap = int(rejoin_gap)
@@ -58,6 +62,10 @@ class CatchupDriver:
         # (the node crashes early; replay begins once the gap exists)
         self.start_at_height = start_at_height
         self.behind_at_start: Optional[int] = None
+        # virtual timestamp of the first real replay step — the soak
+        # harness divides heights_applied by (rejoined_at - this) for
+        # its replay heights/s SLO floor (ISSUE 16)
+        self.replay_began_at: Optional[float] = None
         self.steps = 0
         self.fetches = 0          # blocks actually read from a peer store
         self.dropped_requests = 0  # range requests lost to the link model
@@ -157,6 +165,7 @@ class CatchupDriver:
                 self.behind_at_start = (
                     peer.height() - self._state.last_block_height
                 )
+                self.replay_began_at = c.clock.time()
             if peer is not None:
                 mine = self._state.last_block_height
                 if (peer.height() - mine <= self.rejoin_gap
@@ -207,5 +216,6 @@ class CatchupDriver:
             "dropped_requests": self.dropped_requests,
             "rejoined": self.rejoined_at is not None,
             "rejoined_at_virtual_s": self.rejoined_at,
+            "replay_began_at_virtual_s": self.replay_began_at,
             "failed": list(self.failed),
         }

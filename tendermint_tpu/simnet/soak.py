@@ -1,0 +1,603 @@
+"""Soak harness (ISSUE 16): all four QoS tiers against ONE verifier.
+
+`SoakDriver` runs a single cluster for a configurable VIRTUAL duration
+and drives combined load through one shared `AsyncBatchVerifier`:
+
+- **consensus** — the cluster commits heights normally (stepped
+  consensus on the virtual clock); per-height commit latency is
+  harvested from `HeightTimeline` rings in deterministic virtual time.
+  A "commit echo" additionally re-verifies each freshly committed
+  height's commit through the shared engine at `PRIORITY_CONSENSUS`,
+  so the consensus lane carries real device traffic.
+- **light** — request fleets verify the cluster's OWN recent headers
+  against a height-1 trusted anchor through `LightVerifyService`
+  (shared epoch-cache coupling with every other lane).
+- **ingress** — signed-tx floods through an `IngressAccumulator`,
+  timed per burst with a hard admission timeout, running straight
+  through a mid-soak partition/heal fault.
+- **replay** — a node crashed early rejoins via `CatchupDriver`
+  (optionally from 1000+ heights behind with `catchup_at_height`),
+  its ReplayEngine injected with the SAME shared verifier.
+
+A `TelemetrySampler` snapshots the gauge/counter surfaces on a SimClock
+cadence; declarative `SLOBudget`s (consensus commit p99, light verdict
+p99, ingress admission p99, replay heights/s floor) are evaluated at
+the end — any breach, devcheck violation, or invariant failure makes
+the run conclusively NOT ok, with the flight-recorder tail attached.
+
+Determinism contract (simnet-determinism lint applies to this module):
+every driver tick rides `SimClock.call_later`, so the event ORDER —
+and therefore fingerprint and `schedule_digest()` — is a pure function
+of (seed, config). Wall-clock latencies (`time.perf_counter`) are
+measured INSIDE callbacks and feed only the wall SLO budgets; in a
+healthy run no wall reading changes what gets scheduled. The only
+wall-dependent branch is the fail-fast abort on an admission/verdict
+TIMEOUT — which only fires when the run is already conclusively
+failing its SLO.
+
+Env knobs (all optional; config fields win when passed explicitly):
+TM_TPU_SOAK_DURATION, TM_TPU_SOAK_NODES, TM_TPU_SOAK_SEED,
+TM_TPU_SOAK_SAMPLE_S, TM_TPU_SOAK_WARMUP_S, TM_TPU_SOAK_TX_BURST,
+TM_TPU_SOAK_LIGHT_FLEET, TM_TPU_SOAK_INGRESS_TIMEOUT_S,
+TM_TPU_SOAK_CATCHUP_AT_HEIGHT, TM_TPU_SOAK_CONSENSUS_P99_MS,
+TM_TPU_SOAK_LIGHT_P99_MS, TM_TPU_SOAK_INGRESS_P99_MS,
+TM_TPU_SOAK_REPLAY_HPS, TM_TPU_SOAK_MAX_WALL_S.
+"""
+
+from __future__ import annotations
+
+import os
+import time  # perf_counter only — wall latency; virtual time is SimClock's
+from concurrent import futures as _cfut
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..observability import timeseries as _ts
+from .faults import Fault
+from .harness import Cluster
+
+SCHEMA_VERSION = 1
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else float(default)
+
+
+def _env_i(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw else int(default)
+
+
+@dataclass
+class SoakConfig:
+    """Everything a soak run depends on, in one replayable record."""
+
+    # run shape
+    duration_s: float = 30.0          # virtual
+    n_nodes: int = 4
+    seed: int = 0
+    warmup_s: float = 2.0             # samples before t0+warmup skip SLOs
+    max_wall_s: Optional[float] = 600.0
+    fail_fast: bool = True
+    # telemetry
+    sample_every_s: float = 1.0
+    sample_capacity: int = 4096
+    slo_window_s: float = 5.0
+    # consensus lane (timeline harvest + commit echo)
+    harvest_every_s: float = 1.0
+    echo_every_s: float = 0.5
+    echo_max_per_tick: int = 4
+    echo_timeout_s: float = 60.0
+    # light lane
+    light_every_s: float = 1.0
+    light_fleet: int = 3
+    light_timeout_s: float = 60.0
+    # ingress lane
+    tx_every_s: float = 0.5
+    tx_burst: int = 6
+    tx_senders: int = 4
+    ingress_timeout_s: float = 15.0
+    # replay lane (crash + catch-up)
+    catchup_crash_at_s: float = 1.0
+    catchup_at_height: Optional[int] = None  # hold replay until tip >= this
+    catchup_window: Optional[int] = None
+    catchup_interval: float = 0.05
+    # partition/heal across the tx flood (partition_at_s <= 0 disables)
+    partition_at_s: float = 6.0
+    partition_heal_s: float = 3.0
+    # SLO budgets
+    consensus_commit_p99_ms: float = 15000.0  # VIRTUAL ms (partition stall fits)
+    light_verdict_p99_ms: float = 30000.0     # wall
+    ingress_admission_p99_ms: float = 10000.0  # wall
+    replay_min_heights_per_s: float = 10.0    # virtual heights/s
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SoakConfig":
+        cfg = cls(
+            duration_s=_env_f("TM_TPU_SOAK_DURATION", cls.duration_s),
+            n_nodes=_env_i("TM_TPU_SOAK_NODES", cls.n_nodes),
+            seed=_env_i("TM_TPU_SOAK_SEED", cls.seed),
+            warmup_s=_env_f("TM_TPU_SOAK_WARMUP_S", cls.warmup_s),
+            sample_every_s=_env_f("TM_TPU_SOAK_SAMPLE_S", cls.sample_every_s),
+            tx_burst=_env_i("TM_TPU_SOAK_TX_BURST", cls.tx_burst),
+            light_fleet=_env_i("TM_TPU_SOAK_LIGHT_FLEET", cls.light_fleet),
+            ingress_timeout_s=_env_f("TM_TPU_SOAK_INGRESS_TIMEOUT_S",
+                                     cls.ingress_timeout_s),
+            consensus_commit_p99_ms=_env_f("TM_TPU_SOAK_CONSENSUS_P99_MS",
+                                           cls.consensus_commit_p99_ms),
+            light_verdict_p99_ms=_env_f("TM_TPU_SOAK_LIGHT_P99_MS",
+                                        cls.light_verdict_p99_ms),
+            ingress_admission_p99_ms=_env_f("TM_TPU_SOAK_INGRESS_P99_MS",
+                                            cls.ingress_admission_p99_ms),
+            replay_min_heights_per_s=_env_f("TM_TPU_SOAK_REPLAY_HPS",
+                                            cls.replay_min_heights_per_s),
+            max_wall_s=_env_f("TM_TPU_SOAK_MAX_WALL_S", cls.max_wall_s),
+        )
+        gap = os.environ.get("TM_TPU_SOAK_CATCHUP_AT_HEIGHT", "")
+        if gap:
+            cfg.catchup_at_height = int(gap)
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise TypeError(f"unknown SoakConfig field {k!r}")
+            setattr(cfg, k, v)
+        return cfg
+
+
+class SoakDriver:
+    """One cluster, all four workloads, one shared verifier.
+
+    The caller OWNS the verifier (constructs it, closes it after
+    `run()`); the driver owns the cluster, the light service, and the
+    ingress accumulator, and tears those down in run()'s finally.
+    """
+
+    def __init__(self, verifier, config: Optional[SoakConfig] = None):
+        from .catchup import CatchupDriver
+
+        self.cfg = cfg = config or SoakConfig.from_env()
+        self.v = verifier
+        self._catchup_node = cfg.n_nodes - 1
+        faults = [Fault(kind="crash", at_time=cfg.catchup_crash_at_s,
+                        node=self._catchup_node)]
+        if cfg.partition_at_s > 0:
+            # split WITHOUT a quorum on either side (the catch-up node is
+            # already crashed): commits stall for partition_heal_s, then
+            # heal — the degradation the consensus SLO must absorb and
+            # the ingress lane must ride through
+            half = max(cfg.n_nodes // 2, 1)
+            faults.append(Fault(
+                kind="partition", at_time=cfg.partition_at_s,
+                groups=[list(range(half)), list(range(half, cfg.n_nodes))],
+                duration=cfg.partition_heal_s,
+            ))
+        self.cluster = Cluster(n_nodes=cfg.n_nodes, seed=cfg.seed,
+                               faults=faults, vote_ingress=True,
+                               sig_memo=True)
+        self.catchup = CatchupDriver(
+            self.cluster, self._catchup_node, window=cfg.catchup_window,
+            interval=cfg.catchup_interval,
+            start_after=cfg.catchup_crash_at_s + 0.5,
+            start_at_height=cfg.catchup_at_height, verifier=verifier,
+        )
+        self._rec = _ts.LatencyRecorder()
+        self.sampler = _ts.TelemetrySampler(
+            self.cluster.clock, cadence_s=cfg.sample_every_s,
+            capacity=cfg.sample_capacity,
+        )
+        lanes = verifier.lane_counts
+        self.sampler.add_source(
+            "verify_lane_consensus", lambda: lanes().get("consensus", 0))
+        self.sampler.add_source(
+            "verify_lane_replay", lambda: lanes().get("replay", 0))
+        self.sampler.add_source(
+            "verify_lane_ingress", lambda: lanes().get("ingress", 0))
+        pool = getattr(verifier, "_pool", None)
+        if pool is not None:
+            self.sampler.add_source(
+                "pool_in_flight",
+                lambda: pool.stats().get("in_flight", 0))
+        # lane services — built in run() (they spawn threads)
+        self._svc = None
+        self._acc = None
+        self._privs: list = []
+        # driver state
+        self._finished = False
+        self._measure_from = float("inf")
+        self._abort_reason: Optional[str] = None
+        self._tl_seen = 0
+        self._echo_next = 2
+        self._light_anchor = None
+        self._tx_nonce = 0
+        # lane counters (all surfaced in the result record)
+        self.echo_submitted = 0
+        self.echo_errors = 0
+        self.light_verdicts = 0
+        self.light_rejects = 0
+        self.light_timeouts = 0
+        self.ingress_admitted = 0
+        self.ingress_rejects = 0
+        self.ingress_timeouts = 0
+        self.ingress_errors = 0
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _lead(self):
+        """Most advanced live node — the store every lane reads from."""
+        best = None
+        for n in self.cluster.nodes:
+            if n.crashed or n.bstore is None:
+                continue
+            if best is None or n.height() > best.height():
+                best = n
+        return best
+
+    def _record(self, lane: str, t_v: float, ms: float,
+                t_w: float = 0.0, always: bool = False) -> None:
+        """Warmup-gated sample: pre-measurement samples (first dispatch
+        compiles kernels) stay out of the SLO math — except timeouts
+        (`always`), which are conclusive whenever they happen."""
+        if always or t_v >= self._measure_from:
+            self._rec.record(lane, t_v, ms, t_w)
+
+    def _abort(self, reason: str) -> None:
+        if self._abort_reason is None:
+            self._abort_reason = reason
+
+    def _live(self) -> bool:
+        return not (self._finished or self.cluster._stopped)
+
+    # -- consensus lane ----------------------------------------------------
+
+    def _harvest(self) -> None:
+        """Pull newly applied heights out of the lead node's
+        HeightTimeline ring (bounded — harvest must outpace the ring)."""
+        node = self._lead()
+        if node is None or node.cs is None:
+            return
+        top = self._tl_seen
+        for tl in node.cs.height_timelines:
+            d = tl.to_dict()
+            if d["height"] <= self._tl_seen or d.get("total_s") is None:
+                continue
+            self._record("consensus", d["t_applied"], d["total_s"] * 1e3)
+            top = max(top, d["height"])
+        self._tl_seen = top
+
+    def _harvest_tick(self) -> None:
+        if not self._live():
+            return
+        self._harvest()
+        self.cluster.clock.call_later(self.cfg.harvest_every_s,
+                                      self._harvest_tick)
+
+    def _echo_tick(self) -> None:
+        """Re-verify freshly committed commits through the shared engine
+        at PRIORITY_CONSENSUS — the consensus lane's device traffic."""
+        if not self._live():
+            return
+        from ..ops import pipeline as _pl
+
+        c, cfg = self.cluster, self.cfg
+        node = self._lead()
+        if node is not None and node.cs is not None:
+            tip = node.height()
+            lo = max(self._echo_next, tip - cfg.echo_max_per_tick + 1, 2)
+            t_v, t_w = c.clock.time(), time.perf_counter()
+            futs = []
+            for h in range(lo, tip + 1):
+                commit = node.bstore.load_block_commit(h)
+                if commit is None:
+                    continue
+                try:
+                    vals = node.cs.committed_state.validators
+                    needed = vals.total_voting_power() * 2 // 3
+                    entries, _ = _pl.commit_entries(
+                        c.chain_id, vals, commit, needed)
+                    futs.append(self.v.submit(
+                        entries, priority=_pl.PRIORITY_CONSENSUS))
+                    self.echo_submitted += 1
+                except Exception:  # noqa: BLE001 — echo must not kill the run
+                    self.echo_errors += 1
+            if tip >= lo:
+                self._echo_next = tip + 1
+            for f in futs:
+                try:
+                    f.result(timeout=cfg.echo_timeout_s)
+                    self._record("consensus_echo", t_v,
+                                 (time.perf_counter() - t_w) * 1e3, t_w)
+                except Exception:  # noqa: BLE001
+                    self.echo_errors += 1
+        c.clock.call_later(cfg.echo_every_s, self._echo_tick)
+
+    # -- light lane --------------------------------------------------------
+
+    def _light_tick(self) -> None:
+        if not self._live():
+            return
+        from ..light import batch as _lb
+        from ..types.block import SignedHeader
+
+        c, cfg = self.cluster, self.cfg
+        node = self._lead()
+        if node is not None and node.cs is not None and node.height() >= 3:
+            if self._light_anchor is None:
+                blk1 = node.bstore.load_block(1)
+                com1 = node.bstore.load_block_commit(1)
+                if blk1 is not None and com1 is not None:
+                    self._light_anchor = SignedHeader(header=blk1.header,
+                                                      commit=com1)
+            anchor = self._light_anchor
+            if anchor is not None:
+                vals = node.cs.committed_state.validators
+                tip = node.height()
+                reqs = []
+                for k in range(cfg.light_fleet):
+                    h = tip - 1 - k  # commit FOR h is stored once h+1 lands
+                    if h <= 1:
+                        break
+                    blk = node.bstore.load_block(h)
+                    com = node.bstore.load_block_commit(h)
+                    if blk is None or com is None:
+                        continue
+                    reqs.append(_lb.HeaderRequest(
+                        trusted_header=anchor, trusted_vals=vals,
+                        untrusted_header=SignedHeader(header=blk.header,
+                                                      commit=com),
+                        untrusted_vals=vals, trusting_period=1e9,
+                    ))
+                if reqs:
+                    from ..wire.canonical import Timestamp
+
+                    t_v, t_w = c.clock.time(), time.perf_counter()
+                    now = Timestamp(seconds=int(t_v) + 5, nanos=0)
+                    try:
+                        res = self._svc.submit_many(reqs, now=now).results(
+                            timeout=cfg.light_timeout_s)
+                        ms = (time.perf_counter() - t_w) * 1e3
+                        for r in res:
+                            self.light_verdicts += 1
+                            if not r.get("ok"):
+                                self.light_rejects += 1
+                            self._record("light", t_v, ms, t_w)
+                    except TimeoutError:
+                        self.light_timeouts += 1
+                        for _ in reqs:
+                            self._record("light", t_v,
+                                         cfg.light_timeout_s * 1e3, t_w,
+                                         always=True)
+                        if cfg.fail_fast:
+                            self._abort("light verdict timed out")
+        c.clock.call_later(cfg.light_every_s, self._light_tick)
+
+    # -- ingress lane ------------------------------------------------------
+
+    def _tx_tick(self) -> None:
+        if not self._live():
+            return
+        from ..mempool import ingress as _ing
+
+        c, cfg = self.cluster, self.cfg
+        t_v, t_w = c.clock.time(), time.perf_counter()
+        futs = []
+        for i in range(cfg.tx_burst):
+            n = self._tx_nonce + i
+            priv = self._privs[n % len(self._privs)]
+            raw = _ing.make_signed_tx(priv, b"soak-%d" % n, n)
+            futs.append(self._acc.submit(_ing.parse_signed_tx(raw)))
+        self._tx_nonce += cfg.tx_burst
+        self._acc.flush_now()
+        deadline = t_w + cfg.ingress_timeout_s
+        timeouts = 0
+        for f in futs:
+            try:
+                ok = f.result(
+                    timeout=max(deadline - time.perf_counter(), 0.001))
+                self._record("ingress", t_v,
+                             (time.perf_counter() - t_w) * 1e3, t_w)
+                self.ingress_admitted += 1
+                if not ok:
+                    self.ingress_rejects += 1
+            except _cfut.TimeoutError:
+                timeouts += 1
+                self._record("ingress", t_v, cfg.ingress_timeout_s * 1e3,
+                             t_w, always=True)
+            except Exception:  # noqa: BLE001 — dispatch/shutdown error
+                self.ingress_errors += 1
+        if timeouts:
+            self.ingress_timeouts += timeouts
+            if cfg.fail_fast:
+                self._abort(
+                    f"ingress admission timed out ({timeouts} tx in one "
+                    f"burst after {cfg.ingress_timeout_s:.1f}s)")
+        c.clock.call_later(cfg.tx_every_s, self._tx_tick)
+
+    # -- SLO budgets -------------------------------------------------------
+
+    def budgets(self) -> List[_ts.SLOBudget]:
+        cfg = self.cfg
+        return [
+            _ts.SLOBudget(
+                "consensus_commit_p99_ms", "consensus",
+                _ts.KIND_P99_MS_MAX, cfg.consensus_commit_p99_ms,
+                min_samples=3,
+                description="per-height commit latency from HeightTimeline "
+                            "rings (virtual ms)"),
+            _ts.SLOBudget(
+                "light_verdict_p99_ms", "light",
+                _ts.KIND_P99_MS_MAX, cfg.light_verdict_p99_ms,
+                min_samples=3,
+                description="light-client fleet verdict wall latency"),
+            _ts.SLOBudget(
+                "ingress_admission_p99_ms", "ingress",
+                _ts.KIND_P99_MS_MAX, cfg.ingress_admission_p99_ms,
+                min_samples=3,
+                description="signed-tx admission wall latency through the "
+                            "accumulator"),
+            _ts.SLOBudget(
+                "replay_heights_per_s", "replay",
+                _ts.KIND_RATE_MIN, cfg.replay_min_heights_per_s,
+                description="catch-up replay throughput in virtual "
+                            "heights/s"),
+        ]
+
+    # -- the run -----------------------------------------------------------
+
+    def _replay_rate(self) -> Optional[float]:
+        s = self.catchup.summary()
+        began = s.get("replay_began_at_virtual_s")
+        if began is None:
+            return None  # replay never started — an SLO breach, correctly
+        end = s.get("rejoined_at_virtual_s") or self.cluster.clock.time()
+        if end <= began:
+            return None
+        return s["heights_applied"] / (end - began)
+
+    def run(self) -> dict:
+        from ..libs import devcheck as _dc
+        from ..libs import metrics as _metrics
+        from ..light.service import LightVerifyService
+        from ..mempool.ingress import IngressAccumulator
+        from ..crypto import ed25519
+        from ..observability import trace as _tr
+
+        cfg, c = self.cfg, self.cluster
+        wall0 = time.perf_counter()
+        self._svc = LightVerifyService(verifier=self.v)
+        self._acc = IngressAccumulator(verifier=self.v,
+                                       max_batch=max(cfg.tx_burst, 8),
+                                       window_ms=2.0)
+        self._privs = [
+            ed25519.gen_priv_key(
+                (cfg.seed * 1009 + i + 1).to_bytes(32, "little"))
+            for i in range(cfg.tx_senders)
+        ]
+        try:
+            c.start()
+            t0 = c.clock.time()
+            self._measure_from = t0 + cfg.warmup_s
+            self.sampler.start()
+            c.clock.call_later(cfg.harvest_every_s, self._harvest_tick)
+            c.clock.call_later(cfg.echo_every_s, self._echo_tick)
+            c.clock.call_later(cfg.light_every_s, self._light_tick)
+            c.clock.call_later(cfg.tx_every_s, self._tx_tick)
+            c.clock.run_until(
+                predicate=((lambda: self._abort_reason is not None)
+                           if cfg.fail_fast else None),
+                deadline=t0 + cfg.duration_s,
+                max_wall_s=cfg.max_wall_s,
+            )
+            self._finished = True
+            self.sampler.stop()
+            self._harvest()  # tail heights still in the ring
+            wall_budget_hit = bool(c.clock.wall_budget_hit)
+            violations = c.check_invariants()
+            rate = self._replay_rate()
+            dc_rep = _dc.report()
+            dc_viol = list(dc_rep.get("violations") or [])
+            results = _ts.evaluate_slos(
+                self.budgets(), self._rec,
+                rates={"replay": rate} if rate is not None else {},
+                window_s=cfg.slo_window_s,
+                span_events=_tr.TRACER.events(),
+            )
+            verdict = _ts.slo_verdict(results)
+            ok = (verdict["ok"] and not violations and not dc_viol
+                  and self._abort_reason is None and not wall_budget_hit)
+            if self._abort_reason is not None:
+                reason = self._abort_reason
+            elif violations:
+                reason = f"{len(violations)} invariant violation(s)"
+            elif dc_viol:
+                reason = f"{len(dc_viol)} devcheck violation(s)"
+            elif not verdict["ok"]:
+                reason = "SLO breach: " + ", ".join(
+                    b["slo"] for b in verdict["breaches"])
+            elif wall_budget_hit:
+                reason = "wall budget exhausted"
+            else:
+                reason = ""
+            lane_pcts = {}
+            for lane in self._rec.lanes():
+                ls = self._rec.latencies(lane)
+                if ls:
+                    lane_pcts[lane] = {
+                        "count": len(ls),
+                        "p50_ms": round(_ts.percentile(ls, 0.50), 3),
+                        "p99_ms": round(_ts.percentile(ls, 0.99), 3),
+                        "max_ms": round(max(ls), 3),
+                    }
+            try:
+                engine = _metrics.ops_stats()
+            except Exception:  # noqa: BLE001 — stats must not fail the run
+                engine = None
+            result = {
+                "schema_version": SCHEMA_VERSION,
+                "kind": "soak",
+                "ok": ok,
+                "reason": reason,
+                "seed": cfg.seed,
+                "n_nodes": cfg.n_nodes,
+                "duration_s": cfg.duration_s,
+                "t_start_virtual_s": t0,
+                "virtual_s": round(c.clock.time() - t0, 6),
+                "wall_s": round(time.perf_counter() - wall0, 3),
+                "wall_budget_hit": wall_budget_hit,
+                "events_run": c.clock.events_run,
+                "heights": c.heights(),
+                "fingerprint": c.fingerprint(),
+                "schedule_digest": c.network.schedule_digest(),
+                "violations": violations,
+                "slo": verdict,
+                "lane_percentiles": lane_pcts,
+                "windows": {
+                    lane: _ts.window_stats(self._rec.samples(lane),
+                                           cfg.slo_window_s)
+                    for lane in self._rec.lanes()
+                },
+                "gauges": {
+                    name: [[round(t, 6), v] for t, v in pts]
+                    for name, pts in self.sampler.series().items()
+                },
+                "sampler_ticks": self.sampler.ticks,
+                "lane_counts": self.v.lane_counts(),
+                "catchup": [d.summary() for d in c.catchup_drivers],
+                "replay_heights_per_s": (round(rate, 3)
+                                         if rate is not None else None),
+                "counters": {
+                    "echo_submitted": self.echo_submitted,
+                    "echo_errors": self.echo_errors,
+                    "light_verdicts": self.light_verdicts,
+                    "light_rejects": self.light_rejects,
+                    "light_timeouts": self.light_timeouts,
+                    "ingress_admitted": self.ingress_admitted,
+                    "ingress_rejects": self.ingress_rejects,
+                    "ingress_timeouts": self.ingress_timeouts,
+                    "ingress_errors": self.ingress_errors,
+                },
+                "light_service": self._svc.stats(),
+                "ingress_accumulator": self._acc.stats(),
+                "verify_engine": engine,
+                "devcheck": dc_rep if dc_rep.get("enabled") else None,
+                "faults_applied": list(c.faults_applied),
+            }
+            if not ok:
+                result["flight_recorder"] = c.flight_recorder_dump()
+            return result
+        finally:
+            self._finished = True
+            self.sampler.stop()
+            try:
+                self._svc.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._acc.close(timeout=2.0)
+            except Exception:  # noqa: BLE001
+                pass
+            c.stop()
+
+
+def run_soak(verifier, config: Optional[SoakConfig] = None) -> dict:
+    """One-call soak: build the driver, run it, return the record."""
+    return SoakDriver(verifier, config).run()

@@ -41,9 +41,13 @@ def pytest_collection_modifyitems(config, items):
     suites keep their pure-python halves running everywhere."""
     from tendermint_tpu.native import load as _load_native
 
-    if _load_native() is not None:
-        return
-    skip = pytest.mark.skip(reason="tm_native module not built")
-    for item in items:
-        if "native_required" in item.keywords:
-            item.add_marker(skip)
+    if _load_native() is None:
+        skip = pytest.mark.skip(reason="tm_native module not built")
+        for item in items:
+            if "native_required" in item.keywords:
+                item.add_marker(skip)
+
+    # The end-to-end soak smokes are the most expensive subprocess items
+    # in the suite; run them after everything else so a wall-clock-capped
+    # CI run truncates the soak smokes, not the unit suites.
+    items.sort(key=lambda it: it.fspath.basename == "test_soak_isolated.py")

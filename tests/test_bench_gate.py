@@ -52,7 +52,8 @@ def test_pins_file_is_wellformed():
 
 
 @pytest.mark.parametrize(
-    "kind", ["bench", "multichip", "light", "mempool", "blocksync", "votes"]
+    "kind",
+    ["bench", "multichip", "light", "mempool", "blocksync", "votes", "soak"],
 )
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.
@@ -92,6 +93,39 @@ def test_gate_actually_bites(tmp_path):
         "--gate-pct", str(pins["gate_pct"]),
     ])
     assert rc == 1
+
+
+def test_soak_gate_is_direction_aware(tmp_path):
+    """SOAK lane p99s regress on a RISE, replay_heights_per_s on a FALL
+    (ISSUE 16): both synthetic regressions must trip the same gate."""
+    pins = _pins()
+    pin_path = os.path.join(REPO_ROOT, pins["pins"]["soak"])
+    with open(pin_path) as fh:
+        art = json.load(fh)
+
+    worse_p99 = dict(art)
+    worse_p99["ingress_admission_p99_ms"] = (
+        (art.get("ingress_admission_p99_ms") or 1.0) * 1.5
+    )
+    bad = tmp_path / "SOAK_r98.json"
+    bad.write_text(json.dumps(worse_p99))
+    rc = bench_report.main([
+        "--compare", pin_path, str(bad),
+        "--gate-pct", str(pins["gate_pct"]),
+    ])
+    assert rc == 1, "a 50% ingress-admission p99 rise must fail the gate"
+
+    slower_replay = dict(art)
+    slower_replay["replay_heights_per_s"] = (
+        (art.get("replay_heights_per_s") or 1.0) * 0.5
+    )
+    bad2 = tmp_path / "SOAK_r99.json"
+    bad2.write_text(json.dumps(slower_replay))
+    rc = bench_report.main([
+        "--compare", pin_path, str(bad2),
+        "--gate-pct", str(pins["gate_pct"]),
+    ])
+    assert rc == 1, "a 50% replay heights/s fall must fail the gate"
 
 
 def test_light_artifact_in_trajectory(capsys):

@@ -352,7 +352,8 @@ class TestCommittedArtifacts:
         n = len(bench_report.default_paths())
         rows = [ln for ln in out.splitlines()
                 if ln.startswith(("bench_r", "multichip_r", "light_r",
-                                  "mempool_r", "blocksync_r"))]
+                                  "mempool_r", "blocksync_r", "votes_r",
+                                  "soak_r"))]
         assert len(rows) == n, out
         assert any("152,542" in ln or "152542" in ln for ln in rows), (
             "r03's sustained figure must survive normalization"
@@ -362,7 +363,8 @@ class TestCommittedArtifacts:
         assert bench_report.main(["--trajectory", "--json"]) == 0
         rows = json.loads(capsys.readouterr().out)
         assert {r["kind"] for r in rows} == {"bench", "multichip", "light",
-                                             "mempool", "blocksync"}
+                                             "mempool", "blocksync", "votes",
+                                             "soak"}
         r5 = next(r for r in rows
                   if r["kind"] == "bench" and r["round"] == 5)
         assert r5["kernel_stream"] == pytest.approx(470560.0)

@@ -81,12 +81,19 @@ METRIC_KEYS = (
     "vs_kernel_serial", "range_hit_rate", "fallback_ranges",
     # live-vote-ingress artifacts (VOTES_r*, ISSUE 15)
     "votes_seq_votes_per_s", "window_dups", "memo_hits",
+    # soak-harness artifacts (SOAK_r*, ISSUE 16)
+    "consensus_commit_p99_ms", "light_verdict_p99_ms",
+    "ingress_admission_p99_ms", "replay_heights_per_s",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
 _LOWER_IS_BETTER = {
     "relay_rtt_ms", "commit_p99_unloaded_ms", "commit_p99_flood_ms",
     "flood_latency_ratio", "fallback_ranges",
+    # soak lane p99s regress on a RISE; replay_heights_per_s (a rate)
+    # stays in the default higher-is-better direction
+    "consensus_commit_p99_ms", "light_verdict_p99_ms",
+    "ingress_admission_p99_ms",
 }
 
 # keys a COMPARE tracks by default (rate-like, present across most rounds)
@@ -94,11 +101,12 @@ COMPARE_KEYS = (
     "value", "sustained_sigs_per_s", "kernel_stream_sigs_per_s",
     "pipelined_headers_per_s", "mixed_curve_sigs_per_s", "relay_rtt_ms",
     "speedup_2v1", "light_unique_headers_per_s", "flood_latency_ratio",
-    "vs_kernel_serial",
+    "vs_kernel_serial", "consensus_commit_p99_ms", "light_verdict_p99_ms",
+    "ingress_admission_p99_ms", "replay_heights_per_s",
 )
 
 _NAME_RE = re.compile(
-    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES)_r(\d+)", re.I)
+    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK)_r(\d+)", re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -170,8 +178,9 @@ def normalize(raw: dict, path: str = "") -> dict:
             art["notes"].append(f"smoke failed (rc={raw.get('rc')})")
         return art
     elif "metric" in raw:
-        # direct artifact (MULTICHIP r06+, bench.py line)
-        art["ok"] = True
+        # direct artifact (MULTICHIP r06+, bench.py line); soak records
+        # carry their own SLO verdict in "ok" — honor it
+        art["ok"] = bool(raw.get("ok", True))
         src = raw
     else:
         art["notes"].append("unrecognized artifact shape "
@@ -215,6 +224,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "MEMPOOL_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "BLOCKSYNC_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "VOTES_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "SOAK_r*.json")))
     return paths
 
 
@@ -232,7 +242,7 @@ def validate(art: dict) -> List[str]:
         probs.append("; ".join(art["notes"]))
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
-                           "blocksync", "votes"):
+                           "blocksync", "votes", "soak"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -1323,6 +1323,115 @@ def run_replay(args) -> int:
     return rc
 
 
+def run_soak(args) -> int:
+    """--soak: the round-16 soak-harness gate on a mocked relay (verdicts
+    come back all-accept with NO kernel — this gate checks the HARNESS,
+    not the crypto). Asserts the three properties the soak driver must
+    hold before its artifacts are trusted:
+
+      cadence    the telemetry sampler ticks on SimClock cadence — two
+                 same-seed mini-soaks produce the SAME tick count, and
+                 that count matches duration/cadence (the sampler must
+                 never free-run on wall time)
+      replay     same-seed runs are replay-exact: identical cluster
+                 fingerprint and network schedule digest (the soak loop
+                 must not leak wall-clock reads into the trajectory)
+      no leak    zero buffer-pool slots in flight once the shared
+                 verifier drains, and tmlint's determinism rules stay at
+                 0 findings with simnet/soak.py in scope
+    """
+    import math
+
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, mock_mempool_prepare
+    from tendermint_tpu.simnet.soak import SoakConfig
+    from tendermint_tpu.simnet.soak import run_soak as _run_soak
+
+    duration, cadence, rtt_ms = 6.0, 1.0, 2.0
+    print(f"prep_bench --soak: duration={duration}vs cadence={cadence}s "
+          f"runs=2 rtt={rtt_ms}ms relay=mocked")
+    rc = 0
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        mock_mempool_prepare(real_prepare, rtt_ms / 1e3)
+    )
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    results, pools = [], []
+    try:
+        for _ in range(2):
+            v = pl.AsyncBatchVerifier(depth=2)
+            try:
+                cfg = SoakConfig(duration_s=duration, seed=7,
+                                 sample_every_s=cadence, max_wall_s=120.0)
+                results.append(_run_soak(v, cfg))
+                drain_pool(v._pool)
+                pools.append(v._pool.stats())
+            finally:
+                v.close()
+    finally:
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+
+    a, b = results
+
+    # -- sampler cadence determinism -------------------------------------
+    expect = math.floor(duration / cadence)
+    print(f"  sampler ticks              : {a['sampler_ticks']} / "
+          f"{b['sampler_ticks']} (expect ~{expect})")
+    if a["sampler_ticks"] != b["sampler_ticks"]:
+        print(f"  FAIL: tick count diverged across same-seed runs "
+              f"({a['sampler_ticks']} vs {b['sampler_ticks']})",
+              file=sys.stderr)
+        rc = 1
+    if abs(a["sampler_ticks"] - expect) > 1:
+        print(f"  FAIL: {a['sampler_ticks']} ticks for {duration}s at "
+              f"{cadence}s cadence (expect {expect}±1) — sampler is not "
+              f"riding SimClock", file=sys.stderr)
+        rc = 1
+
+    # -- replay exactness ------------------------------------------------
+    exact = (a["fingerprint"] == b["fingerprint"]
+             and a["schedule_digest"] == b["schedule_digest"])
+    print(f"  replay exact               : {exact} "
+          f"(fp={a['fingerprint'][:16]}… heights={a['heights']})")
+    if not exact:
+        print("  FAIL: same-seed soak runs diverged — a wall-clock read "
+              "leaked into the trajectory", file=sys.stderr)
+        rc = 1
+    for i, r in enumerate(results):
+        if not r["ok"]:
+            print(f"  FAIL: run {i} verdict not ok: {r.get('reason')}",
+                  file=sys.stderr)
+            rc = 1
+
+    # -- pool hygiene ----------------------------------------------------
+    for i, pool in enumerate(pools):
+        print(f"  pool (run {i})               : {pool}")
+        if pool["in_flight"] != 0:
+            print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+                  file=sys.stderr)
+            rc = 1
+
+    # -- tmlint: soak.py is inside the determinism scope -----------------
+    from tools.tmlint.__main__ import main as tmlint_main
+    lint_rc = tmlint_main([])
+    print(f"  tmlint tree gate           : rc={lint_rc} "
+          f"(simnet/soak.py in scope)")
+    if lint_rc != 0:
+        print("  FAIL: tmlint found new findings with soak harness in "
+              "scope", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sigs", type=int, default=10_000)
@@ -1390,6 +1499,13 @@ def main() -> int:
         "relay — N gossiped votes fuse into <= K launches, a forged "
         "signature mid-flood is the ONLY rejection, zero pool-slot leak",
     )
+    ap.add_argument(
+        "--soak",
+        action="store_true",
+        help="round-16 gate: soak-harness hygiene on a mocked relay — "
+        "sampler ticks on SimClock cadence, same-seed runs replay-exact, "
+        "zero pool-slot leak, tmlint clean with simnet/soak.py in scope",
+    )
     args = ap.parse_args()
     if args.fused:
         return run_fused(args)
@@ -1407,6 +1523,8 @@ def main() -> int:
         return run_replay(args)
     if args.votes:
         return run_votes(args)
+    if args.soak:
+        return run_soak(args)
 
     from tendermint_tpu.native import load as _load_native
     from tendermint_tpu.ops import backend, pipeline

@@ -172,6 +172,87 @@ def _attach_devcheck(verdict: dict) -> None:
         )
 
 
+def run_soak(args) -> int:
+    """--soak: one cluster, all four QoS workloads, time-series telemetry
+    and a declarative SLO verdict (ISSUE 16). The verify engine runs with
+    the relay MOCKED by default (real packing/prep/transfer, all-accept
+    verdict behind --soak-rtt-ms) so CI boxes measure the harness and the
+    SLOs, not jax compile time; --soak-real runs live kernels. Exit 0 on
+    a green verdict, 1 on any conclusive failure (SLO breach, invariant,
+    devcheck), 3 when the wall budget cut the run short (inconclusive —
+    the same classification --scenario applies)."""
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.simnet.soak import SoakConfig, SoakDriver
+
+    real_prepare = _pl.AsyncBatchVerifier._prepare
+    force_prev = os.environ.get("TM_TPU_FORCE_DEVICE")
+    if not args.soak_real:
+        from tendermint_tpu.ops._testing import mock_mempool_prepare
+
+        _pl.AsyncBatchVerifier._prepare = staticmethod(
+            mock_mempool_prepare(real_prepare, args.soak_rtt_ms / 1e3)
+        )
+        os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    t0 = time.monotonic()
+    runs = []
+    try:
+        for _ in range(max(args.repeat, 1)):
+            v = _pl.AsyncBatchVerifier(depth=2)
+            try:
+                cfg = SoakConfig.from_env(
+                    duration_s=args.soak,
+                    seed=args.seed,
+                    n_nodes=args.nodes,
+                    catchup_at_height=getattr(args, "replay_at", 0) or None,
+                    max_wall_s=_wall_budget(args, 300.0),
+                )
+                runs.append(SoakDriver(v, cfg).run())
+            finally:
+                v.close()
+    finally:
+        _pl.AsyncBatchVerifier._prepare = real_prepare
+        if not args.soak_real:
+            if force_prev is None:
+                os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+            else:
+                os.environ["TM_TPU_FORCE_DEVICE"] = force_prev
+    verdict = dict(runs[0])
+    verdict["mode"] = "real" if args.soak_real else "mocked-relay"
+    verdict["relay_rtt_ms"] = None if args.soak_real else args.soak_rtt_ms
+    verdict["runs"] = len(runs)
+    verdict["wall_total_s"] = round(time.monotonic() - t0, 3)
+    verdict["replay_exact"] = all(
+        r["fingerprint"] == runs[0]["fingerprint"]
+        and r["schedule_digest"] == runs[0]["schedule_digest"]
+        for r in runs
+    )
+    if len(runs) > 1 and not verdict["replay_exact"]:
+        verdict["ok"] = False
+        verdict["reason"] = (
+            "same-seed soak runs diverged (replay exactness broken)"
+        )
+    if args.devcheck:
+        _attach_devcheck(verdict)
+    if args.soak_out:
+        with open(args.soak_out, "w") as fh:
+            json.dump(verdict, fh, indent=1, default=str)
+            fh.write("\n")
+    # stdout stays readable: the bulky rings live only in --soak-out
+    slim = {
+        k: v for k, v in verdict.items()
+        if k not in ("gauges", "windows", "verify_engine", "flight_recorder")
+    }
+    print(json.dumps(slim, indent=2, default=str))
+    if verdict["ok"]:
+        return 0
+    inconclusive = (
+        verdict.get("wall_budget_hit")
+        and verdict.get("reason") == "wall budget exhausted"
+        and not (verdict.get("devcheck") or {}).get("violations")
+    )
+    return 3 if inconclusive else 1
+
+
 def parse_seed_range(spec: str):
     """"a:b" -> range(a, b); "3,7,9" -> [3, 7, 9]; "12" -> [12]."""
     if ":" in spec:
@@ -349,10 +430,35 @@ def main() -> int:
     )
     ap.add_argument(
         "--inject-bug",
-        choices=["", "catchup"],
+        choices=["", "catchup", "starve"],
         default="",
         help="re-introduce a known-fixed gossip bug (TM_TPU_GOSSIP_BUG_* "
-        "seam) so the search demonstrably rediscovers and shrinks it",
+        "seam) so the search demonstrably rediscovers and shrinks it; "
+        "'starve' arms the reserved-ingress-slot seam "
+        "(TM_TPU_INJECT_LINTBUG, implies devcheck) so a --soak run "
+        "demonstrably fails its ingress-admission SLO",
+    )
+    # -- soak harness (ISSUE 16) ------------------------------------------
+    ap.add_argument(
+        "--soak", type=float, default=0.0,
+        help="run the soak harness for this many VIRTUAL seconds instead "
+        "of --height: all four QoS workloads (consensus + light fleets + "
+        "tx floods through partition/heal + crash-rejoin catch-up) on one "
+        "shared verify engine, with time-series telemetry and per-lane "
+        "SLO budgets; --repeat N asserts replay-exact fingerprints",
+    )
+    ap.add_argument(
+        "--soak-rtt-ms", type=float, default=4.0,
+        help="soak mocked-relay round-trip per launch (default 4)",
+    )
+    ap.add_argument(
+        "--soak-real", action="store_true",
+        help="soak with live kernels instead of the mocked relay",
+    )
+    ap.add_argument(
+        "--soak-out", default="",
+        help="write the full soak artifact JSON (gauge rings, windows, "
+        "flight recorder on failure) here — tools/soak_report.py renders it",
     )
     # -- chain-replay catch-up (ISSUE 14) ---------------------------------
     ap.add_argument(
@@ -392,11 +498,18 @@ def main() -> int:
     if args.inject_bug == "catchup":
         # must land before tendermint_tpu.consensus.peer_state is imported
         os.environ["TM_TPU_GOSSIP_BUG_CATCHUP"] = "1"
+    if args.inject_bug == "starve":
+        # the seam is devcheck-gated (a stale env export with the
+        # checkers off must stay inert), so arming it arms devcheck too
+        os.environ["TM_TPU_DEVCHECK"] = "1"
+        os.environ["TM_TPU_INJECT_LINTBUG"] = "starve"
 
     if args.scenario:
         return run_scenario(args)
     if args.search:
         return run_search(args)
+    if args.soak > 0:
+        return run_soak(args)
 
     if args.smoke:
         args.nodes = 4

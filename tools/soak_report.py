@@ -1,0 +1,192 @@
+#!/usr/bin/env python3
+"""soak_report — render a SOAK_r*.json artifact (ISSUE 16).
+
+Text-mode rendering of the soak harness's time-series telemetry:
+
+- run verdict + per-lane SLO table (observed vs budget),
+- a sparkline trajectory per sampled gauge series (min/max/last),
+- per-lane latency p99 trajectory over the SLO windows,
+- breach localization: the worst time window per breached budget and
+  the dominating span category inside it (when the artifact has span
+  attribution).
+
+Usage:
+    python tools/soak_report.py SOAK_r01.json
+    python tools/soak_report.py --width 48 path/to/artifact.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+BLOCKS = "▁▂▃▄▅▆▇█"  # ▁▂▃▄▅▆▇█
+
+
+def downsample(vals, width: int):
+    """Bucket-mean a series down to at most `width` points."""
+    vals = list(vals)
+    if len(vals) <= width:
+        return vals
+    out = []
+    n = len(vals)
+    for i in range(width):
+        lo = i * n // width
+        hi = max((i + 1) * n // width, lo + 1)
+        grp = vals[lo:hi]
+        out.append(sum(grp) / len(grp))
+    return out
+
+
+def spark(vals, width: int = 64) -> str:
+    """Sparkline-style text trajectory (scaled to the series' own
+    min..max; a flat series renders as a flat low line)."""
+    vals = downsample([float(v) for v in vals], width)
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return BLOCKS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        BLOCKS[min(int((v - lo) / span * len(BLOCKS)), len(BLOCKS) - 1)]
+        for v in vals
+    )
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(doc: dict, width: int = 64) -> str:
+    t0 = float(doc.get("t_start_virtual_s") or 0.0)
+    lines = []
+    ok = doc.get("ok")
+    lines.append(
+        f"soak verdict: {'OK' if ok else 'FAIL'}"
+        + (f" — {doc['reason']}" if doc.get("reason") else "")
+    )
+    lines.append(
+        f"  seed={doc.get('seed')} nodes={doc.get('n_nodes')} "
+        f"virtual={_fmt(doc.get('virtual_s'))}s "
+        f"wall={_fmt(doc.get('wall_s'))}s "
+        f"heights={doc.get('heights')} "
+        f"mode={doc.get('mode', '?')}"
+    )
+    cu = (doc.get("catchup") or [None])[0]
+    if cu:
+        lines.append(
+            f"  catchup: node {cu.get('node')} behind_at_start="
+            f"{cu.get('behind_at_start')} applied={cu.get('heights_applied')}"
+            f" hit_rate={_fmt(cu.get('hit_rate'), 3)} rejoined="
+            f"{cu.get('rejoined')} "
+            f"replay={_fmt(doc.get('replay_heights_per_s'))} heights/s"
+        )
+    lines.append("")
+
+    # -- SLO table ---------------------------------------------------------
+    slo = doc.get("slo") or {}
+    lines.append(f"SLO budgets ({len(slo.get('results', []))} evaluated, "
+                 f"{len(slo.get('breaches', []))} breached):")
+    for r in slo.get("results", []):
+        mark = "ok  " if r.get("ok") else "FAIL"
+        cmp_ = "<=" if r.get("kind") == "p99_ms_max" else ">="
+        lines.append(
+            f"  [{mark}] {r.get('slo'):<28} lane={r.get('lane'):<10} "
+            f"observed={_fmt(r.get('observed'), 2):>10} {cmp_} "
+            f"limit={_fmt(r.get('limit'), 2)}"
+            + (f"  ({r['reason']})" if r.get("reason") else "")
+        )
+    lines.append("")
+
+    # -- per-lane latency trajectory over windows --------------------------
+    windows = doc.get("windows") or {}
+    if windows:
+        lines.append("lane latency p99 trajectory (per SLO window):")
+        for lane in sorted(windows):
+            wins = windows[lane]
+            if not wins:
+                continue
+            p99s = [w["p99_ms"] for w in wins]
+            lines.append(
+                f"  {lane:<16} {spark(p99s, width)}  "
+                f"p99 {_fmt(min(p99s))}..{_fmt(max(p99s))} ms "
+                f"({sum(w['count'] for w in wins)} samples)"
+            )
+        lines.append("")
+
+    # -- breach localization ----------------------------------------------
+    breaches = slo.get("breaches") or []
+    if breaches:
+        lines.append("breach localization:")
+        for b in breaches:
+            bw = b.get("breach_window")
+            if not bw:
+                lines.append(
+                    f"  {b.get('slo')}: no samples to localize"
+                    + (f" — {b['reason']}" if b.get("reason") else "")
+                )
+                continue
+            w0 = bw["t0"] - t0
+            w1 = bw["t1"] - t0
+            lines.append(
+                f"  {b.get('slo')} (lane {b.get('lane')}): worst window "
+                f"t+{w0:.1f}s..t+{w1:.1f}s — p99 {_fmt(bw.get('p99_ms'), 1)} "
+                f"ms over {bw.get('count')} samples"
+            )
+            dom = bw.get("dominant_span")
+            if dom:
+                lines.append(f"    dominating span category: {dom}")
+                totals = bw.get("span_totals_ms") or {}
+                for name, ms in sorted(
+                    totals.items(), key=lambda kv: -kv[1]
+                )[:5]:
+                    lines.append(f"      {name:<28} {_fmt(ms, 1):>10} ms")
+        lines.append("")
+
+    # -- gauge trajectories ------------------------------------------------
+    gauges = doc.get("gauges") or {}
+    if gauges:
+        lines.append(f"gauge time series ({doc.get('sampler_ticks')} ticks):")
+        for name in sorted(gauges):
+            pts = gauges[name]
+            if not pts:
+                continue
+            vals = [p[1] for p in pts]
+            lines.append(
+                f"  {name:<44} {spark(vals, width)}  "
+                f"[{_fmt(min(vals))}..{_fmt(max(vals))}] last={_fmt(vals[-1])}"
+            )
+        lines.append("")
+
+    counters = doc.get("counters") or {}
+    if counters:
+        lines.append("lane counters: " + ", ".join(
+            f"{k}={v}" for k, v in counters.items()))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", nargs="?", default="SOAK_r01.json",
+                    help="soak artifact path (default SOAK_r01.json)")
+    ap.add_argument("--width", type=int, default=64,
+                    help="sparkline width in characters (default 64)")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.artifact):
+        print(f"error: no artifact at {args.artifact}", file=sys.stderr)
+        return 2
+    with open(args.artifact) as fh:
+        doc = json.load(fh)
+    print(render(doc, width=max(args.width, 8)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
