@@ -2123,6 +2123,238 @@ def votes_main(argv) -> None:
             fh.write("\n")
 
 
+def lanes_main(argv) -> None:
+    """`bench.py lanes` — the ingress-fabric latency-vs-load curve
+    (ISSUE 17).
+
+    Drives one fabric lane per WINDOW POLICY through the mocked relay
+    (mock_mempool_prepare: real windowing, EntryBlock packing, host prep
+    and transfer; each launch's verdict matures rtt_ms after launch) at
+    both ends of the load curve:
+
+      idle   lone signatures at a fixed inter-arrival — the latency a
+             single request pays when nothing else is queued (p99 ms)
+      flood  a paced signature flood — sustained sigs/s measured to the
+             LAST verdict delivered
+
+    Three policies: fixed-shallow (the latency end point: small window,
+    small batch), fixed-deep (the throughput end point: big window, big
+    batch), and adaptive (base == shallow, growth cap beyond deep).
+    The gate is that adaptive holds BOTH ends of the curve:
+
+      * at idle it strictly beats deep on p99 latency and stays within
+        tolerance of shallow;
+      * at flood it strictly beats shallow on RELAY-COMMAND ECONOMICS —
+        sigs per launch window, the quantity the window policy actually
+        controls (the relay is one serial command channel, so fewer,
+        fuller launches is the 2302.00418 batch-economics win) — while
+        holding wall-clock throughput within tolerance of BOTH fixed
+        policies. (Raw sigs/s alone cannot separate shallow from deep
+        under backlog: flushes take the whole queue, so a backlogged
+        shallow lane self-heals into big launches. The launch count is
+        the honest fingerprint of the policy.)
+
+    Exits nonzero when adaptive loses the curve.
+
+    Prints ONE JSON line; --out also writes it as an artifact file
+    (LANES_r*.json, schema_version 1, rendered by tools/bench_report.py
+    --trajectory and gated by --compare)."""
+    import argparse
+    import threading
+
+    ap = argparse.ArgumentParser(prog="bench.py lanes")
+    ap.add_argument("--flood-sigs", type=int, default=8192,
+                    help="signatures in the flood (default 8192)")
+    ap.add_argument("--idle-sigs", type=int, default=25,
+                    help="lone signatures at the idle end (default 25)")
+    ap.add_argument("--idle-gap-ms", type=float, default=40.0,
+                    help="idle inter-arrival (default 40)")
+    ap.add_argument("--burst", type=int, default=96,
+                    help="flood pacing: sigs per 1 ms burst (default 96)")
+    ap.add_argument("--rtt-ms", type=float, default=20.0,
+                    help="mocked relay round-trip per launch (default 20)")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON to this path")
+    args = ap.parse_args(argv)
+
+    from tendermint_tpu.libs import jaxcache
+
+    import jax
+
+    jaxcache.enable(jax, os.path.dirname(os.path.abspath(__file__)))
+
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.ops import ingress as _fabric
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.ops._testing import drain_pool, mock_mempool_prepare
+
+    # 8 real signed triples, repeated to fill the streams: the relay is
+    # mocked (all-accept), so prep cost per entry — what the policies
+    # differ on — is what matters, not verdict content
+    triples = []
+    for i in range(8):
+        sk = _ed.gen_priv_key(bytes([i + 1]) * 32)
+        msg = b"lanes-bench-%d" % i
+        triples.append((sk.pub_key().bytes(), msg, sk.sign(msg)))
+
+    # the three window policies: shallow/deep are the fixed end points,
+    # adaptive spans past both (batch cap 8x base, window x8 / /4)
+    policies = {
+        "shallow": dict(batch=32, window_ms=4.0, adaptive=False),
+        "deep": dict(batch=256, window_ms=32.0, adaptive=False),
+        "adaptive": dict(batch=64, window_ms=4.0, adaptive=True),
+    }
+
+    real_prepare = _pl.AsyncBatchVerifier._prepare
+    _pl.AsyncBatchVerifier._prepare = staticmethod(
+        mock_mempool_prepare(real_prepare, args.rtt_ms / 1e3)
+    )
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    eng = _fabric.IngressEngine()
+    results = {}
+    leaked = 0
+    try:
+        for name, pol in policies.items():
+            # a FRESH verifier per policy — a shared one lets the
+            # previous policy's flood tail queue under the next one's
+            # idle measurement. depth=1: the relay is ONE serial command
+            # channel (PERF_r05 §2), so sigs per relay command — what
+            # the window policy controls — bounds flood throughput
+            # exactly the way the 2302.00418 batch economics say
+            v = _pl.AsyncBatchVerifier(depth=1)
+            mtx = threading.Lock()
+            lat: list = []
+            count = [0]
+            target = [0]
+            done = threading.Event()
+
+            def deliver(items, verdicts, err, lat=lat, count=count,
+                        target=target, done=done, mtx=mtx):
+                now = time.perf_counter()
+                with mtx:
+                    for it in items:
+                        lat.append((now - it.t_enq) * 1e3)
+                    count[0] += len(items)
+                    if count[0] >= target[0]:
+                        done.set()
+
+            lane = eng.register(_fabric.LaneSpec(
+                name=f"bench-{name}", priority=_fabric.PRIORITY_INGRESS,
+                verifier=v, entries_fn=lambda i: triples[i % 8],
+                deliver=deliver, **pol))
+            try:
+                # -- idle end: lone signatures, per-item latency ---------
+                with mtx:
+                    lat.clear()
+                    count[0] = 0
+                    target[0] = args.idle_sigs
+                    done.clear()
+                for i in range(args.idle_sigs):
+                    lane.submit(i)
+                    time.sleep(args.idle_gap_ms / 1e3)
+                if not done.wait(timeout=120):
+                    raise RuntimeError(f"{name}: idle verdicts missing")
+                with mtx:
+                    idle_lat = sorted(lat)
+                idle_p99 = idle_lat[int(0.99 * (len(idle_lat) - 1))]
+
+                # -- flood end: paced bursts, time to last verdict -------
+                with mtx:
+                    lat.clear()
+                    count[0] = 0
+                    target[0] = args.flood_sigs
+                    done.clear()
+                t0 = time.perf_counter()
+                for base in range(0, args.flood_sigs, args.burst):
+                    for i in range(base,
+                                   min(base + args.burst, args.flood_sigs)):
+                        lane.submit(i)
+                    time.sleep(0.001)
+                if not done.wait(timeout=300):
+                    raise RuntimeError(f"{name}: flood verdicts missing")
+                flood_dt = time.perf_counter() - t0
+                st = lane.stats()
+            finally:
+                lane.close(timeout=30)
+                drain_pool(v._pool)
+                leaked += v._pool.stats()["in_flight"]
+                v.close()
+            results[name] = {
+                "idle_p99_ms": round(idle_p99, 2),
+                "flood_sigs_per_s": round(args.flood_sigs / flood_dt, 1),
+                "flood_launch_windows": st["batches"],
+                "flood_sigs_per_window": round(
+                    args.flood_sigs / max(st["batches"], 1), 1),
+                "window_grows": st["window_grows"],
+                "window_shrinks": st["window_shrinks"],
+                "batch_final": st["max_batch"],
+            }
+            print(f"# {name}: idle_p99={results[name]['idle_p99_ms']}ms "
+                  f"flood={results[name]['flood_sigs_per_s']} sigs/s "
+                  f"windows={st['batches']} grows={st['window_grows']} "
+                  f"shrinks={st['window_shrinks']}", file=sys.stderr)
+    finally:
+        eng.close(timeout=5)
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        _pl.AsyncBatchVerifier._prepare = real_prepare
+
+    ad, sh, dp = (results[k] for k in ("adaptive", "shallow", "deep"))
+    # the curve gate: adaptive strictly beats each fixed policy at the
+    # end that policy is weak on — deep on idle p99, shallow on relay-
+    # command economics (sigs per launch window; raw sigs/s cannot
+    # separate the policies under backlog because take-all flushes
+    # self-heal a backlogged shallow lane into big launches) — and
+    # holds wall-clock throughput/latency tolerance everywhere else
+    checks = {
+        "beats_deep_at_idle": ad["idle_p99_ms"] < 0.8 * dp["idle_p99_ms"],
+        "beats_shallow_at_flood": (
+            ad["flood_sigs_per_window"] > 1.3 * sh["flood_sigs_per_window"]),
+        "holds_idle_vs_shallow": (
+            ad["idle_p99_ms"] <= 1.15 * sh["idle_p99_ms"]),
+        "holds_flood_vs_shallow": (
+            ad["flood_sigs_per_s"] >= 0.9 * sh["flood_sigs_per_s"]),
+        "holds_flood_vs_deep": (
+            ad["flood_sigs_per_s"] >= 0.85 * dp["flood_sigs_per_s"]),
+        "moved_both_directions": (
+            ad["window_grows"] >= 1 and ad["window_shrinks"] >= 1),
+        "no_pool_leak": leaked == 0,
+    }
+    ok = all(checks.values())
+    out = {
+        "schema_version": 1,
+        "metric": "lanes_adaptive_flood_sigs_per_s",
+        "value": ad["flood_sigs_per_s"],
+        "unit": "sigs/s",
+        "mode": "mocked-relay",
+        "backend": os.environ.get("JAX_PLATFORMS", "") or "cpu",
+        "relay_rtt_ms": args.rtt_ms,
+        "flood_sigs": args.flood_sigs,
+        "idle_sigs": args.idle_sigs,
+        "idle_gap_ms": args.idle_gap_ms,
+        "lanes_adaptive_idle_p99_ms": ad["idle_p99_ms"],
+        "lanes_adaptive_sigs_per_window": ad["flood_sigs_per_window"],
+        "lanes_shallow_flood_sigs_per_s": sh["flood_sigs_per_s"],
+        "lanes_shallow_idle_p99_ms": sh["idle_p99_ms"],
+        "lanes_shallow_sigs_per_window": sh["flood_sigs_per_window"],
+        "lanes_deep_flood_sigs_per_s": dp["flood_sigs_per_s"],
+        "lanes_deep_idle_p99_ms": dp["idle_p99_ms"],
+        "adaptive_window_grows": ad["window_grows"],
+        "adaptive_window_shrinks": ad["window_shrinks"],
+        "adaptive_batch_final": ad["batch_final"],
+        "policies": results,
+        "checks": checks,
+        "ok": ok,
+        "pool_slots_leaked": leaked,
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+            fh.write("\n")
+    if not ok:
+        sys.exit(1)
+
+
 def soak_main(argv) -> None:
     """`bench.py soak` — one cluster, all four workloads, SLO verdict
     (ISSUE 16).
@@ -2244,6 +2476,8 @@ if __name__ == "__main__":
         blocksync_main(sys.argv[2:])
     elif sys.argv[1:2] == ["votes"]:
         votes_main(sys.argv[2:])
+    elif sys.argv[1:2] == ["lanes"]:
+        lanes_main(sys.argv[2:])
     elif sys.argv[1:2] == ["soak"]:
         soak_main(sys.argv[2:])
     elif os.environ.get("TM_TPU_BENCH_WORKER") == "1":

@@ -45,10 +45,15 @@ DEFAULT_WINDOW = 64
 
 
 def replay_window() -> int:
-    """TM_TPU_REPLAY_WINDOW: max heights decoded ahead of apply."""
+    """TM_TPU_INGRESS_REPLAY_WINDOW: max heights decoded ahead of apply
+    (legacy TM_TPU_REPLAY_WINDOW honored with a DeprecationWarning)."""
+    from ..ops import ingress as _fabric
+
+    v = _fabric.env_setting("TM_TPU_INGRESS_REPLAY_WINDOW",
+                            "TM_TPU_REPLAY_WINDOW")
     try:
-        return max(int(os.environ.get("TM_TPU_REPLAY_WINDOW", "")), 1)
-    except ValueError:
+        return max(int(v), 1)
+    except (TypeError, ValueError):
         return DEFAULT_WINDOW
 
 
@@ -190,11 +195,23 @@ class ReplayEngine:
     def __init__(self, window: Optional[int] = None,
                  synchronous: bool = False,
                  verifier=None, result_timeout: float = 600.0):
+        from ..ops import ingress as _fabric
+
         self._window = int(window) if window else replay_window()
         self._synchronous = bool(synchronous)
         self._verifier = verifier  # injected for tests; default shared
         self._timeout = float(result_timeout)
         self._writer: Optional[_Writer] = None
+        # the `replay` lane: fused range chunks ride the shared fabric
+        # at REPLAY priority (stepped — chunk cuts are data-dependent,
+        # the scheduler never flushes for us: replay stays deterministic)
+        self._lane = _fabric.shared_engine().register(_fabric.LaneSpec(
+            name="replay",
+            priority=_fabric.PRIORITY_REPLAY,
+            stepped=True,
+            closed_msg="replay engine is closed",
+            verifier=verifier,
+        ))
         # cumulative stats (deterministic: counts derive only from the
         # replayed chain, not from timing)
         self.ranges = 0
@@ -205,12 +222,6 @@ class ReplayEngine:
         self.heights_applied = 0
 
     # -- plumbing --------------------------------------------------------
-
-    def _pipeline(self):
-        from ..ops import pipeline as _pipeline
-
-        return self._verifier if self._verifier is not None \
-            else _pipeline.shared_verifier()
 
     @staticmethod
     def _group_cap() -> int:
@@ -245,6 +256,7 @@ class ReplayEngine:
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        self._lane.close(timeout=0.0)
 
     # -- the range verifier ---------------------------------------------
 
@@ -290,11 +302,7 @@ class ReplayEngine:
     def _replay_range(self, state, blocks, save, apply, applied,
                       should_stop, out: ReplayOutcome, fid) -> object:
         """One epoch range: blocks[0..n] covering heights h0..h0+n-1."""
-        from ..ops.pipeline import (
-            DispatchError,
-            PRIORITY_REPLAY,
-            EntryBlock,
-        )
+        from ..ops.pipeline import DispatchError
         from concurrent.futures import TimeoutError as _FutTimeout
 
         chain_id = state.chain_id
@@ -343,37 +351,22 @@ class ReplayEngine:
                 should_stop, out,
             )
         # pack prepared heights into device chunks of up to ~max_coalesce
-        # signatures; every chunk is ONE submit (the pipeline launches a
-        # full bucket per chunk instead of one launch per height)
-        cap = self._group_cap()
-        chunks = []  # (future, [(height, off, len, conclude)])
-        cur_entries: list = []
-        cur_spans: list = []
-        cur_sigs = 0
-        verifier = self._pipeline()
+        # signatures through the fabric's BlockFuser; every chunk is ONE
+        # lane submit (the pipeline launches a full bucket per chunk
+        # instead of one launch per height)
+        from ..ops import ingress as _fabric
 
-        def _flush() -> None:
-            nonlocal cur_entries, cur_spans, cur_sigs
-            if not cur_entries:
-                return
-            block = (
-                cur_entries[0] if len(cur_entries) == 1
-                else EntryBlock.concat(cur_entries)
-            )
-            fut = verifier.submit(
-                block, flow=fid, priority=PRIORITY_REPLAY
-            )
-            self.sigs_submitted += len(block)
-            chunks.append((fut, cur_spans))
-            cur_entries, cur_spans, cur_sigs = [], [], 0
+        chunks = []  # (future, [((height, conclude), off, len)])
 
+        def _chunk_done(fut, spans) -> None:
+            self.sigs_submitted += spans[-1][1] + spans[-1][2]
+            chunks.append((fut, spans))
+
+        fuser = _fabric.BlockFuser(self._lane, self._group_cap(),
+                                   _chunk_done, flow=fid)
         for height, entries, conclude in prepared:
-            if cur_sigs and cur_sigs + len(entries) > cap:
-                _flush()
-            cur_spans.append((height, cur_sigs, len(entries), conclude))
-            cur_entries.append(entries)
-            cur_sigs += len(entries)
-        _flush()
+            fuser.add((height, conclude), entries)
+        fuser.flush()
 
         # resolve chunks in order, applying each chunk's heights while
         # later chunks are still in flight on the device
@@ -390,7 +383,7 @@ class ReplayEngine:
                     self._range_resume(blocks, state), n,
                     save, apply, applied, should_stop, out,
                 )
-            for height, off, ln, conclude in spans:
+            for (height, conclude), off, ln in spans:
                 try:
                     conclude(valid[off : off + ln])
                 except (ValueError, RuntimeError):

@@ -40,20 +40,23 @@ flush_pending(), which host-verifies every open window in submission
 order and applies inline — flush points are a pure function of message
 arrival, so cluster runs stay replay-exact.
 
-Threading (threaded mode): one flusher thread per accumulator. Verifier
-done-callbacks run on the pipeline resolver thread and ONLY enqueue —
-the apply callback must never take consensus locks (ConsensusState's is
-queue.put + wake, both lock-free from the resolver's perspective).
+Threading (threaded mode): the shared ingress fabric's one scheduler
+flushes the lane. Verifier done-callbacks run on the pipeline resolver
+thread and ONLY enqueue — the apply callback must never take consensus
+locks (ConsensusState's is queue.put + wake, both lock-free from the
+resolver's perspective).
 
-Knobs: TM_TPU_VOTE_BATCH (default 128 sigs) and TM_TPU_VOTE_WINDOW_MS
-(default 2 ms).
+Since ISSUE 17 the windowing machinery lives in ops/ingress.py (the
+one ingress fabric): this module keeps the vote-shaped host stage —
+memo consult, (height, epoch) window keys, val_idx attachment — as a
+LaneSpec plus callbacks. Knobs: TM_TPU_INGRESS_VOTES_BATCH (default
+128 sigs) and TM_TPU_INGRESS_VOTES_WINDOW_MS (default 2 ms); legacy
+TM_TPU_VOTE_BATCH / TM_TPU_VOTE_WINDOW_MS still honored with a
+DeprecationWarning.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-import time
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -128,71 +131,102 @@ def vote_ingress_stats() -> dict:
 
 
 class VoteIngress:
-    """Window/size-batched live-vote signature verification.
+    """Window/size-batched live-vote signature verification — a `votes`
+    lane on the shared ingress fabric (ops/ingress.py).
 
     submit(pend, val_set) queues one host-checked vote. Windows are
     keyed by (height, valset epoch) and flush as ONE EntryBlock to the
-    shared verifier at PRIORITY_CONSENSUS after `max_batch` votes or
-    `window_ms` past the oldest entry. Verdicts come back through the
-    apply callback in window submission order; the callback only
-    enqueues (see module docstring).
+    shared verifier at PRIORITY_CONSENSUS after the lane's batch target
+    or window elapses. Verdicts come back through the apply callback in
+    window submission order; the callback only enqueues (see module
+    docstring). The lane carries the consensus hot path's 5 ms p99
+    budget: when adaptive, the deadline-aware flush fires early enough
+    that submit + expected device service still fit it.
 
-    stepped=True builds a threadless accumulator for simnet: nothing
-    flushes until flush_pending() — called by the consensus pump when
-    its queue drains — host-verifies and applies inline."""
+    stepped=True builds a threadless lane for simnet: nothing flushes
+    until flush_pending() — called by the consensus pump when its queue
+    drains — host-verifies and applies inline."""
 
     def __init__(self, apply_fn: ApplyFn, verifier=None,
                  max_batch: Optional[int] = None,
                  window_ms: Optional[float] = None,
                  stepped: bool = False, metrics=None):
-        if max_batch is None:
-            max_batch = int(os.environ.get("TM_TPU_VOTE_BATCH",
-                                           DEFAULT_BATCH))
-        if window_ms is None:
-            window_ms = float(os.environ.get("TM_TPU_VOTE_WINDOW_MS",
-                                             DEFAULT_WINDOW_MS))
+        from ..ops import ingress as _fabric
+
+        cfg = _fabric.resolve_lane_config(
+            "votes", batch=max_batch, window_ms=window_ms,
+            legacy_batch="TM_TPU_VOTE_BATCH",
+            legacy_window="TM_TPU_VOTE_WINDOW_MS",
+        )
         self._apply = apply_fn
-        self._max = max(int(max_batch), 1)
-        self._window_s = max(float(window_ms), 0.0) / 1000.0
-        self._stepped = bool(stepped)
-        self._v = verifier
-        self._v_hooked = False
         self.metrics = metrics
-        self._mtx = threading.Lock()
-        # (height, epoch-or-cold key) → [PendingVote]; insertion-ordered
-        # so stepped flushes replay in submission order
-        self._windows: Dict[Tuple, List[PendingVote]] = {}
-        self._inwindow: set = set()   # (h, r, type, idx, sig) dedup keys
-        self._epoch_keys: Dict[Tuple, Optional[bytes]] = {}
-        self._depth = 0
-        self._t_first = 0.0
-        self._wake = threading.Event()
-        self._full = threading.Event()
-        self._inflight = 0
-        self._stopped = threading.Event()
-        # counters (read via stats(); the metrics set mirrors them)
-        self.batches = 0
-        self.sigs = 0
         self.memo_hits = 0
-        self.window_dups = 0
-        self.sync_fallbacks = 0
-        self.preempted = 0
-        self.dispatch_errors = 0
-        self.apply_drops = 0
-        self._wait_ms_sum = 0.0
-        self._thread: Optional[threading.Thread] = None
-        if not self._stepped:
-            self._thread = threading.Thread(
-                target=self._flusher, daemon=True, name="vote-ingress-flush"
-            )
-            self._thread.start()
+        self.apply_drops = 0    # consensus/state.py bumps this directly
+        self._epoch_keys: Dict[Tuple, Optional[bytes]] = {}
+        self._lane = _fabric.shared_engine().register(_fabric.LaneSpec(
+            name="votes",
+            priority=_fabric.PRIORITY_CONSENSUS,
+            batch=cfg.batch,
+            window_ms=cfg.window_ms,
+            budget_ms=cfg.budget_ms,
+            adaptive=cfg.adaptive,
+            stepped=bool(stepped),
+            full_by_window=True,     # size trigger per (height, epoch)
+            device_threshold=BATCH_VERIFY_THRESHOLD,
+            submit_error_to_host=True,  # host path is always available
+            closed_msg="vote ingress is closed",
+            verifier=verifier,
+            entries_fn=lambda p: (p.pub, p.msg, p.vote.signature),
+            attach_fn=self._attach,
+            flow_fn=lambda p: p.flow,
+            trace_fn=self._trace,
+            host_fn=self._host_check,
+            deliver=self._deliver,
+            observer=self,
+        ))
         _ACTIVE.add(self)
 
     @property
     def stepped(self) -> bool:
-        return self._stepped
+        return self._lane.spec.stepped
 
-    # -- wiring ----------------------------------------------------------
+    # -- lane callbacks ---------------------------------------------------
+
+    def _deliver(self, items, verdicts, err) -> None:
+        """Hand one window's verdicts (or its error) to the apply
+        callback — enqueue-only by contract, so the fabric may call this
+        straight from the pipeline resolver thread."""
+        self._apply([it.item for it in items], verdicts, err)
+
+    def _host_check(self, batch: List[PendingVote]) -> List[bool]:
+        """The sync fallback: verify on the host — through
+        crypto.ed25519.verify_zip215_fast so simnet's _SigMemo memoizes
+        the verdicts."""
+        return [
+            bool(_ed.verify_zip215_fast(p.pub, p.msg, p.vote.signature))
+            for p in batch
+        ]
+
+    @staticmethod
+    def _attach(block, key: Tuple, batch: List[PendingVote]) -> None:
+        """Warm-epoch windows carry val_idx + epoch_key so kernels
+        gather A on device (key[1] is the epoch key iff warm)."""
+        ek = key[1] if isinstance(key[1], bytes) else None
+        if ek is not None:
+            block.val_idx = np.array(
+                [p.vote.validator_index for p in batch], dtype=np.int32
+            )
+            block.epoch_key = ek
+
+    @staticmethod
+    def _trace(batch: List[PendingVote], flow: int) -> None:
+        if _trace.TRACER.enabled:
+            _trace.TRACER.flow_point(
+                "vote_ingress.flush", flow, "t",
+                n=len(batch), height=batch[0].vote.height,
+            )
+
+    # -- legacy metric mirror (fabric observer) ---------------------------
 
     def _metrics(self):
         if self.metrics is None:
@@ -201,27 +235,33 @@ class VoteIngress:
             self.metrics = _m.vote_ingress_metrics()
         return self.metrics
 
-    def _ensure_verifier(self):
-        if self._v is None:
-            from ..ops import pipeline as _pl
+    def flush(self, n: int, wait_ms: float) -> None:
+        try:
+            m = self._metrics()
+            m.batches.inc()
+            m.batch_sigs.inc(n)
+            m.batch_wait_ms.observe(wait_ms)
+        except Exception:  # noqa: BLE001 — observability never fatal
+            pass
 
-            self._v = _pl.shared_verifier()
-        if not self._v_hooked:
-            self._v_hooked = True
-            hook = getattr(self._v, "add_preempt_hook", None)
-            if hook is not None:
-                hook(self._note_preempt)
-        return self._v
+    def sync_fallback(self) -> None:
+        try:
+            self._metrics().sync_fallbacks.inc()
+        except Exception:  # noqa: BLE001
+            pass
 
-    def _note_preempt(self, n: int) -> None:
-        self.preempted += n
+    def dispatch_error(self) -> None:
+        try:
+            self._metrics().dispatch_errors.inc()
+        except Exception:  # noqa: BLE001
+            pass
 
-    # -- submission ------------------------------------------------------
+    # -- submission -------------------------------------------------------
 
     def submit(self, pend: PendingVote, val_set) -> None:
         """Queue one host-checked vote. The verdict reaches the apply
         callback later (possibly immediately, on a memo hit)."""
-        if self._stopped.is_set():
+        if self._lane._closed:
             raise RuntimeError("vote ingress is closed")
         vote = pend.vote
         sig = vote.signature
@@ -236,26 +276,8 @@ class VoteIngress:
             return
         dkey = (vote.height, vote.round, vote.type,
                 vote.validator_index, sig)
-        full = False
-        with self._mtx:
-            if dkey in self._inwindow:
-                self.window_dups += 1
-                return
-            wkey = self._window_key(vote.height, val_set)
-            win = self._windows.get(wkey)
-            if win is None:
-                win = self._windows[wkey] = []
-            if not self._depth:
-                self._t_first = pend.t_enq or time.perf_counter()
-            win.append(pend)
-            self._inwindow.add(dkey)
-            self._depth += 1
-            if not self._stepped:
-                full = (len(win) >= self._max or self._window_s <= 0.0)
-        if full:
-            self._full.set()
-        if not self._stepped:
-            self._wake.set()
+        self._lane.submit(pend, key=self._window_key(vote.height, val_set),
+                          dedup_key=dkey, t_enq=pend.t_enq or None)
 
     def _window_key(self, height: int, val_set) -> Tuple:
         """(height, epoch key) when the epoch cache knows this valset
@@ -278,194 +300,34 @@ class VoteIngress:
         return (height, ek) if ek is not None else vkey
 
     def flush_now(self) -> None:
-        if self._stepped:
-            self.flush_pending()
-        else:
-            self._full.set()
-            self._wake.set()
-
-    # -- flusher (threaded mode) -----------------------------------------
-
-    def _flusher(self) -> None:
-        while True:
-            with self._mtx:
-                have = self._depth > 0
-                t_first = self._t_first
-            if not have:
-                if self._stopped.is_set():
-                    break
-                self._wake.wait(0.05)
-                self._wake.clear()
-                continue
-            if self._window_s > 0.0 and not self._stopped.is_set():
-                remaining = t_first + self._window_s - time.perf_counter()
-                if remaining > 0 and not self._full.is_set():
-                    self._full.wait(remaining)
-            self._full.clear()
-            for key, batch in self._take_windows():
-                self._flush_window(key, batch)
-
-    def _take_windows(self) -> List[Tuple[Tuple, List[PendingVote]]]:
-        with self._mtx:
-            taken = list(self._windows.items())
-            self._windows = {}
-            self._inwindow.clear()
-            self._depth = 0
-            self._t_first = 0.0
-        return taken
-
-    def _note_flush(self, batch: List[PendingVote]) -> None:
-        now = time.perf_counter()
-        wait_ms = max(
-            (now - min((p.t_enq or now) for p in batch)) * 1e3, 0.0
-        )
-        self.batches += 1
-        self.sigs += len(batch)
-        self._wait_ms_sum += wait_ms
-        try:
-            m = self._metrics()
-            m.batches.inc()
-            m.batch_sigs.inc(len(batch))
-            m.batch_wait_ms.observe(wait_ms)
-        except Exception:  # noqa: BLE001 — observability never fatal
-            pass
-
-    def _flush_window(self, key: Tuple, batch: List[PendingVote]) -> None:
-        self._note_flush(batch)
-        # sub-threshold windows stay on the host — unless the bench
-        # force-device discipline is on (TM_TPU_FORCE_DEVICE, same as
-        # types.validation): the per-vote baseline column must pay the
-        # relay cost per launch, never quietly route to host crypto
-        force = os.environ.get("TM_TPU_FORCE_DEVICE", "0") == "1"
-        if self._stepped or (len(batch) < BATCH_VERIFY_THRESHOLD
-                             and not force):
-            self._host_verify(batch)
-            return
-        try:
-            from ..ops import pipeline as _pl
-            from ..ops.entry_block import EntryBlock
-
-            block = EntryBlock.from_entries(
-                [(p.pub, p.msg, p.vote.signature) for p in batch]
-            )
-            ek = key[1] if isinstance(key[1], bytes) else None
-            if ek is not None:
-                block.val_idx = np.array(
-                    [p.vote.validator_index for p in batch], dtype=np.int32
-                )
-                block.epoch_key = ek
-            flow = next((p.flow for p in batch if p.flow is not None), None)
-            if flow is not None and _trace.TRACER.enabled:
-                _trace.TRACER.flow_point(
-                    "vote_ingress.flush", flow, "t",
-                    n=len(batch), height=batch[0].vote.height,
-                )
-            with self._mtx:
-                self._inflight += 1
-            fut = self._ensure_verifier().submit(
-                block, flow=flow, priority=_pl.PRIORITY_CONSENSUS
-            )
-        except Exception:  # noqa: BLE001 — engine absent or closed:
-            # the host path is always available, so a window that could
-            # not even be SUBMITTED verifies synchronously instead of
-            # failing (only post-submit DispatchErrors poison a window)
-            with self._mtx:
-                self._inflight = max(self._inflight - 1, 0)
-            self._host_verify(batch)
-            return
-        # done-callback runs on the pipeline resolver: the apply
-        # callback is enqueue-only by contract, so calling it here is
-        # safe and keeps verdict→apply latency at one queue hop
-        fut.add_done_callback(
-            lambda f, b=batch: self._on_device_done(b, f)
-        )
-
-    def _on_device_done(self, batch: List[PendingVote], fut) -> None:
-        with self._mtx:
-            self._inflight = max(self._inflight - 1, 0)
-        err = fut.exception()
-        if err is not None:
-            # poisoned window: exactly these votes fall back; later
-            # windows keep flowing
-            self._deliver_error(batch, err)
-            return
-        try:
-            verdicts = [bool(v) for v in np.asarray(fut.result())]
-            self._apply(batch, verdicts, None)
-        except Exception as e:  # noqa: BLE001
-            self._deliver_error(batch, e)
-
-    def _deliver_error(self, batch: List[PendingVote],
-                       err: BaseException) -> None:
-        self.dispatch_errors += 1
-        try:
-            self._metrics().dispatch_errors.inc()
-        except Exception:  # noqa: BLE001
-            pass
-        self._apply(batch, None, err)
-
-    def _host_verify(self, batch: List[PendingVote]) -> None:
-        """The sync fallback: verify on the host — through
-        crypto.ed25519.verify_zip215_fast so simnet's _SigMemo memoizes
-        the verdicts — and apply."""
-        self.sync_fallbacks += 1
-        try:
-            self._metrics().sync_fallbacks.inc()
-        except Exception:  # noqa: BLE001
-            pass
-        verdicts = [
-            bool(_ed.verify_zip215_fast(p.pub, p.msg, p.vote.signature))
-            for p in batch
-        ]
-        self._apply(batch, verdicts, None)
-
-    # -- stepped mode -----------------------------------------------------
+        self._lane.flush_now()
 
     def flush_pending(self) -> bool:
         """Stepped-mode flush point (ConsensusState.process_pending when
         its queue drains): host-verify every open window in submission
         order and apply inline. Returns True when anything flushed —
         the pump then re-drains its queue for the verdict messages."""
-        taken = self._take_windows()
-        if not taken:
-            return False
-        for _key, batch in taken:
-            self._note_flush(batch)
-            self._host_verify(batch)
-        return True
+        return self._lane.flush_pending()
 
     # -- lifecycle / introspection ----------------------------------------
 
     def stats(self) -> dict:
-        with self._mtx:
-            depth = self._depth
+        s = self._lane.stats()
         return {
-            "queue_depth": depth,
-            "batches": self.batches,
-            "sigs": self.sigs,
+            "queue_depth": s["queue_depth"],
+            "batches": s["batches"],
+            "sigs": s["sigs"],
             "memo_hits": self.memo_hits,
-            "window_dups": self.window_dups,
-            "sync_fallbacks": self.sync_fallbacks,
-            "batch_wait_ms_avg": (
-                self._wait_ms_sum / self.batches if self.batches else 0.0
-            ),
-            "preemptions": self.preempted,
-            "dispatch_errors": self.dispatch_errors,
+            "window_dups": s["window_dups"],
+            "sync_fallbacks": s["sync_fallbacks"],
+            "batch_wait_ms_avg": s["batch_wait_ms_avg"],
+            "preemptions": s["preemptions"],
+            "dispatch_errors": s["dispatch_errors"],
             "apply_drops": self.apply_drops,
-            "max_batch": self._max,
-            "window_ms": self._window_s * 1e3,
-            "stepped": self._stepped,
+            "max_batch": s["max_batch"],
+            "window_ms": s["window_ms"],
+            "stepped": s["stepped"],
         }
 
     def close(self, timeout: float = 10.0) -> None:
-        self._stopped.set()
-        self._wake.set()
-        self._full.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._mtx:
-                if self._inflight == 0:
-                    break
-            time.sleep(0.005)
+        self._lane.close(timeout=timeout)

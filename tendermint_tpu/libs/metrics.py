@@ -437,6 +437,77 @@ class BlockSyncMetrics:
         )
 
 
+class IngressMetrics:
+    """One ingress fabric (ISSUE 17): the unified per-lane metric set
+    pushed by ops/ingress.py IngressEngine. Every series carries a
+    `lane` label (mempool|votes|light|replay) — the canonical names for
+    what used to be four parallel sets. The old per-workload names
+    (mempool_ingress_*, vote_ingress_*) are still written by the lane
+    wrappers as ALIASES so /status, soak SLO evaluation, and existing
+    dashboards keep working unchanged."""
+
+    def __init__(self, registry: Registry):
+        self.queue_depth = registry.gauge(
+            "ingress", "queue_depth",
+            "Signatures waiting in a lane's open windows, by lane label.",
+        )
+        self.batch_wait_ms = registry.histogram(
+            "ingress", "batch_wait_ms",
+            "Milliseconds the oldest item of each window waited before "
+            "its flush, by lane label.",
+            buckets=[0.5, 1, 2.5, 5, 10, 25, 50, 100, 250],
+            labeled=True,
+        )
+        self.batches = registry.counter(
+            "ingress", "batches",
+            "Windows flushed through the fabric, by lane label.",
+        )
+        self.sigs = registry.counter(
+            "ingress", "sigs",
+            "Signatures flushed through the fabric (windowed + "
+            "whole-block), by lane label.",
+        )
+        self.host_lane_sigs = registry.counter(
+            "ingress", "host_lane_sigs",
+            "Signatures route_fn-directed to the host lane (schemes "
+            "without a device kernel), by lane label.",
+        )
+        self.sync_fallbacks = registry.counter(
+            "ingress", "sync_fallbacks",
+            "Windows host-verified as a fallback (sub-threshold, "
+            "stepped mode, or engine absent), by lane label.",
+        )
+        self.dispatch_errors = registry.counter(
+            "ingress", "dispatch_errors",
+            "Windows poisoned by a DispatchError and handed back for "
+            "per-item retry, by lane label.",
+        )
+        self.preemptions = registry.counter(
+            "ingress", "preemptions",
+            "Queued lane batches bypassed by a higher-priority batch in "
+            "the QoS dispatch queue, by lane label.",
+        )
+        self.blocks = registry.counter(
+            "ingress", "blocks",
+            "Whole-block passthrough submissions (light stages, mempool "
+            "recheck, replay fused chunks), by lane label.",
+        )
+        self.window_ms = registry.gauge(
+            "ingress", "window_ms",
+            "Current adaptive window length per lane (the controller's "
+            "base trigger, before the SLO deadline bound).",
+        )
+        self.batch_target = registry.gauge(
+            "ingress", "batch_target",
+            "Current adaptive batch-size trigger per lane.",
+        )
+        self.deadline_flushes = registry.counter(
+            "ingress", "deadline_flushes",
+            "Flushes fired early by the SLO deadline bound (budget minus "
+            "service-time headroom), by lane label.",
+        )
+
+
 class P2PMetrics:
     """p2p/metrics.go — the router metric set. peers is sampled by a
     registry collect hook at scrape time."""
@@ -631,6 +702,21 @@ def vote_ingress_metrics() -> "VoteIngressMetrics":
         if _global_vote_ingress is None:
             _global_vote_ingress = VoteIngressMetrics(global_registry())
         return _global_vote_ingress
+
+
+_global_ingress: Optional["IngressMetrics"] = None
+
+
+def ingress_metrics() -> "IngressMetrics":
+    """Process-wide IngressMetrics — the one labeled set behind every
+    fabric lane (ops/ingress.py). Same sharing rationale as
+    mempool_metrics(): the fabric's scheduler/completer are process
+    infrastructure, so its counters live on the process registry."""
+    global _global_ingress
+    with _global_mtx:
+        if _global_ingress is None:
+            _global_ingress = IngressMetrics(global_registry())
+        return _global_ingress
 
 
 _global_blocksync: Optional["BlockSyncMetrics"] = None

@@ -30,8 +30,15 @@ carries one flow id — `light.rpc_arrival` (s) → `light.prepare` →
 (and `pipeline.mesh_pack` when mesh lanes are on) → `light.verdict` (f)
 — so one Perfetto chain spans RPC arrival to verdict delivery.
 
-Knobs: TM_TPU_LIGHT_INFLIGHT (max unresolved unique verifications, 256),
-TM_TPU_LIGHT_MEMO (verdict memo entries, 4096; 0 disables).
+Since ISSUE 17 stage submission rides the `light` lane of the shared
+ingress fabric (ops/ingress.py) — a whole-block passthrough at
+CONSENSUS priority with per-lane labeled metrics; the single-flight,
+memo, and plan machinery here IS the lane's host stage.
+
+Knobs: TM_TPU_INGRESS_LIGHT_INFLIGHT (max unresolved unique
+verifications, 256) and TM_TPU_INGRESS_LIGHT_MEMO (verdict memo
+entries, 4096; 0 disables); legacy TM_TPU_LIGHT_INFLIGHT /
+TM_TPU_LIGHT_MEMO still honored with a DeprecationWarning.
 """
 
 from __future__ import annotations
@@ -123,22 +130,33 @@ class LightVerifyService:
     def __init__(self, verifier=None, now_fn=None,
                  max_inflight: Optional[int] = None,
                  memo_size: Optional[int] = None):
+        from ..ops import ingress as _fabric
+
         if verifier is None:
             from ..ops import pipeline as _pl
 
             verifier = _pl.shared_verifier()
         self._v = verifier
+        # the `light` lane: whole-block passthrough on the shared fabric
+        # (stepped — the service has no windows; stages submit directly)
+        self._lane = _fabric.shared_engine().register(_fabric.LaneSpec(
+            name="light",
+            priority=_fabric.PRIORITY_CONSENSUS,
+            stepped=True,
+            closed_msg="light verify service is closed",
+            verifier=verifier,
+        ))
         # injected clock (the light/ determinism contract): simnet
         # drives a virtual clock through here; wall clock is the default
         self._now_fn = now_fn or _now_ts
         if max_inflight is None:
-            max_inflight = int(
-                os.environ.get("TM_TPU_LIGHT_INFLIGHT", DEFAULT_MAX_INFLIGHT)
-            )
+            v = _fabric.env_setting("TM_TPU_INGRESS_LIGHT_INFLIGHT",
+                                    "TM_TPU_LIGHT_INFLIGHT")
+            max_inflight = int(v) if v is not None else DEFAULT_MAX_INFLIGHT
         if memo_size is None:
-            memo_size = int(
-                os.environ.get("TM_TPU_LIGHT_MEMO", DEFAULT_MEMO_SIZE)
-            )
+            v = _fabric.env_setting("TM_TPU_INGRESS_LIGHT_MEMO",
+                                    "TM_TPU_LIGHT_MEMO")
+            memo_size = int(v) if v is not None else DEFAULT_MEMO_SIZE
         self._sem = threading.Semaphore(max(int(max_inflight), 1))
         self._memo_cap = max(int(memo_size), 0)
         self._memo: "OrderedDict[tuple, dict]" = OrderedDict()
@@ -253,7 +271,8 @@ class LightVerifyService:
         pend.acquired = True
         try:
             futs = [
-                self._v.submit(st.entries, flow=fid) for st in entry_stages
+                self._lane.submit_block(st.entries, flow=fid)
+                for st in entry_stages
             ]
         except Exception as e:  # noqa: BLE001 — closed/overloaded verifier
             pend.infra = True  # transient: a retry may succeed — no memo
@@ -333,8 +352,10 @@ class LightVerifyService:
 
     def close(self) -> None:
         """Retire the service. The underlying verifier is SHARED (the
-        node's consensus path uses it too) and is not closed here."""
+        node's consensus path uses it too) and is not closed here; the
+        fabric lane unregisters so /status stops counting it."""
         self._closed = True
+        self._lane.close(timeout=0.0)
 
 
 # ---------------------------------------------------------------------------

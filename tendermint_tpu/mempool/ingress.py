@@ -30,22 +30,27 @@ Scheme lanes (the 2302.00418 story):
              unverifiable sig is an explicit rejection, not an accept.
 
 Threading (the deadlock rule this module exists to respect): completion
-work that takes the mempool lock runs on the accumulator's OWN completer
+work that takes the mempool lock runs on the ingress fabric's completer
 thread, never on the pipeline's resolver thread — consensus holds the
 mempool lock across update()→recheck while waiting on pipeline futures,
 so a resolver blocked on that lock would deadlock the process. Verifier
 done-callbacks only enqueue; the completer does the locking.
+
+Since ISSUE 17 the windowing machinery itself lives in ops/ingress.py
+(the one ingress fabric): this module keeps the envelope format, the
+host-stage scheme routing, and the verdict-future delivery — a LaneSpec
+plus callbacks. Knobs: TM_TPU_INGRESS_MEMPOOL_BATCH / _WINDOW_MS
+(legacy TM_TPU_MEMPOOL_BATCH / TM_TPU_MEMPOOL_WINDOW_MS still honored
+with a DeprecationWarning).
 """
 
 from __future__ import annotations
 
-import os
-import queue
-import threading
-import time
 import weakref
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence
+
+from ..ops import ingress as _fabric
 
 MAGIC = b"\xc1TX1"
 SCHEME_ED25519 = 0
@@ -156,15 +161,6 @@ def host_verify(stx: SignedTx) -> bool:
     return False
 
 
-class _Pending:
-    __slots__ = ("stx", "future", "t_enq")
-
-    def __init__(self, stx: SignedTx, t_enq: float):
-        self.stx = stx
-        self.future: "Future[bool]" = Future()
-        self.t_enq = t_enq
-
-
 # live accumulators for /status aggregation (rpc/core.py)
 _ACTIVE: "weakref.WeakSet[IngressAccumulator]" = weakref.WeakSet()
 
@@ -195,61 +191,88 @@ def ingress_stats() -> dict:
 
 
 class IngressAccumulator:
-    """Window/size-batched CheckTx signature verification.
+    """Window/size-batched CheckTx signature verification — a `mempool`
+    lane on the shared ingress fabric (ops/ingress.py).
 
     submit(stx) returns a Future[bool] sig verdict. ed25519 entries
-    accumulate until `max_batch` signatures or `window_ms` after the
-    oldest entry, then flush as ONE EntryBlock into the shared verifier
-    at PRIORITY_INGRESS; sr25519/secp256k1 entries flush on the same
-    clock through their host lanes. Verdict futures resolve on the
-    accumulator's completer thread (see the module docstring for why
-    that thread exists). A DispatchError from the device poisons ONLY
-    its own window's futures — later windows are untouched.
+    accumulate until the lane's batch target or window elapses, then
+    flush as ONE EntryBlock into the shared verifier at
+    PRIORITY_INGRESS; sr25519/secp256k1 entries flush on the same clock
+    through their host lanes. Verdict futures resolve on the fabric's
+    completer thread (see the module docstring for why that thread
+    exists). A DispatchError from the device poisons ONLY its own
+    window's futures — later windows are untouched.
 
-    Knobs: TM_TPU_MEMPOOL_BATCH (default 256 sigs) and
-    TM_TPU_MEMPOOL_WINDOW_MS (default 4 ms)."""
+    Explicit max_batch/window_ms pin the window (deterministic, the
+    pre-fabric behavior); defaulted knobs get the adaptive SLO-aware
+    controller unless TM_TPU_INGRESS_MEMPOOL_ADAPTIVE says otherwise."""
 
     def __init__(self, verifier=None, max_batch: Optional[int] = None,
                  window_ms: Optional[float] = None, metrics=None):
-        if max_batch is None:
-            max_batch = int(os.environ.get("TM_TPU_MEMPOOL_BATCH",
-                                           DEFAULT_BATCH))
-        if window_ms is None:
-            window_ms = float(os.environ.get("TM_TPU_MEMPOOL_WINDOW_MS",
-                                             DEFAULT_WINDOW_MS))
-        self._max = max(int(max_batch), 1)
-        self._window_s = max(float(window_ms), 0.0) / 1000.0
-        self._v = verifier
-        self._v_hooked = False
+        cfg = _fabric.resolve_lane_config(
+            "mempool", batch=max_batch, window_ms=window_ms,
+            legacy_batch="TM_TPU_MEMPOOL_BATCH",
+            legacy_window="TM_TPU_MEMPOOL_WINDOW_MS",
+        )
         self.metrics = metrics
-        self._mtx = threading.Lock()
-        self._pend_dev: List[_Pending] = []    # ed25519 → device lane
-        self._pend_host: List[_Pending] = []   # sr25519/secp256k1 lanes
-        self._t_first = 0.0
-        self._wake = threading.Event()   # new work for the flusher
-        self._full = threading.Event()   # batch hit max: flush now
-        self._cq: "queue.Queue" = queue.Queue()
-        self._inflight = 0               # flushed-but-uncompleted batches
-        self._stopped = threading.Event()
-        # counters (read via stats(); the metrics set mirrors them)
-        self.batches = 0
-        self.sigs = 0
-        self.host_lane_sigs = 0
-        self.preempted = 0
-        self.dispatch_errors = 0
-        self._wait_ms_sum = 0.0
-        self._thread = threading.Thread(
-            target=self._flusher, daemon=True, name="mempool-ingress-flush"
-        )
-        self._cthread = threading.Thread(
-            target=self._completer, daemon=True,
-            name="mempool-ingress-complete",
-        )
-        self._thread.start()
-        self._cthread.start()
+        self._lane = _fabric.shared_engine().register(_fabric.LaneSpec(
+            name="mempool",
+            priority=_fabric.PRIORITY_INGRESS,
+            batch=cfg.batch,
+            window_ms=cfg.window_ms,
+            budget_ms=cfg.budget_ms,
+            adaptive=cfg.adaptive,
+            use_completer=True,      # delivery may take the mempool lock
+            closed_msg="ingress accumulator is closed",
+            verifier=verifier,
+            entries_fn=lambda s: (s.pub, s.signed_bytes(), s.sig),
+            route_fn=lambda s: s.scheme == SCHEME_ED25519,
+            host_fn=self._host_check,
+            deliver=self._deliver,
+            observer=self,
+        ))
         _ACTIVE.add(self)
 
-    # -- wiring ----------------------------------------------------------
+    # -- lane callbacks ---------------------------------------------------
+
+    def _deliver(self, items, verdicts, err) -> None:
+        """Resolve the per-tx verdict futures (fabric completer thread).
+        A window error fails exactly these futures — poisoned-window
+        isolation, the txs stay retryable upstream."""
+        if err is not None:
+            for it in items:
+                if not it.future.done():
+                    it.future.set_exception(err)
+            return
+        for it, ok in zip(items, verdicts):
+            if not it.future.done():
+                it.future.set_result(bool(ok))
+
+    def _host_check(self, stxs: List[SignedTx]) -> Sequence[bool]:
+        """Host-lane verification in item order: sr25519 as one native
+        batch (schnorrkel when built), secp256k1 (and anything future)
+        per-sig — the explicit non-batched path, never a silent drop."""
+        verdicts: List[bool] = [False] * len(stxs)
+        sr_idx = [i for i, s in enumerate(stxs)
+                  if s.scheme == SCHEME_SR25519]
+        if sr_idx:
+            try:
+                from ..crypto import sr25519 as _sr
+
+                vs = _sr.verify_batch(
+                    [(stxs[i].pub, stxs[i].signed_bytes(), stxs[i].sig)
+                     for i in sr_idx]
+                )
+            except Exception:  # noqa: BLE001 — reject, never drop
+                vs = [False] * len(sr_idx)
+            for i, ok in zip(sr_idx, vs):
+                verdicts[i] = bool(ok)
+        for i, s in enumerate(stxs):
+            if s.scheme != SCHEME_SR25519:
+                verdicts[i] = host_verify(s)
+        return verdicts
+
+    # -- legacy metric mirror (fabric observer) ---------------------------
 
     def _metrics(self):
         if self.metrics is None:
@@ -258,216 +281,43 @@ class IngressAccumulator:
             self.metrics = _m.mempool_metrics()
         return self.metrics
 
-    def _ensure_verifier(self):
-        if self._v is None:
-            from ..ops import pipeline as _pl
+    def depth(self, d: int) -> None:
+        self._metrics().ingress_queue_depth.set(d)
 
-            self._v = _pl.shared_verifier()
-        if not self._v_hooked:
-            self._v_hooked = True
-            hook = getattr(self._v, "add_preempt_hook", None)
-            if hook is not None:
-                hook(self._note_preempt)
-        return self._v
+    def flush(self, n: int, wait_ms: float) -> None:
+        m = self._metrics()
+        m.ingress_queue_depth.set(0)
+        m.ingress_batch_wait_ms.observe(wait_ms)
 
-    def _note_preempt(self, n: int) -> None:
-        self.preempted += n
-        try:
-            self._metrics().checktx_preemptions.inc(n)
-        except Exception:  # noqa: BLE001 — observability never fatal
-            pass
+    def preempt(self, n: int) -> None:
+        self._metrics().checktx_preemptions.inc(n)
 
-    # -- submission ------------------------------------------------------
+    # -- public API -------------------------------------------------------
 
     def submit(self, stx: SignedTx) -> "Future[bool]":
         """Queue one signature; the returned future resolves to the bool
         verdict (or raises DispatchError when the device window failed)
-        on the completer thread."""
-        if self._stopped.is_set():
-            raise RuntimeError("ingress accumulator is closed")
-        p = _Pending(stx, time.perf_counter())
-        with self._mtx:
-            lane = (self._pend_dev if stx.scheme == SCHEME_ED25519
-                    else self._pend_host)
-            if not self._pend_dev and not self._pend_host:
-                self._t_first = p.t_enq
-            lane.append(p)
-            depth = len(self._pend_dev) + len(self._pend_host)
-            full = depth >= self._max or self._window_s <= 0.0
-        m = self._metrics()
-        if m is not None:
-            m.ingress_queue_depth.set(depth)
-        if full:
-            self._full.set()
-        self._wake.set()
-        return p.future
+        on the fabric completer thread."""
+        return self._lane.submit(stx, want_future=True)
 
     def submit_block(self, block, priority: Optional[int] = None):
         """Raw EntryBlock passthrough for recheck: returns the PIPELINE
         future directly (resolved on the resolver thread, which never
         takes the mempool lock) — safe to wait on while holding the
         mempool lock, unlike the per-tx futures from submit()."""
-        from ..ops import pipeline as _pl
-
-        if priority is None:
-            priority = _pl.PRIORITY_INGRESS
-        return self._ensure_verifier().submit(block, priority=priority)
+        return self._lane.submit_block(block, priority=priority,
+                                       count=False)
 
     def flush_now(self) -> None:
-        self._full.set()
-        self._wake.set()
-
-    # -- flusher thread --------------------------------------------------
-
-    def _flusher(self) -> None:
-        while True:
-            with self._mtx:
-                have = bool(self._pend_dev or self._pend_host)
-                t_first = self._t_first
-            if not have:
-                if self._stopped.is_set():
-                    break
-                self._wake.wait(0.05)
-                self._wake.clear()
-                continue
-            if self._window_s > 0.0 and not self._stopped.is_set():
-                remaining = t_first + self._window_s - time.perf_counter()
-                if remaining > 0 and not self._full.is_set():
-                    self._full.wait(remaining)
-            self._full.clear()
-            self._flush()
-
-    def _flush(self) -> None:
-        with self._mtx:
-            dev, self._pend_dev = self._pend_dev, []
-            host, self._pend_host = self._pend_host, []
-            self._t_first = 0.0
-        if not dev and not host:
-            return
-        now = time.perf_counter()
-        wait_ms = max(
-            (now - min(p.t_enq for p in dev + host)) * 1e3, 0.0
-        )
-        self.batches += 1
-        self.sigs += len(dev) + len(host)
-        self.host_lane_sigs += len(host)
-        self._wait_ms_sum += wait_ms
-        m = self._metrics()
-        if m is not None:
-            m.ingress_queue_depth.set(0)
-            m.ingress_batch_wait_ms.observe(wait_ms)
-        if dev:
-            self._flush_device(dev)
-        if host:
-            self._cq.put(("host", host))
-
-    def _flush_device(self, dev: List[_Pending]) -> None:
-        try:
-            from ..ops.entry_block import EntryBlock
-
-            block = EntryBlock.from_entries(
-                [(p.stx.pub, p.stx.signed_bytes(), p.stx.sig) for p in dev]
-            )
-            with self._mtx:
-                self._inflight += 1
-            fut = self.submit_block(block)
-        except Exception as e:  # noqa: BLE001 — window isolation
-            with self._mtx:
-                self._inflight = max(self._inflight - 1, 0)
-            for p in dev:
-                if not p.future.done():
-                    p.future.set_exception(e)
-            return
-        # done-callback runs on the pipeline resolver: ONLY enqueue —
-        # the completer owns any work that may take the mempool lock
-        fut.add_done_callback(
-            lambda f, batch=dev: self._cq.put(("device", batch, f))
-        )
-
-    # -- completer thread ------------------------------------------------
-
-    def _completer(self) -> None:
-        while True:
-            item = self._cq.get()
-            if item is None:
-                break
-            if item[0] == "device":
-                _, batch, fut = item
-                self._complete_device(batch, fut)
-                with self._mtx:
-                    self._inflight = max(self._inflight - 1, 0)
-            else:
-                self._complete_host(item[1])
-
-    @staticmethod
-    def _deliver(p: _Pending, ok: bool) -> None:
-        if not p.future.done():
-            p.future.set_result(bool(ok))
-
-    def _complete_device(self, batch: List[_Pending], fut) -> None:
-        err = fut.exception()
-        if err is not None:
-            # poisoned window: exactly these futures fail; the
-            # accumulator and every later window keep flowing
-            self.dispatch_errors += 1
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(err)
-            return
-        verdicts = fut.result()
-        for p, ok in zip(batch, verdicts):
-            self._deliver(p, bool(ok))
-
-    def _complete_host(self, batch: List[_Pending]) -> None:
-        sr = [p for p in batch if p.stx.scheme == SCHEME_SR25519]
-        if sr:
-            try:
-                from ..crypto import sr25519 as _sr
-
-                verdicts = _sr.verify_batch(
-                    [(p.stx.pub, p.stx.signed_bytes(), p.stx.sig)
-                     for p in sr]
-                )
-            except Exception:  # noqa: BLE001 — reject, never drop
-                verdicts = [False] * len(sr)
-            for p, ok in zip(sr, verdicts):
-                self._deliver(p, bool(ok))
-        for p in batch:
-            if p.stx.scheme == SCHEME_SR25519:
-                continue
-            # secp256k1 (and anything future): per-sig host fallback —
-            # the explicit non-batched path, never a silent drop
-            self._deliver(p, host_verify(p.stx))
-
-    # -- lifecycle / introspection ---------------------------------------
+        self._lane.flush_now()
 
     def stats(self) -> dict:
-        with self._mtx:
-            depth = len(self._pend_dev) + len(self._pend_host)
-        return {
-            "queue_depth": depth,
-            "batches": self.batches,
-            "sigs": self.sigs,
-            "host_lane_sigs": self.host_lane_sigs,
-            "batch_wait_ms_avg": (
-                self._wait_ms_sum / self.batches if self.batches else 0.0
-            ),
-            "preemptions": self.preempted,
-            "dispatch_errors": self.dispatch_errors,
-            "max_batch": self._max,
-            "window_ms": self._window_s * 1e3,
-        }
+        s = self._lane.stats()
+        return {k: s[k] for k in (
+            "queue_depth", "batches", "sigs", "host_lane_sigs",
+            "batch_wait_ms_avg", "preemptions", "dispatch_errors",
+            "max_batch", "window_ms",
+        )}
 
     def close(self, timeout: float = 10.0) -> None:
-        self._stopped.set()
-        self._wake.set()
-        self._full.set()
-        self._thread.join(timeout=timeout)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._mtx:
-                if self._inflight == 0:
-                    break
-            time.sleep(0.005)
-        self._cq.put(None)
-        self._cthread.join(timeout=timeout)
+        self._lane.close(timeout=timeout)

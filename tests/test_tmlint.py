@@ -717,6 +717,107 @@ class TestLockDiscipline:
         ) == ["relay-ownership"]
 
 
+class TestIngressDiscipline:
+    """ISSUE 17: the four hand-rolled windowed accumulators were unified
+    behind ops/ingress.py; a fifth private batching stack (flush-timer
+    thread + EntryBlock assembly in one module) must never grow back."""
+
+    ACCUMULATOR_BUG = """
+        import threading
+        from ..ops.entry_block import EntryBlock
+
+        class MyAccumulator:
+            def __init__(self, verifier):
+                self._verifier = verifier
+                self._pending = []
+                self._thread = threading.Thread(
+                    target=self._flush_loop, daemon=True)
+                self._thread.start()
+
+            def _flush_loop(self):
+                while True:
+                    block = EntryBlock.from_entries(
+                        [(p.pub, p.msg, p.sig) for p in self._pending])
+                    self._verifier.submit(block)
+    """
+
+    def test_positive_private_accumulator(self):
+        """The exact pre-ISSUE-17 shape: a per-workload flusher thread
+        assembling EntryBlocks for submission."""
+        fs = lint(self.ACCUMULATOR_BUG, REACTOR_PATH, "ingress-discipline")
+        assert rules_of(fs) == ["ingress-discipline"]
+        assert "LaneSpec" in fs[0].message
+
+    def test_positive_window_timer_thread(self):
+        src = """
+            import threading
+            from .entry_block import EntryBlock
+
+            def start(pending, verifier):
+                def _window_timer():
+                    verifier.submit(EntryBlock.from_entries(pending))
+                threading.Thread(target=_window_timer).start()
+        """
+        assert rules_of(
+            lint(src, OPS_PATH, "ingress-discipline")
+        ) == ["ingress-discipline"]
+
+    def test_negative_assembly_without_thread(self):
+        """Building EntryBlocks alone is fine — the replay prep path and
+        every bench do it; the engine owns the flush cadence."""
+        src = """
+            from ..ops.entry_block import EntryBlock
+
+            def prepare(votes):
+                return EntryBlock.from_entries(
+                    [(v.pub, v.msg, v.sig) for v in votes])
+        """
+        assert not lint(src, REACTOR_PATH, "ingress-discipline")
+
+    def test_negative_thread_without_assembly(self):
+        """Threads with flush-ish targets but no EntryBlock assembly are
+        out of scope (the soak harness drains queues on threads)."""
+        src = """
+            import threading
+
+            def start(q):
+                threading.Thread(target=q.drain_loop, daemon=True).start()
+        """
+        assert not lint(src, REACTOR_PATH, "ingress-discipline")
+
+    def test_negative_unrelated_thread_target(self):
+        """A worker thread that is not a flush loop does not pair with
+        assembly elsewhere in the module."""
+        src = """
+            import threading
+            from .entry_block import EntryBlock
+
+            def start(sock, votes):
+                threading.Thread(target=sock.read_loop).start()
+                return EntryBlock.from_entries(votes)
+        """
+        assert not lint(src, OPS_PATH, "ingress-discipline")
+
+    def test_whitelisted_engine_module(self):
+        """The engine itself is the one sanctioned owner."""
+        assert not lint(self.ACCUMULATOR_BUG,
+                        "tendermint_tpu/ops/ingress.py",
+                        "ingress-discipline")
+
+    def test_suppressed(self):
+        src = """
+            import threading
+            from .entry_block import EntryBlock
+
+            def start(pending, verifier):
+                def _flush():
+                    verifier.submit(EntryBlock.from_entries(pending))
+                # tmlint: disable=ingress-discipline -- migration shim
+                threading.Thread(target=_flush).start()
+        """
+        assert not lint(src, OPS_PATH, "ingress-discipline")
+
+
 # ---------------------------------------------------------------------------
 # framework mechanics
 

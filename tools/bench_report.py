@@ -84,6 +84,13 @@ METRIC_KEYS = (
     # soak-harness artifacts (SOAK_r*, ISSUE 16)
     "consensus_commit_p99_ms", "light_verdict_p99_ms",
     "ingress_admission_p99_ms", "replay_heights_per_s",
+    # ingress-fabric curve artifacts (LANES_r*, ISSUE 17); the headline
+    # "value" is the adaptive policy's flood sigs/s
+    "lanes_adaptive_idle_p99_ms", "lanes_adaptive_sigs_per_window",
+    "lanes_shallow_flood_sigs_per_s", "lanes_shallow_idle_p99_ms",
+    "lanes_shallow_sigs_per_window", "lanes_deep_flood_sigs_per_s",
+    "lanes_deep_idle_p99_ms", "adaptive_window_grows",
+    "adaptive_window_shrinks",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -94,6 +101,9 @@ _LOWER_IS_BETTER = {
     # stays in the default higher-is-better direction
     "consensus_commit_p99_ms", "light_verdict_p99_ms",
     "ingress_admission_p99_ms",
+    # lanes-curve idle latencies regress on a RISE
+    "lanes_adaptive_idle_p99_ms", "lanes_shallow_idle_p99_ms",
+    "lanes_deep_idle_p99_ms",
 }
 
 # keys a COMPARE tracks by default (rate-like, present across most rounds)
@@ -103,10 +113,12 @@ COMPARE_KEYS = (
     "speedup_2v1", "light_unique_headers_per_s", "flood_latency_ratio",
     "vs_kernel_serial", "consensus_commit_p99_ms", "light_verdict_p99_ms",
     "ingress_admission_p99_ms", "replay_heights_per_s",
+    "lanes_adaptive_idle_p99_ms", "lanes_adaptive_sigs_per_window",
 )
 
 _NAME_RE = re.compile(
-    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK)_r(\d+)", re.I)
+    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES)_r(\d+)",
+    re.I)
 
 
 def _round_kind_from_name(path: str):
@@ -225,6 +237,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "BLOCKSYNC_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "VOTES_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "SOAK_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "LANES_r*.json")))
     return paths
 
 
@@ -242,7 +255,7 @@ def validate(art: dict) -> List[str]:
         probs.append("; ".join(art["notes"]))
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
-                           "blocksync", "votes", "soak"):
+                           "blocksync", "votes", "soak", "lanes"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

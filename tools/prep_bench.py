@@ -1323,6 +1323,211 @@ def run_replay(args) -> int:
     return rc
 
 
+def run_fabric(args) -> int:
+    """--fabric: the round-17 ingress-fabric gate on a mocked relay
+    (slow readback over REAL kernels — verdicts are live). Asserts what
+    unifying the four windowed accumulators bought:
+
+      one engine  all four lane patterns (mempool / votes / light /
+                  replay) register on ONE engine — exactly one
+                  flush-scheduler thread and one completer thread serve
+                  all of them, where the per-workload era ran four
+      adaptive    the consensus-pattern lane's window moves BOTH ways:
+                  it deepens under a flood (grows >= 1) and shrinks back
+                  on an idle trickle (shrinks >= 1)
+      parity      every signature's verdict arrives and the one forged
+                  signature is the ONLY rejection, on the right lane
+      no leak     zero buffer-pool slots remain in flight once drained
+    """
+    import threading
+
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.ops import ingress as fabric
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.ops.entry_block import EntryBlock
+
+    resolve_delay = 0.05
+    n_keys = 8
+    keys = [ed.gen_priv_key(bytes([i + 1]) * 32) for i in range(n_keys)]
+
+    def signed(lane: str, i: int):
+        sk = keys[i % n_keys]
+        msg = f"fabric/{lane}/{i}".encode()
+        return (sk.pub_key().bytes(), msg, sk.sign(msg), i)
+
+    rc = 0
+    print(f"prep_bench --fabric: lanes=4 resolve_delay={resolve_delay}s")
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay))
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    v = pl.AsyncBatchVerifier(depth=2, pool_depth=OVERLAP_POOL_DEPTH)
+    eng = fabric.IngressEngine()
+
+    mtx = threading.Lock()
+    results = {name: {} for name in ("mempool", "votes", "light")}
+
+    def sink(name):
+        def deliver(items, verdicts, err):
+            with mtx:
+                for i, it in enumerate(items):
+                    results[name][it.item[3]] = (
+                        None if err is not None else bool(verdicts[i]))
+        return deliver
+
+    def host_check(items):
+        return [ed.verify_zip215_fast(t[0], t[1], t[2]) for t in items]
+
+    common = dict(verifier=v, entries_fn=lambda t: t[:3],
+                  host_fn=host_check)
+    mp = eng.register(fabric.LaneSpec(
+        name="mempool", priority=fabric.PRIORITY_INGRESS, batch=32,
+        window_ms=4.0, use_completer=True, deliver=sink("mempool"),
+        **common))
+    vo = eng.register(fabric.LaneSpec(
+        name="votes", priority=fabric.PRIORITY_CONSENSUS, batch=16,
+        window_ms=4.0, adaptive=True, deliver=sink("votes"), **common))
+    li = eng.register(fabric.LaneSpec(
+        name="light", priority=fabric.PRIORITY_CONSENSUS, stepped=True,
+        deliver=sink("light"), **common))
+    rp = eng.register(fabric.LaneSpec(
+        name="replay", priority=fabric.PRIORITY_REPLAY, stepped=True,
+        **common))
+    try:
+        # -- one engine: four lanes, one scheduler, one completer --------
+        names = [t.name for t in threading.enumerate()]
+        n_sched = sum(n == "ingress-fabric-flush" for n in names)
+        n_comp = sum(n == "ingress-fabric-complete" for n in names)
+        print(f"  lanes registered           : {len(eng.lanes())} "
+              f"(flush threads={n_sched}, completer threads={n_comp})")
+        if len(eng.lanes()) != 4 or n_sched != 1 or n_comp != 1:
+            print("  FAIL: expected 4 lanes on exactly one scheduler + "
+                  "one completer thread", file=sys.stderr)
+            rc = 1
+
+        # -- mempool flood with one forged signature mid-flood -----------
+        # (pre-sign everything: purepy signing is slow enough that
+        # signing inside the submit loop would turn the flood into a
+        # trickle and never fill a window)
+        n_mp, forged_i = 96, 48
+        mp_items = []
+        for i in range(n_mp):
+            pub, msg, sig, idx = signed("mempool", i)
+            if i == forged_i:
+                bad = bytearray(sig)
+                bad[0] ^= 0x5A
+                sig = bytes(bad)
+            mp_items.append((pub, msg, sig, idx))
+        n_vo = 128
+        vo_items = [signed("votes", i) for i in range(n_vo)]
+        trickle_items = [signed("votes", n_vo + i) for i in range(20)]
+
+        for it in mp_items:
+            mp.submit(it)
+        mp.flush_now()
+
+        # -- votes flood: the window must DEEPEN -------------------------
+        # no flush_now() here: a manual flush would race the scheduler
+        # and claim the whole flood under CAUSE_MANUAL (which by design
+        # never adapts); the full-window force + timer tail drain it
+        for it in vo_items:
+            vo.submit(it)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with mtx:
+                if (len(results["mempool"]) >= n_mp
+                        and len(results["votes"]) >= n_vo):
+                    break
+            time.sleep(0.01)
+        grows = vo.ctrl.grows
+        print(f"  votes flood                : {n_vo} sigs -> window "
+              f"grows={grows} (target now {vo.ctrl.batch_target()}, "
+              f"base 16)")
+        if grows < 1:
+            print("  FAIL: a flood at the batch target must deepen the "
+                  "adaptive window", file=sys.stderr)
+            rc = 1
+
+        # -- votes idle trickle: the window must SHRINK back -------------
+        trickles = 0
+        for it in trickle_items:
+            if vo.ctrl.shrinks >= 1:
+                break
+            vo.submit(it)
+            trickles += 1
+            time.sleep(0.12)
+        shrinks = vo.ctrl.shrinks
+        print(f"  votes idle trickle         : {trickles} lone sigs -> "
+              f"window shrinks={shrinks} (target now "
+              f"{vo.ctrl.batch_target()})")
+        if shrinks < 1:
+            print("  FAIL: an idle trickle must shrink the adaptive "
+                  "window back toward its base", file=sys.stderr)
+            rc = 1
+
+        # -- stepped lanes: light host windows, replay block passthrough -
+        n_li = 16
+        for i in range(n_li):
+            li.submit(signed("light", i))
+        li.flush_pending()
+        blk = EntryBlock.from_entries(
+            [signed("replay", i)[:3] for i in range(16)])
+        rp_verdicts = list(rp.submit_block(blk).result(timeout=60))
+
+        # -- parity ------------------------------------------------------
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with mtx:
+                if len(results["votes"]) >= n_vo + trickles:
+                    break
+            time.sleep(0.01)
+        with mtx:
+            snapshot = {k: dict(d) for k, d in results.items()}
+        snapshot["replay"] = {i: bool(x) for i, x in enumerate(rp_verdicts)}
+        expect = {"mempool": n_mp, "votes": n_vo + trickles,
+                  "light": n_li, "replay": 16}
+        rejected = [(lane, i) for lane, d in snapshot.items()
+                    for i, ok in d.items() if not ok]
+        total = sum(len(d) for d in snapshot.values())
+        print(f"  verdicts                   : {total} arrived, "
+              f"rejected={rejected} (forged: mempool idx {forged_i})")
+        for lane, n in expect.items():
+            if len(snapshot[lane]) != n:
+                print(f"  FAIL: {lane} delivered {len(snapshot[lane])}"
+                      f"/{n} verdicts", file=sys.stderr)
+                rc = 1
+        if rejected != [("mempool", forged_i)]:
+            print("  FAIL: the forged signature must be the ONLY "
+                  "rejection", file=sys.stderr)
+            rc = 1
+
+        # -- pool hygiene ------------------------------------------------
+        for lane in (mp, vo, li, rp):
+            lane.close(timeout=30)
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+        print(f"  pool                       : {pool}")
+        if pool["in_flight"] != 0:
+            print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+                  file=sys.stderr)
+            rc = 1
+    finally:
+        eng.close(timeout=5)
+        v.close()
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+    return rc
+
+
 def run_soak(args) -> int:
     """--soak: the round-16 soak-harness gate on a mocked relay (verdicts
     come back all-accept with NO kernel — this gate checks the HARNESS,
@@ -1500,6 +1705,14 @@ def main() -> int:
         "signature mid-flood is the ONLY rejection, zero pool-slot leak",
     )
     ap.add_argument(
+        "--fabric",
+        action="store_true",
+        help="round-17 gate: the unified ingress fabric on a mocked relay "
+        "— four lane patterns on ONE scheduler + completer thread, the "
+        "adaptive window deepens under flood AND shrinks back when idle, "
+        "a forged signature is the only rejection, zero pool-slot leak",
+    )
+    ap.add_argument(
         "--soak",
         action="store_true",
         help="round-16 gate: soak-harness hygiene on a mocked relay — "
@@ -1523,6 +1736,8 @@ def main() -> int:
         return run_replay(args)
     if args.votes:
         return run_votes(args)
+    if args.fabric:
+        return run_fabric(args)
     if args.soak:
         return run_soak(args)
 

@@ -55,6 +55,7 @@ def iter_functions(tree: ast.AST):
 
 
 from .determinism import SimnetDeterminismRule  # noqa: E402
+from .ingress import IngressDisciplineRule  # noqa: E402
 from .donation import DonationAliasingRule  # noqa: E402
 from .locks import LockDisciplineRule  # noqa: E402
 from .purity import HotPathPurityRule  # noqa: E402
@@ -62,6 +63,7 @@ from .relay import RelayOwnershipRule  # noqa: E402
 
 ALL_RULES = [
     DonationAliasingRule(),
+    IngressDisciplineRule(),
     RelayOwnershipRule(),
     SimnetDeterminismRule(),
     HotPathPurityRule(),

@@ -1,0 +1,85 @@
+"""ingress-discipline — hand-rolled windowed accumulators outside the fabric.
+
+ISSUE 17 collapsed four parallel copies of the same machinery — mempool
+ingress, vote ingress, light verify and blocksync replay each owned a
+window dict, a flush-timer thread and its own EntryBlock assembly — into
+ONE engine (`ops/ingress.py`): one flush scheduler, one completion
+thread, one poisoned-window / fallback / QoS policy. A fifth parallel
+stack must never grow back: every new batched-verify consumer registers
+a LaneSpec with the shared engine instead of spawning its own flusher.
+
+The tell for a hand-rolled accumulator is the PAIR of signals in one
+module, neither of which is suspicious alone:
+
+  1. a flush/window timer thread — `threading.Thread(target=<something
+     named *flush*/*window*/*timer*/*drain*>)`, and
+  2. EntryBlock assembly for submission — `EntryBlock.from_entries(...)`
+     (or `.concat`).
+
+Plenty of modules legitimately build EntryBlocks (benches, the replay
+prep path) and plenty spawn threads (the pipeline, the soak harness);
+only the combination re-creates a private batching engine. The engine
+itself is the single whitelisted module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule
+from . import dotted, func_name, receiver_name
+
+# the one module architecturally sanctioned to own window/flush machinery
+WHITELIST = frozenset({
+    "tendermint_tpu/ops/ingress.py",
+})
+
+# substrings that mark a thread target as a window-flush loop
+_FLUSH_HINTS = ("flush", "window", "timer", "drain")
+
+# EntryBlock assembly entry points (terminal callee names)
+_ASSEMBLY = frozenset({"from_entries", "concat"})
+
+
+def _target_name(call: ast.Call) -> str:
+    """Dotted name of the `target=` keyword of a Thread(...) call."""
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return dotted(kw.value)
+    if call.args:  # Thread(group, target, ...) positional form
+        if len(call.args) >= 2:
+            return dotted(call.args[1])
+    return ""
+
+
+class IngressDisciplineRule(Rule):
+    name = "ingress-discipline"
+    description = ("windowed accumulator (flush thread + EntryBlock "
+                   "assembly) outside ops/ingress.py")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("tendermint_tpu/")
+                and relpath not in WHITELIST)
+
+    def visit(self, ctx: FileContext) -> Iterator[Finding]:
+        flush_threads: List[ast.Call] = []
+        assembles = False
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = func_name(node)
+            if name == "Thread":
+                tgt = _target_name(node).lower()
+                if tgt and any(h in tgt for h in _FLUSH_HINTS):
+                    flush_threads.append(node)
+            elif name in _ASSEMBLY and receiver_name(node) == "EntryBlock":
+                assembles = True
+        if not assembles:
+            return
+        for call in flush_threads:
+            yield ctx.finding(
+                self.name, call,
+                "flush-timer thread + EntryBlock assembly in one module "
+                "re-creates a private batching engine; register a LaneSpec "
+                "with ops.ingress.shared_engine() instead")
