@@ -1,0 +1,38 @@
+"""The verification fleet (ISSUE 18, ROADMAP item 2).
+
+Many tendermint nodes, one device fleet: a network-facing EntryBlock
+verify service. `wire` is the length-prefixed columnar frame format
+(near-free serialization — EntryBlocks are already contiguous buffers),
+`server` accepts frames and feeds the shared AsyncBatchVerifier at each
+client's QoS tier (so same-epoch blocks from DIFFERENT nodes cross-node
+coalesce into mesh lanes), and `client` is the duck-typed remote
+verifier that plugs in behind the ingress fabric's LaneSpec seam with
+RTT-EWMA health tracking and graceful local-fallback degradation.
+
+Import discipline: nothing here imports jax at module level — the wire
+format and client run on pure numpy + stdlib sockets, and the server
+resolves its verifier lazily exactly like the ingress lanes do.
+"""
+
+import os as _os
+
+from .wire import (  # noqa: F401
+    VERSION,
+    FrameDecoder,
+    OversizeFrame,
+    TruncatedFrame,
+    VersionSkew,
+    WireError,
+)
+
+# Flow-domain partitioning (observability/trace.set_flow_domain): a
+# process participating in a fleet sets TM_TPU_FLEET_FLOW_DOMAIN to a
+# distinct small integer so merged flight-recorder traces from client
+# nodes + fleet host never alias locally-allocated flow ids.
+_domain = _os.environ.get("TM_TPU_FLEET_FLOW_DOMAIN", "")
+if _domain:
+    try:
+        from ..observability.trace import set_flow_domain as _set_fd
+        _set_fd(int(_domain))
+    except ValueError:
+        pass

@@ -482,6 +482,11 @@ class IngressMetrics:
             "Windows poisoned by a DispatchError and handed back for "
             "per-item retry, by lane label.",
         )
+        self.remote_fallbacks = registry.counter(
+            "ingress", "remote_fallbacks",
+            "Windows host-verified because a remote (fleet) verifier "
+            "became unavailable after submit, by lane label (ISSUE 18).",
+        )
         self.preemptions = registry.counter(
             "ingress", "preemptions",
             "Queued lane batches bypassed by a higher-priority batch in "
@@ -505,6 +510,73 @@ class IngressMetrics:
             "ingress", "deadline_flushes",
             "Flushes fired early by the SLO deadline bound (budget minus "
             "service-time headroom), by lane label.",
+        )
+
+
+class FleetMetrics:
+    """The verification fleet (ISSUE 18): client- and server-side series
+    for the network-facing EntryBlock verify service. Client series are
+    labeled by `target` (the fleet address as the client knows it);
+    server series by `lane` (the client-declared lane name riding the
+    wire) or `reason` (frame-reject taxonomy). One labeled set serves
+    any number of FleetClients/FleetServers in the process — benches and
+    simnet runs host both ends."""
+
+    def __init__(self, registry: Registry):
+        # -- client side ------------------------------------------------
+        self.client_connected = registry.gauge(
+            "fleet", "client_connected",
+            "1 while the client holds a live fleet connection, 0 while "
+            "degraded to local fallback, by target label.",
+        )
+        self.client_rtt_ewma_ms = registry.gauge(
+            "fleet", "client_rtt_ewma_ms",
+            "EWMA of submit→verdict round-trip milliseconds, by target.",
+        )
+        self.client_requests = registry.counter(
+            "fleet", "client_requests",
+            "SUBMIT frames sent to the fleet, by target label.",
+        )
+        self.client_timeouts = registry.counter(
+            "fleet", "client_timeouts",
+            "Requests that hit the fleet deadline and were failed over, "
+            "by target label.",
+        )
+        self.client_fallbacks = registry.counter(
+            "fleet", "client_fallbacks",
+            "Requests failed with FleetUnavailable (timeout, socket "
+            "error, or fleet marked down), by target label.",
+        )
+        self.client_rejoins = registry.counter(
+            "fleet", "client_rejoins",
+            "Successful reconnects after a degraded interval, by target.",
+        )
+        # -- server side ------------------------------------------------
+        self.server_connections = registry.gauge(
+            "fleet", "server_connections",
+            "Client connections currently held by the fleet server.",
+        )
+        self.server_frames_accepted = registry.counter(
+            "fleet", "server_frames_accepted",
+            "Well-formed SUBMIT frames accepted, by lane label.",
+        )
+        self.server_frames_rejected = registry.counter(
+            "fleet", "server_frames_rejected",
+            "Frames rejected, by reason label "
+            "(malformed|version|oversize|closed).",
+        )
+        self.server_sigs = registry.counter(
+            "fleet", "server_sigs",
+            "Signatures received for verification, by lane label.",
+        )
+        self.server_verdicts_streamed = registry.counter(
+            "fleet", "server_verdicts_streamed",
+            "Verdict frames streamed back in completion order.",
+        )
+        self.server_dispatch_errors = registry.counter(
+            "fleet", "server_dispatch_errors",
+            "Requests answered with an ERROR frame because the verifier "
+            "raised (DispatchError or submit failure).",
         )
 
 
@@ -717,6 +789,59 @@ def ingress_metrics() -> "IngressMetrics":
         if _global_ingress is None:
             _global_ingress = IngressMetrics(global_registry())
         return _global_ingress
+
+
+_global_fleet: Optional["FleetMetrics"] = None
+
+
+def fleet_metrics() -> "FleetMetrics":
+    """Process-wide FleetMetrics — same sharing rationale as
+    ingress_metrics(): fleet clients hang off process-shared lanes and a
+    fleet server fronts the process-shared verifier, so both ends push
+    to the process registry."""
+    global _global_fleet
+    with _global_mtx:
+        if _global_fleet is None:
+            _global_fleet = FleetMetrics(global_registry())
+        return _global_fleet
+
+
+def fleet_stats() -> dict:
+    """Fleet snapshot for /status — cheap counter reads, no fleet (or
+    jax) import; safe to call whether or not a fleet exists (all-zero
+    series then)."""
+    m = fleet_metrics()
+
+    def _by(metric, label):
+        return {
+            (dict(k).get(label, "") or "unlabeled"): int(v)
+            for k, v in metric.by_label().items()
+        }
+
+    def _gauge_by(metric, label):
+        return {
+            (dict(k).get(label, "") or "unlabeled"): float(v)
+            for k, v in metric.by_label().items()
+        }
+
+    return {
+        "client": {
+            "connected": _by(m.client_connected, "target"),
+            "rtt_ewma_ms": _gauge_by(m.client_rtt_ewma_ms, "target"),
+            "requests": _by(m.client_requests, "target"),
+            "timeouts": _by(m.client_timeouts, "target"),
+            "fallbacks": _by(m.client_fallbacks, "target"),
+            "rejoins": _by(m.client_rejoins, "target"),
+        },
+        "server": {
+            "connections": int(m.server_connections.value()),
+            "frames_accepted": _by(m.server_frames_accepted, "lane"),
+            "frames_rejected": _by(m.server_frames_rejected, "reason"),
+            "sigs": _by(m.server_sigs, "lane"),
+            "verdicts_streamed": int(m.server_verdicts_streamed.total()),
+            "dispatch_errors": int(m.server_dispatch_errors.total()),
+        },
+    }
 
 
 _global_blocksync: Optional["BlockSyncMetrics"] = None

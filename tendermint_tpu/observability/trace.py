@@ -72,6 +72,24 @@ def next_flow() -> int:
     """Allocate a process-unique flow (correlation) id."""
     return next(_flow_counter)
 
+
+def set_flow_domain(domain: int) -> None:
+    """Re-base this process's flow allocator into a disjoint id range.
+
+    The verification fleet (ISSUE 18) merges traces from MANY processes
+    — client nodes and the fleet host — into one flight-recorder view;
+    each process calls this once at startup (TM_TPU_FLEET_FLOW_DOMAIN)
+    with a distinct small integer so allocated flow ids can never alias
+    across the merge. Domain 0 is the default base. Flows CONTINUED
+    from a wire frame keep the originator's id — that is the point: the
+    chain client-submit → fleet-recv → verdict shares one id, and this
+    partition guarantees the fleet's own locally-started flows stay out
+    of every client's range.
+    """
+    global _flow_counter
+    base = _FLOW_BASE + (int(domain) & 0xFFFF) * (1 << 24)
+    _flow_counter = itertools.count(base + 1)
+
 # Per-node tracers get small deterministic pids well away from real OS
 # pids; assignment order is the tracer construction order.
 _node_pid_mtx = threading.Lock()

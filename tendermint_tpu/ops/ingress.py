@@ -53,6 +53,10 @@ Error policy, per window (the four schemes, now one):
 * post-submit DispatchError: poisons ONLY its own window — the items
   are handed back with the error, the lane and every later window keep
   flowing.
+* post-submit remote death (ISSUE 18): an error marked
+  `fallback_to_host` (a fleet verifier's FleetUnavailable) host-verifies
+  the window through `host_fn` instead of poisoning — zero lost items
+  while the remote backend rejoins.
 
 Knobs (lane-keyed, replacing the per-workload sprawl — old names are
 honored with a DeprecationWarning): TM_TPU_INGRESS_<LANE>_BATCH,
@@ -324,7 +328,14 @@ class LaneSpec:
     use_completer: bool = False    # deliver + host_fn on completer thread
     submit_error_to_host: bool = False  # pre-submit failure → host verify
     closed_msg: str = "ingress lane is closed"
-    verifier: Any = None           # None → ops.pipeline.shared_verifier()
+    # None → ops.pipeline.shared_verifier(). Anything submit()-shaped
+    # plugs in here — including fleet.client.FleetClient, which routes
+    # the lane's flushed windows over the wire to a remote device fleet
+    # (ISSUE 18). A remote verifier signals post-submit death by failing
+    # futures with an error whose `fallback_to_host` attr is true: such
+    # windows host-verify via host_fn (counted remote_fallbacks) instead
+    # of poisoning. Pre-submit raises ride submit_error_to_host as ever.
+    verifier: Any = None
     # callbacks (None where a lane has no use for the seam)
     entries_fn: Optional[Callable[[Any], Tuple[bytes, bytes, bytes]]] = None
     route_fn: Optional[Callable[[Any], bool]] = None   # True → device lane
@@ -393,6 +404,7 @@ class Lane:
         self.sync_fallbacks = 0
         self.preempted = 0
         self.dispatch_errors = 0
+        self.remote_fallbacks = 0      # remote verifier died post-submit
         self.blocks = 0                # whole-block passthrough submits
         self._wait_ms_sum = 0.0
         self._flush_t0: Dict[int, float] = {}   # inflight window → t_submit
@@ -633,6 +645,27 @@ class Lane:
             self.ctrl.note_service((time.perf_counter() - t0) * 1e3)
         err = fut.exception()
         if err is not None:
+            # graceful degradation (ISSUE 18): a remote verifier that
+            # died AFTER submit marks its error fallback_to_host (duck-
+            # typed — fleet.client.FleetUnavailable; ingress never
+            # imports fleet). The window host-verifies instead of
+            # poisoning: zero lost items, and the lane keeps flowing
+            # while the client rejoins.
+            if (getattr(err, "fallback_to_host", False)
+                    and self.spec.host_fn is not None):
+                with self.engine._mtx:
+                    self.remote_fallbacks += 1
+                self.engine._m_remote_fallback(self.spec.name)
+                _observe(self.spec.observer, "remote_fallback")
+                try:
+                    # fallback=False: remote_fallbacks is the counter
+                    # here, not sync_fallbacks (disjoint taxonomies)
+                    self._host(items, fallback=False)
+                    return
+                except Exception as e:  # noqa: BLE001 — fallback failed
+                    self._count_dispatch_error()
+                    self._deliver(items, None, e)
+                    return
             # poisoned window: exactly these items fail; the lane and
             # every later window keep flowing (items left the dedup set
             # at stage time, so a retry re-enters cleanly)
@@ -697,6 +730,7 @@ class Lane:
             ),
             "preemptions": self.preempted,
             "dispatch_errors": self.dispatch_errors,
+            "remote_fallbacks": self.remote_fallbacks,
             "blocks": self.blocks,
             "max_batch": self.ctrl.batch_target(),
             "window_ms": self.ctrl.window_ms,
@@ -892,6 +926,14 @@ class IngressEngine:
         if m is not None:
             try:
                 m.sync_fallbacks.inc(1, lane=lane)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _m_remote_fallback(self, lane: str) -> None:
+        m = self._m()
+        if m is not None:
+            try:
+                m.remote_fallbacks.inc(1, lane=lane)
             except Exception:  # noqa: BLE001
                 pass
 

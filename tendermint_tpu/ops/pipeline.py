@@ -28,7 +28,7 @@ import threading
 import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutTimeout
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -315,6 +315,9 @@ class AsyncBatchVerifier:
         self._lane_submitted = {
             PRIORITY_CONSENSUS: 0, PRIORITY_REPLAY: 0, PRIORITY_INGRESS: 0,
         }
+        # declared-origin attribution (ISSUE 18): fleet-server submits
+        # carry each remote client's lane name; same mutex as lane counts
+        self._origin_submitted: Dict[str, int] = {}
         # (spans, prep_future, t_enqueue, priority) | None sentinel —
         # priority-ordered so a pending consensus batch overtakes queued
         # ingress superbatches (never an in-flight launch)
@@ -368,7 +371,11 @@ class AsyncBatchVerifier:
                 pass
 
     def submit(self, entries, flow: Optional[int] = None,
-               priority: int = PRIORITY_CONSENSUS) -> Future:
+               priority: int = PRIORITY_CONSENSUS,
+               origin: Optional[str] = None) -> Future:
+        """`origin` names WHO submitted (ISSUE 18: the fleet server
+        passes each client's wire-declared lane) — pure attribution for
+        origin_counts(); scheduling ignores it."""
         if self._stopped.is_set():
             raise RuntimeError("verifier is closed")
         block = as_block(entries)
@@ -378,7 +385,8 @@ class AsyncBatchVerifier:
             # submissions at the lane capacity so every chunk fits one
             max_b = min(max_b, _mesh.lane_cap())
         if len(block) > max_b:
-            return self._submit_chunked(block, max_b, flow, priority)
+            return self._submit_chunked(block, max_b, flow, priority,
+                                        origin=origin)
         job = _Job(block, priority=int(priority),
                    seq=next(self._job_seq))
         if _trace.TRACER.enabled:
@@ -403,6 +411,10 @@ class AsyncBatchVerifier:
             ] = self._lane_submitted.get(
                 min(job.priority, PRIORITY_INGRESS), 0
             ) + 1
+            if origin is not None:
+                self._origin_submitted[origin] = (
+                    self._origin_submitted.get(origin, 0) + 1
+                )
         self._q.put(job, priority=job.priority)
         _backend._ops_m().pipeline_queue_depth.set(self._q.qsize())
         return job.future
@@ -417,9 +429,16 @@ class AsyncBatchVerifier:
                 "ingress": self._lane_submitted[PRIORITY_INGRESS],
             }
 
+    def origin_counts(self) -> dict:
+        """Jobs accepted per declared origin (ISSUE 18: fleet clients'
+        lane names). Empty until someone submits with origin=."""
+        with self._lane_mtx:
+            return dict(self._origin_submitted)
+
     def _submit_chunked(self, block: EntryBlock, max_b: int,
                         flow: Optional[int] = None,
-                        priority: int = PRIORITY_CONSENSUS) -> Future:
+                        priority: int = PRIORITY_CONSENSUS,
+                        origin: Optional[str] = None) -> Future:
         """An oversized job rides as zero-copy slices through the normal
         queue (the dispatcher stays the only device-touching thread; the
         old path ran a chunked synchronous fallback on the worker) and
@@ -429,7 +448,7 @@ class AsyncBatchVerifier:
         while i < len(block):
             futs.append(
                 self.submit(block[i : i + max_b], flow=flow,
-                            priority=priority)
+                            priority=priority, origin=origin)
             )
             i += max_b
         agg: Future = Future()

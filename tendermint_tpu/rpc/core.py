@@ -180,6 +180,11 @@ class Environment:
             # fallbacks, and the QoS lane intake split proving votes ride
             # the consensus lane. Same cheap-counters-only rule.
             "vote_ingress": self._vote_ingress_stats(),
+            # ISSUE 18: the verification fleet — client connection state,
+            # RTT EWMA, fallback/rejoin counters, and server accepted-
+            # frame/per-lane counts. Same cheap-counters-only rule; reads
+            # only libs.metrics (never imports fleet, never dials).
+            "fleet": self._fleet_stats(),
         }
 
     def _mempool_ingress_stats(self) -> dict:
@@ -205,6 +210,25 @@ class Environment:
 
             if _pl._shared is not None:
                 stats["pipeline_lanes"] = _pl._shared.lane_counts()
+            return stats
+        except Exception as e:  # noqa: BLE001 — /status must not 500
+            return {"enabled": False, "error": str(e)}
+
+    @staticmethod
+    def _fleet_stats() -> dict:
+        try:
+            from ..libs.metrics import fleet_stats
+
+            stats = fleet_stats()
+            # origin split only when a pipeline already exists — same
+            # no-spin-up rule as _vote_ingress_stats
+            from ..ops import pipeline as _pl
+
+            if _pl._shared is not None and hasattr(_pl._shared,
+                                                   "origin_counts"):
+                stats["server"]["origin_counts"] = (
+                    _pl._shared.origin_counts()
+                )
             return stats
         except Exception as e:  # noqa: BLE001 — /status must not 500
             return {"enabled": False, "error": str(e)}

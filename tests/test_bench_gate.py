@@ -53,7 +53,8 @@ def test_pins_file_is_wellformed():
 
 @pytest.mark.parametrize(
     "kind",
-    ["bench", "multichip", "light", "mempool", "blocksync", "votes", "soak"],
+    ["bench", "multichip", "light", "mempool", "blocksync", "votes", "soak",
+     "fleet"],
 )
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.

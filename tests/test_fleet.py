@@ -1,0 +1,183 @@
+"""Verification-fleet parity: local vs through-fleet (ISSUE 18).
+
+The fleet is a TRANSPORT, not a verifier: shipping an EntryBlock to a
+FleetServer over the wire codec and verifying it on the server's shared
+pipeline must produce byte-identical verdicts — and, through each prep
+seam's conclude(), byte-identical blame errors — to submitting the same
+block to the same pipeline locally. Covered per lane:
+
+  consensus  prepare_commit_light        (PRIORITY_CONSENSUS)
+  light      prepare_commit_light_trusting (PRIORITY_CONSENSUS)
+  replay     prepare_commit_range        (PRIORITY_REPLAY)
+
+Runs real ed25519 (purepy fallback in containers without the
+cryptography wheel) and the real CPU kernels — this file is executed by
+tests/test_fleet_isolated.py in a TM_TPU_PUREPY_CRYPTO=1 subprocess
+when the wheel is missing.
+"""
+
+import pytest
+
+np = pytest.importorskip("numpy")
+jax = pytest.importorskip("jax")
+
+try:
+    from tendermint_tpu.crypto import ed25519
+except ModuleNotFoundError:
+    # No cryptography wheel in this container; test_fleet_isolated.py
+    # re-runs this module in a TM_TPU_PUREPY_CRYPTO=1 subprocess.
+    pytest.skip(
+        "ed25519 backend unavailable (runs via test_fleet_isolated.py)",
+        allow_module_level=True,
+    )
+from tendermint_tpu.fleet.client import FleetClient  # noqa: E402
+from tendermint_tpu.fleet.server import FleetServer  # noqa: E402
+from tendermint_tpu.ops import pipeline as pl  # noqa: E402
+from tendermint_tpu.types import (  # noqa: E402
+    BlockID,
+    Fraction,
+    PartSetHeader,
+    PRECOMMIT_TYPE,
+    Timestamp,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.validation import (  # noqa: E402
+    prepare_commit_light,
+    prepare_commit_light_trusting,
+    prepare_commit_range,
+)
+
+CHAIN_ID = "fleet-parity-chain"
+HEIGHT = 10
+
+
+def _make_validators(n):
+    pairs = []
+    for i in range(n):
+        sk = ed25519.gen_priv_key(bytes([i + 1]) * 32)
+        pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+    vset = ValidatorSet.new([v for _, v in pairs])
+    by_addr = {v.address: sk for sk, v in pairs}
+    return [by_addr[v.address] for v in vset.validators], vset
+
+
+def _make_block_id(tag=b"\x01"):
+    return BlockID(hash=tag * 32,
+                   part_set_header=PartSetHeader(total=1, hash=tag * 32))
+
+
+def _sign_vote(sk, vset, height, round_, block_id):
+    addr = sk.pub_key().address()
+    idx, _ = vset.get_by_address(addr)
+    vote = Vote(
+        type=PRECOMMIT_TYPE, height=height, round=round_,
+        block_id=block_id, timestamp=Timestamp(seconds=1_600_000_000,
+                                               nanos=0),
+        validator_address=addr, validator_index=idx,
+    )
+    sig = sk.sign(vote.sign_bytes(CHAIN_ID))
+    return Vote(**{**vote.__dict__, "signature": sig})
+
+
+def _build_commit(n=6, forge_at=None):
+    """A real n-validator precommit; forge_at tampers that CommitSig's
+    signature (the blame target)."""
+    sks, vset = _make_validators(n)
+    block_id = _make_block_id()
+    vote_set = VoteSet(CHAIN_ID, HEIGHT, 1, PRECOMMIT_TYPE, vset)
+    for sk in sks:
+        vote_set.add_vote(_sign_vote(sk, vset, HEIGHT, 1, block_id))
+    commit = vote_set.make_commit()
+    if forge_at is not None:
+        from dataclasses import replace as dc_replace
+
+        bad = bytearray(commit.signatures[forge_at].signature)
+        bad[0] ^= 0x5A
+        commit.signatures[forge_at] = dc_replace(
+            commit.signatures[forge_at], signature=bytes(bad))
+    return sks, vset, block_id, commit
+
+
+def _conclusion(conclude, verdicts):
+    """(type_name, str) of what conclude raises, or None when clean."""
+    try:
+        conclude(verdicts)
+        return None
+    except Exception as e:  # noqa: BLE001 — the blame IS the result
+        return (type(e).__name__, str(e))
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One shared pipeline, served both locally and through a real
+    socket fleet — the parity comparison is transport vs no-transport
+    over the SAME verifier."""
+    v = pl.AsyncBatchVerifier(depth=1)
+    srv = FleetServer(verifier=v).start()
+    cli = FleetClient(srv.addr, name="parity", lane="parity",
+                      timeout_ms=120_000)
+    yield v, cli
+    cli.close()
+    srv.stop()
+    v.close()
+
+
+def _both_verdicts(rig_v, rig_cli, eblk, priority):
+    local = np.asarray(rig_v.submit(eblk).result(timeout=300), dtype=bool)
+    fleet = np.asarray(
+        rig_cli.submit(eblk, priority=priority).result(timeout=300),
+        dtype=bool)
+    return local, fleet
+
+
+class TestForgedCommitBlameParity:
+    @pytest.mark.parametrize("forge_at", [0, 3])
+    def test_consensus_lane_light_prep(self, rig, forge_at):
+        v, cli = rig
+        _, vset, block_id, commit = _build_commit(forge_at=forge_at)
+        eblk, conclude = prepare_commit_light(
+            CHAIN_ID, vset, block_id, HEIGHT, commit)
+        local, fleet = _both_verdicts(v, cli, eblk,
+                                      pl.PRIORITY_CONSENSUS)
+        assert np.array_equal(local, fleet)
+        want, got = _conclusion(conclude, local), _conclusion(conclude, fleet)
+        assert want is not None, "forged commit must blame"
+        assert want[0] == "ValueError" and "wrong signature" in want[1]
+        assert got == want  # byte-identical blame through the fleet
+
+    def test_light_lane_trusting_prep(self, rig):
+        v, cli = rig
+        _, vset, _, commit = _build_commit(forge_at=2)
+        eblk, conclude = prepare_commit_light_trusting(
+            CHAIN_ID, vset, commit, Fraction(1, 3))
+        local, fleet = _both_verdicts(v, cli, eblk,
+                                      pl.PRIORITY_CONSENSUS)
+        assert np.array_equal(local, fleet)
+        want, got = _conclusion(conclude, local), _conclusion(conclude, fleet)
+        assert want is not None and got == want
+
+    def test_replay_lane_range_prep(self, rig):
+        v, cli = rig
+        _, vset, block_id, commit = _build_commit(forge_at=4)
+        prepared, synced = prepare_commit_range(
+            CHAIN_ID, vset, [(HEIGHT, block_id, commit)])
+        assert synced == [] and len(prepared) == 1
+        _h, eblk, conclude = prepared[0]
+        local, fleet = _both_verdicts(v, cli, eblk, pl.PRIORITY_REPLAY)
+        assert np.array_equal(local, fleet)
+        want, got = _conclusion(conclude, local), _conclusion(conclude, fleet)
+        assert want is not None and got == want
+
+    def test_clean_commit_concludes_clean_both_ways(self, rig):
+        v, cli = rig
+        _, vset, block_id, commit = _build_commit()
+        eblk, conclude = prepare_commit_light(
+            CHAIN_ID, vset, block_id, HEIGHT, commit)
+        local, fleet = _both_verdicts(v, cli, eblk,
+                                      pl.PRIORITY_CONSENSUS)
+        assert np.array_equal(local, fleet) and bool(local.all())
+        assert _conclusion(conclude, local) is None
+        assert _conclusion(conclude, fleet) is None

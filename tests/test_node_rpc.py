@@ -78,6 +78,19 @@ class TestNodeRPC:
         assert st["node_info"]["network"] == CHAIN
         assert int(st["sync_info"]["latest_block_height"]) >= 2
 
+        # ISSUE 18: the verification-fleet section is always present —
+        # all-zero counter reads when no fleet exists, never a dial
+        fl = st["fleet"]
+        assert set(fl) >= {"client", "server"}
+        assert set(fl["client"]) >= {
+            "connected", "rtt_ewma_ms", "requests",
+            "timeouts", "fallbacks", "rejoins",
+        }
+        assert set(fl["server"]) >= {
+            "connections", "frames_accepted", "frames_rejected",
+            "sigs", "verdicts_streamed", "dispatch_errors",
+        }
+
         res = rpc.broadcast_tx_commit(b"rpckey=rpcval")
         assert res["deliver_tx"]["code"] == 0
         height = int(res["height"])

@@ -314,6 +314,79 @@ class TestRelayOwnership:
         assert not lint(src, LIGHT_PATH, "relay-ownership")
 
 
+class TestFleetTransport:
+    """ISSUE 18: the fleet wire codec has exactly three sanctioned homes
+    (fleet/wire.py, fleet/client.py, fleet/server.py) — frame encode /
+    parse call sites anywhere else fork a versioned protocol surface."""
+
+    def test_positive_encode_outside_fleet(self):
+        src = """
+            from tendermint_tpu.fleet import wire
+
+            def sneaky_send(sock, rid, block):
+                for buf in wire.encode_submit(rid, block, lane="rogue"):
+                    sock.sendall(buf)
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["fleet-transport"]
+
+    def test_positive_parse_and_decoder_outside_fleet(self):
+        src = """
+            from tendermint_tpu.fleet.wire import FrameDecoder, parse_frame
+
+            def sneaky_recv(sock):
+                dec = FrameDecoder()
+                for payload in dec.feed(sock.recv(65536)):
+                    yield parse_frame(payload)
+        """
+        assert sorted(rules_of(lint(src, REACTOR_PATH))) == [
+            "fleet-transport", "fleet-transport"
+        ]
+
+    def test_negative_raw_sockets_stay_legal(self):
+        """Generic socket traffic is NOT the invariant — rpc/, privval/,
+        and p2p/ own their sockets; only the fleet codec is fenced."""
+        src = """
+            def send_all(conn, data):
+                conn.sendall(data)
+                return conn.recv(4096)
+        """
+        assert not lint(src, "tendermint_tpu/p2p/fake_transport.py",
+                        "fleet-transport")
+
+    def test_negative_whitelisted_modules(self):
+        src = """
+            from . import wire
+
+            def reply(outbox, rid, verdicts):
+                outbox.put(wire.encode_verdicts(rid, verdicts))
+        """
+        for path in ("tendermint_tpu/fleet/wire.py",
+                     "tendermint_tpu/fleet/client.py",
+                     "tendermint_tpu/fleet/server.py"):
+            assert not lint(src, path, "fleet-transport")
+
+    def test_negative_fleet_client_usage_is_clean(self):
+        """The sanctioned consumer shape — a lane handing windows to a
+        FleetClient via the LaneSpec verifier seam — is clean."""
+        src = """
+            from tendermint_tpu.fleet.client import FleetClient
+
+            def make_lane_verifier(addr):
+                return FleetClient(addr, name="node-a")
+        """
+        assert not lint(src, REACTOR_PATH, "fleet-transport")
+
+    def test_suppressed_next_line_comment(self):
+        src = """
+            from tendermint_tpu.fleet import wire
+
+            def forge(rid):
+                # tmlint: disable=fleet-transport — wire-format test rig
+                return wire.encode_error(rid, 3, "boom")
+        """
+        assert not lint(src, REACTOR_PATH, "fleet-transport")
+
+
 class TestSimnetDeterminism:
     def test_positive_wall_clock(self):
         src = """

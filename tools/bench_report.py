@@ -91,6 +91,9 @@ METRIC_KEYS = (
     "lanes_shallow_sigs_per_window", "lanes_deep_flood_sigs_per_s",
     "lanes_deep_idle_p99_ms", "adaptive_window_grows",
     "adaptive_window_shrinks",
+    # verification-fleet scale-out artifacts (FLEET_r*, ISSUE 18); the
+    # headline "value" is the aggregate sigs/s at the largest host count
+    "clients",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -117,7 +120,8 @@ COMPARE_KEYS = (
 )
 
 _NAME_RE = re.compile(
-    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES)_r(\d+)",
+    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES|FLEET)"
+    r"_r(\d+)",
     re.I)
 
 
@@ -238,6 +242,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "VOTES_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "SOAK_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "LANES_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "FLEET_r*.json")))
     return paths
 
 
@@ -255,7 +260,7 @@ def validate(art: dict) -> List[str]:
         probs.append("; ".join(art["notes"]))
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
-                           "blocksync", "votes", "soak", "lanes"):
+                           "blocksync", "votes", "soak", "lanes", "fleet"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -1528,6 +1528,249 @@ def run_fabric(args) -> int:
     return rc
 
 
+def run_fleet(args) -> int:
+    """--fleet: the round-18 verification-fleet gate on a mocked relay
+    (slow readback over REAL kernels and REAL loopback sockets —
+    verdicts are live, frames cross a real TCP stream). Asserts what
+    the network-facing verify service must hold:
+
+      coalesce  two client NODES submitting same-epoch blocks through
+                ONE fleet server fuse into fewer device launches than
+                the same blocks verified solo (sum of the two per-node
+                launch counts) — the whole point of sharing the fleet
+      blame     the one forged signature (node B, block 3, row 5) is
+                the ONLY False verdict across both nodes, demuxed back
+                to node B's future at the right row; verdict arrays are
+                byte-identical to the solo runs
+      failover  killing the fleet server mid-window loses ZERO items —
+                every unresolved request fails over to the host path
+                with identical verdicts — and a server restarted on the
+                same port is rejoined automatically, after which the
+                next submit rides the fleet again
+      no leak   zero buffer-pool slots remain in flight once drained
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.fleet.client import FleetClient, FleetUnavailable
+    from tendermint_tpu.fleet.server import FleetServer
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool, slow_prepare
+    from tendermint_tpu.ops.entry_block import EntryBlock
+
+    resolve_delay = 0.15
+    n_keys, spb, bpn = 8, 16, 6  # sigs/block, blocks/node
+    nodes = ("node-a", "node-b")
+    forge_node, forge_block, forge_row = "node-b", 3, 5
+    keys = [ed.gen_priv_key(bytes([i + 1]) * 32) for i in range(n_keys)]
+    epoch = b"fleet-gate-epoch"  # unregistered: degrades to uncached prep
+
+    print(f"prep_bench --fleet: nodes=2 blocks/node={bpn} sigs/block={spb} "
+          f"resolve_delay={resolve_delay}s")
+    rc = 0
+
+    def build_block(node: str, b: int) -> EntryBlock:
+        pub = np.zeros((spb, 32), dtype=np.uint8)
+        sig = np.zeros((spb, 64), dtype=np.uint8)
+        offsets = np.zeros(spb + 1, dtype=np.int64)
+        msgs = []
+        for i in range(spb):
+            sk = keys[i % n_keys]
+            m = f"fleet/{node}/{b}/{i}".encode()
+            s = sk.sign(m)
+            if (node, b, i) == (forge_node, forge_block, forge_row):
+                bad = bytearray(s)
+                bad[0] ^= 0x5A
+                s = bytes(bad)
+            pub[i] = np.frombuffer(sk.pub_key().bytes(), dtype=np.uint8)
+            sig[i] = np.frombuffer(s, dtype=np.uint8)
+            msgs.append(m)
+            offsets[i + 1] = offsets[i] + len(m)
+        return EntryBlock(
+            pub, sig, b"".join(msgs), offsets,
+            val_idx=np.arange(spb, dtype=np.int32), epoch_key=epoch)
+
+    # pre-sign everything once (purepy signing is slow) and reuse the
+    # SAME blocks across the solo and shared phases — parity by identity
+    blocks = {node: [build_block(node, b) for b in range(bpn)]
+              for node in nodes}
+
+    def launches() -> int:
+        return sum(1 for name, *_ in tr.TRACER.events()
+                   if name == "pipeline.dispatch")
+
+    real_prepare = pl.AsyncBatchVerifier._prepare
+    pl.AsyncBatchVerifier._prepare = staticmethod(
+        slow_prepare(real_prepare, resolve_delay))
+    os.environ["TM_TPU_FORCE_DEVICE"] = "1"
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    try:
+        # -- solo baselines: each node verifies its own blocks ------------
+        # Arrivals are PACED (one block per `spacing`, like a live node's
+        # request stream) in both phases: a solo node's trickle has no
+        # coalescing partner, while the shared fleet sees both nodes'
+        # streams and fuses across them — that cross-node fusion is the
+        # whole economics of the fleet.
+        spacing = 0.10
+        solo_verdicts = {}
+        solo_launches = {}
+        for node in nodes:
+            v = pl.AsyncBatchVerifier(depth=2, pool_depth=OVERLAP_POOL_DEPTH)
+            try:
+                before = launches()
+                futs = []
+                for i, blk in enumerate(blocks[node]):
+                    futs.append(v.submit(blk, flow=1000 + i))
+                    time.sleep(spacing)
+                solo_verdicts[node] = [
+                    np.asarray(f.result(timeout=300), dtype=bool)
+                    for f in futs]
+                solo_launches[node] = launches() - before
+                drain_pool(v._pool)
+            finally:
+                v.close()
+        solo_total = sum(solo_launches.values())
+        print(f"  solo launches              : "
+              f"{solo_launches['node-a']} + {solo_launches['node-b']} "
+              f"= {solo_total} ({bpn} blocks each, one every "
+              f"{spacing * 1e3:.0f} ms)")
+
+        # -- shared fleet: both nodes through ONE server ------------------
+        v = pl.AsyncBatchVerifier(depth=2, pool_depth=OVERLAP_POOL_DEPTH)
+        srv = FleetServer(verifier=v).start()
+        port = srv.addr[1]
+        clients = {node: FleetClient(srv.addr, name=node, lane=node,
+                                     timeout_ms=60_000, rejoin_ms=100)
+                   for node in nodes}
+        try:
+            before = launches()
+            futs = []
+            for b in range(bpn):  # same per-node pacing as the solo phase
+                for ni, node in enumerate(nodes):
+                    futs.append((node, b, clients[node].submit(
+                        blocks[node][b], flow=2000 + 100 * ni + b)))
+                time.sleep(spacing)
+            shared_verdicts = {node: [None] * bpn for node in nodes}
+            for node, b, f in futs:
+                shared_verdicts[node][b] = np.asarray(
+                    f.result(timeout=300), dtype=bool)
+            shared_launches = launches() - before
+            print(f"  shared-fleet launches      : {shared_launches} "
+                  f"({2 * bpn} blocks, 2 nodes, one server)")
+            if shared_launches >= solo_total:
+                print(f"  FAIL: {shared_launches} launches through the "
+                      f"shared fleet vs {solo_total} solo — no cross-node "
+                      f"coalescing", file=sys.stderr)
+                rc = 1
+
+            # -- verdict parity + blame demux ----------------------------
+            mism = [
+                (node, b)
+                for node in nodes for b in range(bpn)
+                if not np.array_equal(shared_verdicts[node][b],
+                                      solo_verdicts[node][b])
+            ]
+            rejected = [
+                (node, b, i)
+                for node in nodes for b in range(bpn)
+                for i in np.flatnonzero(~shared_verdicts[node][b])
+            ]
+            print(f"  verdict parity vs solo     : "
+                  f"{'OK' if not mism else f'MISMATCH {mism}'}")
+            print(f"  rejections                 : {rejected} "
+                  f"(forged: {(forge_node, forge_block, forge_row)})")
+            if mism:
+                rc = 1
+            if rejected != [(forge_node, forge_block, forge_row)]:
+                print("  FAIL: the forged signature must be the ONLY "
+                      "rejection, demuxed to the right node/row",
+                      file=sys.stderr)
+                rc = 1
+
+            # -- failover: kill the server mid-window --------------------
+            futs = [(node, b, clients[node].submit(blocks[node][b],
+                                                   flow=3000 + b))
+                    for b in range(bpn) for node in nodes]
+            srv.stop()
+            lost, fellback = 0, 0
+            for node, b, f in futs:
+                try:
+                    got = np.asarray(f.result(timeout=120), dtype=bool)
+                except FleetUnavailable:
+                    # graceful degradation: host path, same verdicts
+                    fellback += 1
+                    blk = blocks[node][b]
+                    got = np.asarray(
+                        [ed.verify_zip215_fast(*blk.entry(i))
+                         for i in range(len(blk))], dtype=bool)
+                except Exception:  # noqa: BLE001 — any other loss counts
+                    lost += 1
+                    continue
+                if not np.array_equal(got, solo_verdicts[node][b]):
+                    lost += 1
+            print(f"  fleet kill mid-window      : {len(futs)} in flight, "
+                  f"{fellback} fell back to host, {lost} lost")
+            if lost != 0 or fellback == 0:
+                print("  FAIL: a fleet kill must lose ZERO items (host "
+                      "fallback) and at least one request must have been "
+                      "cut over", file=sys.stderr)
+                rc = 1
+
+            # -- rejoin: same port, fresh server -------------------------
+            srv2 = FleetServer(addr=("127.0.0.1", port), verifier=v).start()
+            try:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if all(c.connected for c in clients.values()):
+                        break
+                    time.sleep(0.02)
+                rejoined = all(c.connected for c in clients.values())
+                rejoins = {n: c.stats()["rejoins"]
+                           for n, c in clients.items()}
+                post = np.asarray(
+                    clients["node-a"].submit(
+                        blocks["node-a"][0], flow=4000).result(timeout=120),
+                    dtype=bool)
+                print(f"  rejoin after restart       : connected="
+                      f"{rejoined} rejoins={rejoins}")
+                if not rejoined or any(r < 1 for r in rejoins.values()):
+                    print("  FAIL: clients must redial a restarted fleet "
+                          "host automatically", file=sys.stderr)
+                    rc = 1
+                if not np.array_equal(post, solo_verdicts["node-a"][0]):
+                    print("  FAIL: post-rejoin verdicts diverged",
+                          file=sys.stderr)
+                    rc = 1
+            finally:
+                srv2.stop()
+        finally:
+            for c in clients.values():
+                c.close()
+            srv.stop()
+            drain_pool(v._pool)
+            pool = v._pool.stats()
+            v.close()
+
+        # -- pool hygiene ------------------------------------------------
+        print(f"  pool                       : {pool}")
+        if pool["in_flight"] != 0:
+            print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+                  file=sys.stderr)
+            rc = 1
+    finally:
+        tr.configure(enabled=False)
+        os.environ.pop("TM_TPU_FORCE_DEVICE", None)
+        pl.AsyncBatchVerifier._prepare = real_prepare
+    return rc
+
+
 def run_soak(args) -> int:
     """--soak: the round-16 soak-harness gate on a mocked relay (verdicts
     come back all-accept with NO kernel — this gate checks the HARNESS,
@@ -1713,6 +1956,16 @@ def main() -> int:
         "a forged signature is the only rejection, zero pool-slot leak",
     )
     ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="round-18 gate: the network-facing verification fleet on a "
+        "mocked relay over REAL loopback sockets — two client nodes' "
+        "same-epoch blocks coalesce into fewer launches than solo, the "
+        "one forged signature demuxes to the right node/row, a mid-window "
+        "fleet kill loses zero items (host fallback) and a restarted "
+        "server is rejoined, zero pool-slot leak",
+    )
+    ap.add_argument(
         "--soak",
         action="store_true",
         help="round-16 gate: soak-harness hygiene on a mocked relay — "
@@ -1738,6 +1991,8 @@ def main() -> int:
         return run_votes(args)
     if args.fabric:
         return run_fabric(args)
+    if args.fleet:
+        return run_fleet(args)
     if args.soak:
         return run_soak(args)
 

@@ -253,6 +253,51 @@ def run_soak(args) -> int:
     return 3 if inconclusive else 1
 
 
+def run_fleet(args) -> int:
+    """--fleet: the shared-verification-fleet scenario (ISSUE 18). A
+    100-node cluster submits EntryBlock verify requests at all three QoS
+    tiers through the real wire codec (loopback transport) to ONE fleet
+    host; --fleet-kill-at crashes it mid-run and every node degrades to
+    local verification with zero stalled requests. --repeat N asserts
+    replay-exact reports; the verdict also checks verdict parity against
+    an all-local run of the same seed (degradation may move WHERE a
+    verdict is computed, never what it is). Pure host-side — no jax, no
+    crypto wheel."""
+    from tendermint_tpu.simnet.fleet import run_fleet_scenario
+
+    kw = dict(
+        seed=args.seed,
+        n_nodes=args.fleet_nodes,
+        kill_at=args.fleet_kill_at if args.fleet_kill_at >= 0 else None,
+        revive_at=args.fleet_revive_at if args.fleet_revive_at >= 0 else None,
+    )
+    t0 = time.monotonic()
+    runs = [run_fleet_scenario(**kw) for _ in range(max(args.repeat, 1))]
+    baseline = run_fleet_scenario(seed=args.seed, n_nodes=args.fleet_nodes,
+                                  all_local=True)
+    verdict = dict(runs[0])
+    verdict["runs"] = len(runs)
+    verdict["wall_total_s"] = round(time.monotonic() - t0, 3)
+    verdict["replay_exact"] = all(r == runs[0] for r in runs)
+    verdict["verdict_parity"] = (
+        runs[0]["verdict_fingerprint"] == baseline["verdict_fingerprint"]
+    )
+    verdict["ok"] = bool(
+        verdict["replay_exact"]
+        and verdict["verdict_parity"]
+        and verdict["stalled_requests"] == 0
+    )
+    if not verdict["ok"]:
+        verdict["reason"] = (
+            "same-seed fleet runs diverged" if not verdict["replay_exact"]
+            else "fleet/local verdict streams differ"
+            if not verdict["verdict_parity"]
+            else "%d requests stalled" % verdict["stalled_requests"]
+        )
+    print(json.dumps(verdict, indent=2, default=str))
+    return 0 if verdict["ok"] else 1
+
+
 def parse_seed_range(spec: str):
     """"a:b" -> range(a, b); "3,7,9" -> [3, 7, 9]; "12" -> [12]."""
     if ":" in spec:
@@ -460,6 +505,29 @@ def main() -> int:
         help="write the full soak artifact JSON (gauge rings, windows, "
         "flight recorder on failure) here — tools/soak_report.py renders it",
     )
+    # -- shared verification fleet (ISSUE 18) -----------------------------
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="run the shared-verification-fleet scenario instead of "
+        "--height: --fleet-nodes nodes submit EntryBlock verify requests "
+        "at all three QoS tiers through the real fleet wire codec to one "
+        "fleet host; the verdict asserts zero stalled requests, verdict "
+        "parity vs an all-local run, and (--repeat N) replay exactness",
+    )
+    ap.add_argument(
+        "--fleet-nodes", type=int, default=100,
+        help="cluster size for --fleet (default 100)",
+    )
+    ap.add_argument(
+        "--fleet-kill-at", type=float, default=4.0,
+        help="kill the fleet host this many virtual seconds in "
+        "(default 4.0; negative = never)",
+    )
+    ap.add_argument(
+        "--fleet-revive-at", type=float, default=7.0,
+        help="revive the fleet host at this virtual second "
+        "(default 7.0; negative = never)",
+    )
     # -- chain-replay catch-up (ISSUE 14) ---------------------------------
     ap.add_argument(
         "--replay-node", type=int, default=-1,
@@ -510,6 +578,8 @@ def main() -> int:
         return run_search(args)
     if args.soak > 0:
         return run_soak(args)
+    if args.fleet:
+        return run_fleet(args)
 
     if args.smoke:
         args.nodes = 4

@@ -55,6 +55,7 @@ def iter_functions(tree: ast.AST):
 
 
 from .determinism import SimnetDeterminismRule  # noqa: E402
+from .fleet import FleetTransportRule  # noqa: E402
 from .ingress import IngressDisciplineRule  # noqa: E402
 from .donation import DonationAliasingRule  # noqa: E402
 from .locks import LockDisciplineRule  # noqa: E402
@@ -65,6 +66,7 @@ ALL_RULES = [
     DonationAliasingRule(),
     IngressDisciplineRule(),
     RelayOwnershipRule(),
+    FleetTransportRule(),
     SimnetDeterminismRule(),
     HotPathPurityRule(),
     LockDisciplineRule(),
