@@ -105,7 +105,13 @@ def create_batch_verifier(pk: PubKey) -> Optional[BatchVerifier]:
         from ..ops.mixed import Sr25519DeviceBatchVerifier
 
         return Sr25519DeviceBatchVerifier()
-    # secp256k1 never batches (batch.go:26-33)
+    # secp256k1 has no batch VERIFIER (batch.go:26-33) and must stay
+    # None here: _verify_commit_batch's add_block path is ed25519-shaped
+    # and would choke on 33-byte keys. Batched secp verification exists
+    # anyway (ISSUE 19) — it routes through the scheme lanes instead:
+    # types/validation.prepare_commit_batch (all-secp committees),
+    # prepare_commit_scheme_split + the mesh packer (mixed committees),
+    # and ops.mixed.Secp256k1DeviceBatchVerifier for explicit opt-in.
     return None
 
 

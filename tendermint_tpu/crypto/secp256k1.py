@@ -4,11 +4,17 @@ Reference parity: crypto/secp256k1/secp256k1.go and secp256k1_nocgo.go —
   - PubKey is 33-byte compressed SEC1; Address = RIPEMD160(SHA256(pub)) (:141-153)
   - Sign: ECDSA over SHA256(msg), 64-byte R||S, lower-S form (nocgo:20-32)
   - VerifySignature rejects non-lower-S signatures (nocgo:34-54)
-  - No batch support (crypto/batch/batch.go:26-33) — stays host-side in the
-    TPU build as well.
+  - No batch VERIFIER (crypto/batch/batch.go:26-33): create_batch_verifier
+    stays None for parity. Device batching exists anyway since ISSUE 19 —
+    it routes through the scheme lanes (ops/secp_verify via
+    prepare_commit_batch / ops.mixed), not the verifier interface, and is
+    bit-identical to per-signature verification.
 
-Backed by the `cryptography` OpenSSL binding; lower-S normalization and the
-64-byte wire format are handled here.
+Backed by the `cryptography` OpenSSL binding when present; under
+TM_TPU_PUREPY_CRYPTO=1 a container without the wheel runs the pure-Python
+_weierstrass implementation instead (byte-identical signatures — both paths
+are RFC 6979 deterministic with lower-S normalization). Lower-S and the
+64-byte wire format are handled here either way.
 """
 
 from __future__ import annotations
@@ -16,9 +22,7 @@ from __future__ import annotations
 import hashlib
 import os
 
-try:  # OpenSSL-backed. Under TM_TPU_PUREPY_CRYPTO=1 (see crypto/ed25519)
-    # the module still imports without the wheel (key registration, sizes,
-    # address math) and only the ECDSA ops raise at use.
+try:  # OpenSSL fast path (see crypto/ed25519 for the gating rationale).
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (
         Prehashed,
@@ -35,13 +39,7 @@ except ModuleNotFoundError:
     _HAVE_OPENSSL = False
 
 from . import PrivKey as _PrivKey, PubKey as _PubKey, register_key_type
-
-
-def _require_openssl() -> None:
-    if not _HAVE_OPENSSL:
-        raise RuntimeError(
-            "secp256k1 ECDSA requires the `cryptography` OpenSSL wheel"
-        )
+from . import _weierstrass
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33
@@ -54,6 +52,13 @@ PRIV_KEY_NAME = "tendermint/PrivKeySecp256k1"
 # Curve order of secp256k1.
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 _CURVE = ec.SECP256K1() if _HAVE_OPENSSL else None
+
+
+def is_pure_python() -> bool:
+    """True when the OpenSSL binding is absent (TM_TPU_PUREPY_CRYPTO
+    fallback): per-signature verification is GIL-held Python bignum math,
+    so callers skip thread pools and prefer the device lane."""
+    return not _HAVE_OPENSSL
 
 
 class PubKey(_PubKey):
@@ -80,12 +85,16 @@ class PubKey(_PubKey):
             return False
         if s > _N // 2:  # reject non-lower-S (nocgo:35,41-44)
             return False
-        _require_openssl()
+        digest = hashlib.sha256(msg).digest()
+        if not _HAVE_OPENSSL:
+            return _weierstrass.verify_digest(
+                _weierstrass.decompress(self._bytes), digest, r, s
+            )
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self._bytes)
             pub.verify(
                 encode_dss_signature(r, s),
-                hashlib.sha256(msg).digest(),
+                digest,
                 ec.ECDSA(Prehashed(hashes.SHA256())),
             )
             return True
@@ -97,35 +106,44 @@ class PubKey(_PubKey):
 
 
 class PrivKey(_PrivKey):
-    __slots__ = ("_bytes", "_sk")
+    __slots__ = ("_bytes", "_d", "_sk")
 
     def __init__(self, data: bytes):
         if len(data) != PRIV_KEY_SIZE:
             raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
         self._bytes = bytes(data)
-        d = int.from_bytes(data, "big")
-        if not (0 < d < _N):
+        self._d = int.from_bytes(data, "big")
+        if not (0 < self._d < _N):
             raise ValueError("invalid secp256k1 scalar")
-        _require_openssl()
-        self._sk = ec.derive_private_key(d, _CURVE)
+        self._sk = ec.derive_private_key(self._d, _CURVE) if _HAVE_OPENSSL else None
 
     def sign(self, msg: bytes) -> bytes:
         # RFC 6979 deterministic nonces, matching btcec (nocgo:20-32): same
-        # (key, msg) must always yield the same signature bytes.
+        # (key, msg) must always yield the same signature bytes — on both
+        # the OpenSSL and the pure-Python path.
         digest = hashlib.sha256(msg).digest()
-        der = self._sk.sign(
-            digest, ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True)
-        )
-        r, s = decode_dss_signature(der)
+        if self._sk is not None:
+            der = self._sk.sign(
+                digest,
+                ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True),
+            )
+            r, s = decode_dss_signature(der)
+        else:
+            r, s = _weierstrass.sign_digest(self._d, digest)
         if s > _N // 2:  # normalize to lower-S
             s = _N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> PubKey:
-        pub = self._sk.public_key().public_bytes(
-            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        if self._sk is not None:
+            pub = self._sk.public_key().public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.CompressedPoint,
+            )
+            return PubKey(pub)
+        return PubKey(
+            _weierstrass.compress(_weierstrass.scalar_mult(self._d, _weierstrass.G))
         )
-        return PubKey(pub)
 
     def bytes(self) -> bytes:
         return self._bytes

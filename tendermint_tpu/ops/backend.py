@@ -94,6 +94,21 @@ def _bucket_for(n: int) -> int:
     return BUCKETS[-1]
 
 
+# The secp256k1 lane gets a finer bucket floor: with no RLC fusion the
+# Strauss+GLV ladder's kernel time is ~linear per ROW (padding included),
+# so a 10-signature commit on the 128 floor pays 12× its useful work —
+# material on CPU backends where the ladder runs ~40 ms/row. One extra
+# small shape in the compile cache buys it back.
+SECP_BUCKETS = (16,) + BUCKETS
+
+
+def _secp_bucket_for(n: int) -> int:
+    for b in SECP_BUCKETS:
+        if n <= b:
+            return b
+    return SECP_BUCKETS[-1]
+
+
 def _pack_le_limbs(enc: np.ndarray) -> np.ndarray:
     """(B, 32) uint8 little-endian encodings -> (B, 20) int32 limbs of the
     low 255 bits (bit 255 — the sign bit — is excluded). Routes through the
@@ -453,6 +468,119 @@ def cached_kernel(ep, device_hash: bool, donate: bool = False):
     return call
 
 
+# -- secp256k1 scheme lane (ISSUE 19) ---------------------------------------
+#
+# ECDSA has no RLC fusion and no pallas variant (follow-up work): the
+# scheme rides the XLA per-signature kernel family only, with the same
+# bucket ladder, donation contract, and warm-epoch gather split as
+# ed25519. Host prep is python-int math (s^-1 mod n + GLV), so it runs on
+# the prep pool like every other prep.
+
+
+def _secp_items(entries) -> list:
+    """EntryBlock (scheme secp256k1) or (pub33, msg, sig64) tuple list ->
+    the item tuples ops/secp_verify.prepare_rows* consume."""
+    if isinstance(entries, EntryBlock):
+        mvs = entries.msg_views()
+        return [
+            (entries.pub_bytes(i), mvs[i], entries.sig[i].tobytes())
+            for i in range(len(entries))
+        ]
+    return list(entries)
+
+
+def prepare_batch_secp(entries, bucket: int) -> tuple:
+    """Direct (uncached) secp256k1 prep: host decompression + GLV split
+    -> the jitted_secp_verify arg arrays, padded to `bucket` with
+    trivial-accept rows."""
+    from . import secp_verify as _sv
+
+    t0 = time.perf_counter()
+    with _span("ops.host_prep", n=len(entries), bucket=bucket,
+               scheme="secp256k1"):
+        args = _sv.prepare_rows(_secp_items(entries), bucket)
+    _ops_m().host_prep_seconds.observe(
+        time.perf_counter() - t0, bucket=str(bucket)
+    )
+    return args
+
+
+def prepare_batch_secp_cached(entries: EntryBlock, bucket: int, ep) -> tuple:
+    """Warm-epoch secp prep: the committee's decompressed affine Q
+    columns are device-resident (ep.secp_tables) — the batch ships gather
+    indices + scalar data only."""
+    from . import secp_verify as _sv
+
+    t0 = time.perf_counter()
+    with _span("ops.host_prep", n=len(entries), bucket=bucket,
+               scheme="secp256k1", cached=1):
+        args = _sv.prepare_rows_cached(
+            _secp_items(entries), entries.val_idx, bucket, ep.vp - 1
+        )
+    _ops_m().host_prep_seconds.observe(
+        time.perf_counter() - t0, bucket=str(bucket)
+    )
+    return args
+
+
+def secp_kernel(donate: bool = False):
+    from . import secp_verify as _sv
+
+    return _sv.jitted_secp_verify(donate)
+
+
+def secp_cached_kernel(ep, donate: bool = False):
+    """Warm-epoch secp kernel closure: resolves the entry's device Q
+    tables at CALL time on the dispatch-owner thread (the cached_kernel
+    contract — tables are the leading never-donated arguments)."""
+    from . import secp_verify as _sv
+
+    base = _sv.jitted_secp_verify_cached(donate)
+
+    def call(*args):
+        qx, qy, q_ok = ep.secp_tables()
+        return base(qx, qy, q_ok, *args)
+
+    return call
+
+
+def verify_batch_secp(entries) -> np.ndarray:
+    """Run the secp256k1 device kernel over arbitrary batch size
+    (EntryBlock with scheme secp256k1, or (pub33, msg, sig64) tuples);
+    returns (n,) bool. Direct relay path — devcheck-exempt like
+    verify_batch."""
+    with _devcheck.exempt():
+        from . import epoch_cache as _epoch
+        from . import secp_verify as _sv
+
+        ep = _epoch.lookup(entries)
+        if ep is not None and ep.scheme != "secp256k1":
+            ep = None
+        out: List[np.ndarray] = []
+        i = 0
+        n_total = len(entries)
+        while i < n_total:
+            chunk = entries[i : i + BUCKETS[-1]]
+            bucket = _secp_bucket_for(len(chunk))
+            t0 = time.perf_counter()
+            if ep is not None:
+                args = prepare_batch_secp_cached(chunk, bucket, ep)
+                kern = secp_cached_kernel(ep)
+            else:
+                args = prepare_batch_secp(chunk, bucket)
+                kern = secp_kernel()
+            t1 = time.perf_counter()
+            with _span("ops.device_wait", bucket=bucket, scheme="secp256k1"):
+                res = np.array(kern(*args))
+            _note_device_batch(
+                len(chunk), bucket, prep_s=t1 - t0,
+                device_s=time.perf_counter() - t1,
+            )
+            out.append(res[: len(chunk)])
+            i += len(chunk)
+        return np.concatenate(out) if out else np.zeros((0,), dtype=bool)
+
+
 def prepare_batch_device_hash(entries, bucket: int) -> tuple:
     """Device-hash argument prep: no host SHA-512 — messages ship as padded
     R||A||M SHA blocks. EntryBlock input pads columnar (pad_ram_block);
@@ -585,6 +713,8 @@ def verify_batch(entries) -> np.ndarray:
     TM_TPU_DEVCHECK it runs in a devcheck.exempt() scope so the lazy
     epoch-table uploads it may trigger on the caller thread do not trip
     the relay-ownership assertion while a dispatcher owns the relay."""
+    if getattr(entries, "scheme", "ed25519") == "secp256k1":
+        return verify_batch_secp(entries)
     with _devcheck.exempt():
         return _verify_batch_direct(entries)
 

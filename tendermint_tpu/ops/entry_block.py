@@ -51,13 +51,14 @@ class EntryBlock:
 
     __slots__ = ("pub", "sig", "msgs", "offsets",
                  "ram_hi", "ram_lo", "ram_counts",
-                 "val_idx", "epoch_key")
+                 "val_idx", "epoch_key", "scheme", "pub_aux")
 
     def __init__(self, pub: np.ndarray, sig: np.ndarray,
                  msgs: Union[bytes, memoryview], offsets: np.ndarray,
                  ram_hi: "np.ndarray" = None, ram_lo: "np.ndarray" = None,
                  ram_counts: "np.ndarray" = None,
-                 val_idx: "np.ndarray" = None, epoch_key: bytes = None):
+                 val_idx: "np.ndarray" = None, epoch_key: bytes = None,
+                 scheme: str = "ed25519", pub_aux: "np.ndarray" = None):
         n = pub.shape[0]
         if pub.shape != (n, 32) or sig.shape != (n, 64):
             raise ValueError("pub must be (n, 32) and sig (n, 64) uint8")
@@ -91,31 +92,57 @@ class EntryBlock:
             raise ValueError("val_idx must be (n,)")
         self.val_idx = val_idx
         self.epoch_key = epoch_key
+        # Scheme tag (ISSUE 19): every row of a block shares ONE signature
+        # scheme — the mesh packer keys lanes on it and the kernel prep
+        # branches on it. `pub_aux` carries the per-row byte a scheme's
+        # wire key needs beyond the (n, 32) column: for secp256k1 the SEC1
+        # compression prefix (pub = prefix || X, so pub holds X). ed25519
+        # blocks keep pub_aux None.
+        self.scheme = scheme
+        if pub_aux is not None and pub_aux.shape != (n,):
+            raise ValueError("pub_aux must be (n,)")
+        self.pub_aux = pub_aux
 
     # -- construction -------------------------------------------------------
 
     @classmethod
-    def empty(cls) -> "EntryBlock":
+    def empty(cls, scheme: str = "ed25519") -> "EntryBlock":
         return cls(
             np.zeros((0, 32), dtype=np.uint8),
             np.zeros((0, 64), dtype=np.uint8),
             b"",
             _EMPTY_OFFSETS,
+            scheme=scheme,
+            pub_aux=(
+                np.zeros(0, dtype=np.uint8) if scheme != "ed25519" else None
+            ),
         )
 
     @classmethod
-    def from_entries(cls, entries: Sequence[Entry]) -> "EntryBlock":
+    def from_entries(cls, entries: Sequence[Entry],
+                     scheme: str = "ed25519") -> "EntryBlock":
         """Tuple-list shim: one validation pass + two joins, the same cost
         the old per-batch _pack_rows paid — conversion happens once at the
-        API boundary instead of in every downstream stage."""
+        API boundary instead of in every downstream stage. Non-ed25519
+        schemes declare themselves: secp256k1 entries carry 33-byte SEC1
+        keys, split here into the prefix column (pub_aux) + X (pub)."""
         n = len(entries)
         if n == 0:
-            return cls.empty()
-        if any(len(pk) != 32 or len(s) != 64 for pk, _, s in entries):
-            raise ValueError("entries must be (pub32, msg, sig64) triples")
-        pub = np.frombuffer(
+            return cls.empty(scheme)
+        klen = 33 if scheme == "secp256k1" else 32
+        if any(len(pk) != klen or len(s) != 64 for pk, _, s in entries):
+            raise ValueError(
+                f"entries must be (pub{klen}, msg, sig64) triples"
+            )
+        raw = np.frombuffer(
             b"".join(pk for pk, _, _ in entries), dtype=np.uint8
-        ).reshape(n, 32)
+        ).reshape(n, klen)
+        pub_aux = None
+        if klen == 33:
+            pub_aux = np.ascontiguousarray(raw[:, 0])
+            pub = np.ascontiguousarray(raw[:, 1:])
+        else:
+            pub = raw
         sig = np.frombuffer(
             b"".join(s for _, _, s in entries), dtype=np.uint8
         ).reshape(n, 64)
@@ -124,7 +151,7 @@ class EntryBlock:
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lens, out=offsets[1:])
         msgs = b"".join(m for _, m, _ in entries)
-        return cls(pub, sig, msgs, offsets)
+        return cls(pub, sig, msgs, offsets, scheme=scheme, pub_aux=pub_aux)
 
     # -- shape --------------------------------------------------------------
 
@@ -144,10 +171,18 @@ class EntryBlock:
         o = self.offsets
         return bytes(memoryview(self.msgs)[int(o[i]) : int(o[i + 1])])
 
+    def pub_bytes(self, i: int) -> bytes:
+        """Row i's full wire-format key (prefix byte re-attached for
+        schemes that split one into pub_aux)."""
+        if self.pub_aux is not None:
+            return bytes([int(self.pub_aux[i])]) + self.pub[i].tobytes()
+        return self.pub[i].tobytes()
+
     def entry(self, i: int) -> Entry:
-        """Materialize ONE (pub32, msg, sig64) tuple — the blame path's
-        per-lane re-verify, not a bulk conversion."""
-        return self.pub[i].tobytes(), self.msg(i), self.sig[i].tobytes()
+        """Materialize ONE (pub, msg, sig64) tuple — the blame path's
+        per-lane re-verify, not a bulk conversion. The pub element is the
+        scheme's wire key (32 bytes ed25519, 33 bytes secp256k1)."""
+        return self.pub_bytes(i), self.msg(i), self.sig[i].tobytes()
 
     def iter_entries(self) -> Iterator[Entry]:  # tmlint: fallback — tuple-compat shim, blame/debug path only
         for i in range(self.n):
@@ -201,6 +236,10 @@ class EntryBlock:
                 self.val_idx[start:stop] if self.val_idx is not None else None
             ),
             epoch_key=self.epoch_key,
+            scheme=self.scheme,
+            pub_aux=(
+                self.pub_aux[start:stop] if self.pub_aux is not None else None
+            ),
         )
 
     # -- combination --------------------------------------------------------
@@ -216,6 +255,14 @@ class EntryBlock:
             return EntryBlock.empty()
         if len(blocks) == 1:
             return blocks[0]
+        # scheme discipline (ISSUE 19): unlike epoch_key (which degrades a
+        # mixed merge to the uncached prep), a cross-scheme concat has no
+        # meaning — the rows would hit the wrong kernel. The mesh packer
+        # keys lanes per scheme precisely so this never fires in the
+        # dispatch path; a caller-driven mix is a bug, not a fallback.
+        scheme = blocks[0].scheme
+        if any(b.scheme != scheme for b in blocks):
+            raise ValueError("cannot concat mixed-scheme EntryBlocks")
         pub = np.concatenate([b.pub for b in blocks])
         sig = np.concatenate([b.sig for b in blocks])
         msgs = b"".join(b.msgs_contiguous()[0] for b in blocks)
@@ -246,10 +293,14 @@ class EntryBlock:
         ):
             epoch_key = blocks[0].epoch_key
             val_idx = np.concatenate([b.val_idx for b in blocks])
+        pub_aux = None
+        if all(b.pub_aux is not None for b in blocks):
+            pub_aux = np.concatenate([b.pub_aux for b in blocks])
         return EntryBlock(pub, sig, msgs, offsets,
                           ram_hi=ram_hi, ram_lo=ram_lo,
                           ram_counts=ram_counts,
-                          val_idx=val_idx, epoch_key=epoch_key)
+                          val_idx=val_idx, epoch_key=epoch_key,
+                          scheme=scheme, pub_aux=pub_aux)
 
 
 class CommitBlock:

@@ -70,6 +70,15 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+@functools.lru_cache(maxsize=1)
+def _secp_pad_pub() -> np.ndarray:
+    """The secp256k1 padding row's pubkey: the compressed generator
+    (jax-free — pure-python curve constants only)."""
+    from ..crypto import _weierstrass as _wst
+
+    return np.frombuffer(_wst.compress(_wst.G), dtype=np.uint8)
+
+
 class EpochEntry:
     """One validator set's device-resident pubkey tables.
 
@@ -84,20 +93,27 @@ class EpochEntry:
     dispatcher's transfer accounting can attribute the one-time cold-
     epoch cost separately from steady-state H2D."""
 
-    __slots__ = ("key", "n_vals", "vp", "pub_rows", "_mtx", "_dev")
+    __slots__ = ("key", "n_vals", "vp", "pub_rows", "scheme", "_mtx",
+                 "_dev")
 
-    def __init__(self, key: bytes, pub_col: np.ndarray):
+    def __init__(self, key: bytes, pub_col: np.ndarray,
+                 scheme: str = "ed25519"):
         v = pub_col.shape[0]
         # pad to a power of two (min 16) so the compiled-shape set stays
         # small: the kernels' shapes are keyed by vp, not the raw size
         vp = max(_next_pow2(v + 1), 16)
-        rows = np.empty((vp, 32), dtype=np.uint8)
+        rows = np.empty((vp, pub_col.shape[1]), dtype=np.uint8)
         rows[:v] = pub_col
-        rows[v:] = _IDENT_ENC
+        # padding rows: the scheme's trivial gather target — ed25519's
+        # identity encoding, or secp256k1's compressed generator (the
+        # secp pad lane verifies a fixed signature under G; ops/mesh.py
+        # _secp_pad_row)
+        rows[v:] = _IDENT_ENC if scheme == "ed25519" else _secp_pad_pub()
         self.key = key
         self.n_vals = v
         self.vp = vp
         self.pub_rows = rows
+        self.scheme = scheme
         self._mtx = _devcheck.lock("epoch.entry")
         self._dev: dict = {}
 
@@ -183,9 +199,41 @@ class EpochEntry:
                 self._dev[key] = t
             return t
 
+    def secp_tables(self) -> Tuple:
+        """((vp, 20) int32 qx limbs, (vp, 20) int32 qy limbs, (vp,) bool
+        ok) on device — the committee's DECOMPRESSED affine Q columns for
+        the cached secp256k1 kernel (ops/secp_verify.verify_kernel_cached).
+        Decompression (the per-key square root) runs once per epoch on
+        the host (ops/secp_verify.table_columns, memoized per key); rows
+        whose pubkey fails to decompress carry G with ok False, and every
+        padding row is (G, True) — the pad lane's trivial-accept base."""
+        with self._mtx:
+            t = self._dev.get("secp")
+            if t is None:
+                _devcheck.note_relay_touch("epoch_cache.secp_tables")
+                import jax
+
+                from . import secp_verify as _sv
+
+                # table_columns appends ONE pad row itself; feed it the
+                # first vp-1 rows (live keys + compressed-G padding) so
+                # the device shape lands exactly on vp
+                qx, qy, ok = _sv.table_columns(
+                    [r.tobytes() for r in self.pub_rows[: self.vp - 1]]
+                )
+                with _span("pipeline.table_upload", layout="secp",
+                           vp=self.vp):
+                    t = (jax.device_put(qx), jax.device_put(qy),
+                         jax.device_put(ok))
+                self._dev["secp"] = t
+            return t
+
     def nbytes_host(self) -> int:
         """Host bytes a FULL table upload ships (every layout the kernels
         consume) — the cold-epoch H2D cost the --transfer gate accounts."""
+        if self.scheme == "secp256k1":
+            # qx + qy limb tables + ok flags
+            return self.vp * (2 * 20 * 4 + 1)
         # xla limbs+sign, pallas coords+ok
         return self.vp * (20 * 4 + 4) + self.vp * (4 * 32 * 4 + 4)
 
@@ -229,7 +277,8 @@ class EpochCache:
                 self._entries.move_to_end(key)
             return e
 
-    def note(self, key: bytes, pub_col: np.ndarray) -> Optional[EpochEntry]:
+    def note(self, key: bytes, pub_col: np.ndarray,
+             scheme: str = "ed25519") -> Optional[EpochEntry]:
         """Warm lookup-or-register. Returns the entry when the epoch is
         WARM (seen before — counted as a hit); a cold epoch registers and
         returns None so the first commit rides the uncached path and the
@@ -242,7 +291,7 @@ class EpochCache:
                 m.epoch_cache_hits.inc()
                 return e
             m.epoch_cache_misses.inc()
-            self._entries[key] = EpochEntry(key, pub_col)
+            self._entries[key] = EpochEntry(key, pub_col, scheme)
             while len(self._entries) > self.depth:
                 self._entries.popitem(last=False)
                 m.epoch_cache_evictions.inc()
@@ -306,16 +355,21 @@ def reset(depth: Optional[int] = None) -> None:
 
 def note_valset(vals) -> Optional[bytes]:
     """Register/refresh `vals` in the cache; returns the epoch key iff the
-    epoch is WARM and cacheable (all-ed25519 columns). The key rides on
-    the EntryBlock (`epoch_key`) so the prep stage can find the entry."""
+    epoch is WARM and cacheable (single-scheme columns: all-ed25519 or
+    all-secp256k1 — ISSUE 19). The key rides on the EntryBlock
+    (`epoch_key`) so the prep stage can find the entry."""
     c = cache()
     if c is None:
         return None
     cols = vals.ed25519_columns()
+    scheme = "ed25519"
+    if cols is None:
+        cols = vals.secp256k1_columns()
+        scheme = "secp256k1"
     if cols is None:
         return None
     key = vals.hash()
-    return key if c.note(key, cols[0]) is not None else None
+    return key if c.note(key, cols[0], scheme) is not None else None
 
 
 def stats() -> dict:
@@ -346,4 +400,10 @@ def lookup(entries) -> Optional[EpochEntry]:
     c = cache()
     if c is None:
         return None
-    return c.get(key)
+    e = c.get(key)
+    if e is not None and e.scheme != getattr(entries, "scheme", "ed25519"):
+        # hash collision across schemes can't happen for one valset (a
+        # set has one scheme), but a stale/mismatched key must degrade to
+        # the uncached path, never feed the wrong kernel's tables
+        return None
+    return e

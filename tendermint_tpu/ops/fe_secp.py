@@ -1,0 +1,307 @@
+"""Field arithmetic over GF(2^256 - 2^32 - 977) — secp256k1 — in 13-bit
+limbs, for TPU/XLA.
+
+Same limb conventions as `fe.py` (ISSUE 19: the secp256k1 lane of the
+device verification engine): an element is an int32 array (..., 20) of
+13-bit limbs, shape-polymorphic over leading batch dims, signed limbs
+with lazy canonicalization. The differences from GF(2^255 - 19) are all
+consequences of the prime's shape:
+
+- The top wrap is NOT a single small constant. 2^260 mod p =
+  2^4 * (2^32 + 977) = 2^36 + 15632, which in radix-13 limbs is
+  (7440, 1, 1024) at limbs (0, 1, 2). Every carry out of limb 19
+  distributes over three low limbs instead of one.
+- The wrap coefficient 7440 is ~12x ed25519's 608, so the single-stage
+  fold `fe.mul` uses (hi split 13-bit, scale, add to lo) would overflow
+  int32: hi_hi * 7440 alone reaches ~1.7e9 on top of a ~1.8e9 lo term.
+  `mul` here instead carries the 39-coefficient convolution in place
+  first (no wrap, widths 41 -> coefficients < 2^13.01), then folds the
+  top coefficients through the (7440, 1, 1024) pattern twice. The extra
+  carry passes are element-wise shifts; the einsum still dominates.
+- Canonicalization folds at the 2^256 boundary (mid-limb-19: bit 9),
+  since 2^256 ≡ 2^32 + 977 gives a two-term sparse fold (977 at limb 0,
+  64 at limb 2).
+
+Invariants (re-derived for this prime; see the bound notes inline):
+- "reduced" form (output of carry/add/sub/mul/sq): limb 0 in
+  (-15632, 15632], limb 1 in (-8223, 8223], limb 2 in (-9246, 9246],
+  limbs 3..19 in (-8198, 8198]. Safe as input to any op here: the worst
+  convolution coefficient is bounded by 2*15632*9252 + 18*9252^2
+  < 1.84e9 < 2^31.
+- "canonical" form (output of canon): limbs in [0, 2^13), value in [0, p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1  # 8191
+
+P = 2**256 - 2**32 - 977
+
+# 2^260 mod p = 2^36 + 15632, distributed over limbs 0..2.
+_WRAP0 = 7440  # 15632 & 8191
+_WRAP1 = 1  # 15632 >> 13
+_WRAP2 = 1024  # 2^36 = 2^(13*2 + 10)
+
+# 2^256 mod p = 2^32 + 977: the canon-time fold constants. 2^32 sits at
+# bit 6 of limb 2 (32 = 13*2 + 6).
+_FOLD0 = 977
+_FOLD2 = 64
+
+
+def limbs_raw(v: int) -> np.ndarray:
+    """Nonnegative int < 2^260 -> 20-limb int32 array, NO mod-p reduction."""
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = (v >> (RADIX * i)) & MASK
+    return out
+
+
+def limbs_from_int(v: int) -> np.ndarray:
+    """Python int -> canonical (mod-p-reduced) 20-limb int32 array."""
+    return limbs_raw(v % P)
+
+
+def int_from_limbs(a) -> int:
+    """Limb array (20,) -> Python int (host helper; no mod-p reduction)."""
+    a = np.asarray(a, dtype=object)
+    return int(sum(int(a[i]) << (RADIX * i) for i in range(NLIMBS)))
+
+
+# Module constants stay NUMPY (never jnp): a jnp array materialized at import
+# time *during an active trace* (lazy import under jit) leaks as a tracer;
+# numpy constants are immune and jit constant-folds them the same way.
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
+ONE = np.asarray(limbs_from_int(1))
+P_LIMBS = np.asarray(limbs_raw(P))  # limbs of p itself (NOT reduced!)
+
+# 16p in radix-13 limbs (fits: 16p < 2^260). Added before canonicalization
+# so possibly-negative reduced values become positive: a reduced value is
+# > -2^253 (the masked residues are nonnegative; only the ~30-bounded
+# carries of the final pass contribute negatively), and 16p > 2^259.9.
+P16_LIMBS = np.asarray(limbs_raw(16 * P))
+
+# Convolution index/mask matrices: TOEP_IDX[k, i] = k - i (clipped),
+# TOEP_MSK[k, i] = 1 iff 0 <= k - i < NLIMBS.
+_k = np.arange(2 * NLIMBS - 1)[:, None]
+_i = np.arange(NLIMBS)[None, :]
+TOEP_IDX = np.clip(_k - _i, 0, NLIMBS - 1).astype(np.int32)
+TOEP_MSK = (((_k - _i) >= 0) & ((_k - _i) < NLIMBS)).astype(np.int32)
+
+
+def _carry_pass(x):
+    """One parallel carry pass: every limb sheds its carry to the next limb
+    simultaneously; the carry out of limb 19 (weight 2^260) wraps into
+    limbs 0..2 with the (7440, 1, 1024) pattern. Three passes land every
+    limb within the reduced-form bounds above: starting from |limb| < 2^31,
+    the carries contract 2^18 -> ~2.4e5 -> ~30 -> ~1, and the resting
+    state keeps limb 0 below 8191 + 1*7440 and limb 2 below
+    8191 + 30 + 1024."""
+    c = x >> RADIX  # arithmetic shift == floor division (signed-safe)
+    r = x & MASK
+    top = c[..., NLIMBS - 1 :]
+    wrap = jnp.concatenate(
+        [top * _WRAP0, top * _WRAP1, top * _WRAP2,
+         jnp.zeros_like(c[..., : NLIMBS - 3])],
+        axis=-1,
+    )
+    shift = jnp.concatenate(
+        [jnp.zeros_like(top), c[..., : NLIMBS - 1]], axis=-1
+    )
+    return r + wrap + shift
+
+
+def carry(x):
+    """Propagate carries: (..., 20) int32 with |limb| < 2^31 -> reduced form.
+
+    Three parallel passes, like fe.carry; the secp wrap feeds three limbs
+    per pass but the contraction argument is the same (bounds in the
+    _carry_pass docstring)."""
+    return _carry_pass(_carry_pass(_carry_pass(x)))
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    return carry(a - b)
+
+
+def neg(a):
+    return carry(-a)
+
+
+def _wide_pass(x):
+    """One carry pass over a widened coefficient array with NO top wrap:
+    the top coefficient simply accumulates (callers size the array so the
+    value fits)."""
+    c = x >> RADIX
+    r = x & MASK
+    shift = jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    return r + shift
+
+
+def _fold_top(x, width_out: int):
+    """Fold coefficients >= 20 of a carried wide array through
+    2^260 ≡ 2^36 + 15632: coefficient k contributes (7440, 1, 1024) at
+    positions (k-20, k-19, k-18). Requires |coeff| <~ 2^13.01 (post
+    _wide_pass x2), so every product stays below ~8230 * 7440 < 7e7."""
+    lo = x[..., :NLIMBS]
+    hi = x[..., NLIMBS:]
+    nhi = hi.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 1)
+
+    def _at(v, off):
+        # place hi coefficient j's contribution at position j + off, in a
+        # width_out array
+        return jnp.pad(v, pad + [(off, width_out - nhi - off)])
+
+    out = jnp.pad(lo, pad + [(0, width_out - NLIMBS)])
+    out = out + _WRAP0 * _at(hi, 0) + _WRAP1 * _at(hi, 1) + _WRAP2 * _at(hi, 2)
+    return out
+
+
+def mul(a, b):
+    """Field multiply: 39-coefficient limb convolution, in-place wide
+    carry, then two fold-and-carry rounds through the 2^260 wrap.
+
+    Bounds: conv coefficients < 1.84e9 (reduced-form inputs). Two wide
+    passes over width 41 (p^2 < 2^512 < 2^13*41) shrink them below 8225.
+    Fold A lands positions 20..40 into a width-23 array with |coeff|
+    < 8225 * (7440 + 1 + 1024) + 8225 < 7e7; two more wide passes over
+    width 25 (the folded value is < 2^313) shrink again, and fold B
+    (positions 20..24, no spill past limb 6) leaves |limb| < 7e7 for the
+    final 3-pass carry into reduced form."""
+    bt = jnp.take(b, TOEP_IDX, axis=-1) * TOEP_MSK  # (..., 39, 20)
+    c39 = jnp.einsum(
+        "...i,...ki->...k", a, bt, preferred_element_type=jnp.int32
+    )
+    pad = [(0, 0)] * (c39.ndim - 1)
+    x = jnp.pad(c39, pad + [(0, 2)])  # width 41
+    x = _wide_pass(_wide_pass(x))
+    x = _fold_top(x, 25)  # width 25 ( > 2^313 capacity)
+    x = _wide_pass(_wide_pass(x))
+    x = _fold_top(x, NLIMBS)  # positions 20..24 -> limbs 0..6
+    return carry(x)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def sqn(a, n: int):
+    """n successive squarings; uses fori_loop so the trace stays small."""
+    if n <= 4:
+        for _ in range(n):
+            a = sq(a)
+        return a
+    return lax.fori_loop(0, n, lambda _, v: sq(v), a)
+
+
+def mul_small(a, c: int):
+    """Multiply by a small constant (|c| * 15632 must fit int32 headroom)."""
+    return carry(a * c)
+
+
+def invert(z):
+    """z^(p-2) (host-side utility / completeness; the verify kernel itself
+    compares projectively and never inverts). p - 2 =
+    2^256 - 2^32 - 979; chain: build z^(2^223-1) like the standard
+    libsecp256k1 ladder, then stitch the sparse low word."""
+    # p - 2 = 0xFFFF...FFFE FFFFFC2D: 223 ones, then bits of 0xFFFFFC2D
+    x1 = z
+    x2 = mul(sqn(x1, 1), x1)  # 2 ones
+    x3 = mul(sqn(x2, 1), x1)  # 3 ones
+    x6 = mul(sqn(x3, 3), x3)
+    x9 = mul(sqn(x6, 3), x3)
+    x11 = mul(sqn(x9, 2), x2)
+    x22 = mul(sqn(x11, 11), x11)
+    x44 = mul(sqn(x22, 22), x22)
+    x88 = mul(sqn(x44, 44), x44)
+    x176 = mul(sqn(x88, 88), x88)
+    x220 = mul(sqn(x176, 44), x44)
+    x223 = mul(sqn(x220, 3), x3)
+    # tail: (x223 << 23) | 0x2D... follow the exponent bits of 0xFFFFFC2D
+    t = sqn(x223, 23)
+    t = mul(t, x22)  # low 23 bits of p-2 are 0b111_1100_0010_1101 padded:
+    t = sqn(t, 5)  # 0xFFFFFC2D = ...111111111111111111111100_00101101
+    t = mul(t, x1)
+    t = sqn(t, 3)
+    t = mul(t, x2)
+    t = sqn(t, 2)
+    return mul(t, x1)
+
+
+def _fold256(x):
+    """Fold bits >= 2^256 down (2^256 ≡ 2^32 + 977): sequential carry
+    chain, extract q = bits >= 256 from limb 19 (bit 9 up), re-add
+    q*977 at limb 0 and q*64 at limb 2, re-chain. Requires a nonnegative
+    value < ~2^262; output limbs in [0, 2^13), value < 2^256 + q*2^33."""
+    parts = [x[..., i] for i in range(NLIMBS)]
+    out = []
+    c = jnp.zeros_like(parts[0])
+    for i in range(NLIMBS):
+        t = parts[i] + c
+        c = t >> RADIX
+        out.append(t & MASK)
+    top = out[NLIMBS - 1] + (c << RADIX)  # exact bits 247.. of the value
+    q = top >> 9  # bits >= 2^256
+    out[NLIMBS - 1] = top & 0x1FF
+    out[0] = out[0] + q * _FOLD0
+    out[2] = out[2] + q * _FOLD2
+    res = []
+    c = jnp.zeros_like(out[0])
+    for i in range(NLIMBS):
+        t = out[i] + c
+        c = t >> RADIX
+        res.append(t & MASK)
+    res[NLIMBS - 1] = res[NLIMBS - 1] + (c << RADIX)  # c is 0 by bounds
+    return jnp.stack(res, axis=-1)
+
+
+def _cond_sub(x, const_limbs):
+    """x - const if x >= const else x (both nonneg canonical-ish limbs)."""
+    d = x - const_limbs
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        t = d[..., i] + c
+        c = t >> RADIX
+        out.append(t & MASK)
+    t = jnp.stack(out, axis=-1)
+    keep = (c < 0)[..., None]  # borrow out -> x < const
+    return jnp.where(keep, x, t)
+
+
+def canon(x):
+    """Fully canonicalize: reduced form -> limbs in [0, 2^13), value in
+    [0, p). The +16p makes the value strictly positive (reduced values
+    are > -2^253; 16p > 2^259.9) without leaving the 20-limb range
+    (16p + |value| < 2^262, within _fold256's domain)."""
+    x = carry(x)
+    x = x + P16_LIMBS
+    x = _fold256(x)
+    x = _fold256(x)  # value now < 2^256 + eps < 2p
+    x = _cond_sub(x, P_LIMBS)
+    x = _cond_sub(x, P_LIMBS)
+    return x
+
+
+def is_zero(x):
+    """(...,) bool: value ≡ 0 (mod p)."""
+    return jnp.all(canon(x) == 0, axis=-1)
+
+
+def eq(a, b):
+    return is_zero(a - b)
+
+
+def parity(x):
+    """Canonical low bit (the SEC1 compressed-point sign bit)."""
+    return canon(x)[..., 0] & 1

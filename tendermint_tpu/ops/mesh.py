@@ -82,6 +82,11 @@ except ImportError:  # pragma: no cover — standalone file load (crypto-less
 # packing layer stays importable without the device stack; backend asserts
 # they agree at prepare_superbatch time)
 _BUCKETS = (128, 1024, 10240)
+# smallest lane bucket an operator may force via TM_TPU_MESH_LANE_BUCKET:
+# the secp256k1 ladder's fine bucket floor (backend.SECP_BUCKETS) — its
+# per-row kernel cost makes small lanes worthwhile, and the ed25519
+# kernel handles any shape the packer emits
+_LANE_BUCKET_FLOOR = 16
 
 
 def lanes_from_env() -> int:
@@ -110,7 +115,7 @@ def lane_cap() -> int:
     env = os.environ.get("TM_TPU_MESH_LANE_BUCKET")
     if env:
         try:
-            return min(max(int(env), _BUCKETS[0]), _BUCKETS[-1])
+            return min(max(int(env), _LANE_BUCKET_FLOOR), _BUCKETS[-1])
         except ValueError:
             pass
     return _BUCKETS[-1]
@@ -138,13 +143,16 @@ def _pow2_floor(n: int) -> int:
 
 
 class Lane:
-    """One shard's worth of packed jobs: single epoch key, whole jobs,
-    live rows <= the plan's lane_bucket."""
+    """One shard's worth of packed jobs: single epoch key, single
+    signature scheme (ISSUE 19 — a mixed-scheme commit's ed25519 and
+    secp256k1 halves land in DIFFERENT lanes of the same superbatch),
+    whole jobs, live rows <= the plan's lane_bucket."""
 
-    __slots__ = ("key", "jobs", "n")
+    __slots__ = ("key", "scheme", "jobs", "n")
 
-    def __init__(self, key: Optional[bytes]):
+    def __init__(self, key: Optional[bytes], scheme: str = "ed25519"):
         self.key = key
+        self.scheme = scheme
         self.jobs: List = []  # objects with an `.entries` EntryBlock
         self.n = 0
 
@@ -165,8 +173,8 @@ class MeshPlan:
                  lane_bucket: Optional[int] = None):
         self.lanes = lanes
         self.empty_jobs: List = []
-        self.lane_bucket = lane_bucket or _bucket_for(
-            max((l.n for l in lanes), default=1)
+        self.lane_bucket = lane_bucket or min(
+            _bucket_for(max((l.n for l in lanes), default=1)), lane_cap()
         )
         # power-of-two lane count keeps the compiled-shape set small:
         # {1,2,4,...} x the bucket ladder — a non-pow2 TM_TPU_MESH is
@@ -205,6 +213,15 @@ class MeshPlan:
         if len(keys) == 1:
             return next(iter(keys))
         return None
+
+    def schemes(self) -> List[str]:
+        """The plan's signature schemes in superblock segment order
+        (ed25519 first — its pure-pad filler lanes extend the first
+        segment)."""
+        found = {l.scheme for l in self.lanes}
+        return [s for s in ("ed25519", "secp256k1")
+                if s in found or (s == "ed25519" and not found)] + sorted(
+                    s for s in found if s not in ("ed25519", "secp256k1"))
 
 
 def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
@@ -245,14 +262,18 @@ def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
             empty.append(job)
             continue
         key = job.entries.epoch_key
+        scheme = getattr(job.entries, "scheme", "ed25519")
 
-        def _fits(l, n=n, key=key):
+        def _fits(l, n=n, key=key, scheme=scheme):
             # bucket-aware fit (the classic coalescer's peel rule, as a
             # pack-time predicate): fusing must not push the lane into a
             # BIGGER ladder bucket unless the fused total nearly fills
             # it — e.g. two 600-sig jobs stay separate 1024-bucket lanes
-            # instead of one 1200-live lane quantized to 10240 rows
-            if l.key != key or l.n + n > cap:
+            # instead of one 1200-live lane quantized to 10240 rows.
+            # Scheme-keyed (ISSUE 19): a lane holds ONE scheme — the
+            # superblock concatenates per-scheme sub-blocks and the
+            # launch runs each scheme's kernel over its own row range.
+            if l.key != key or l.scheme != scheme or l.n + n > cap:
                 return False
             b = _bucket_for(l.n + n)
             if b == _bucket_for(l.n):
@@ -262,7 +283,7 @@ def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
         lane = next((l for l in lanes if _fits(l)), None)
         if lane is None:
             if len(lanes) < max_lanes:
-                lane = Lane(key)
+                lane = Lane(key, scheme)
                 lanes.append(lane)
             else:
                 held.append(job)
@@ -273,16 +294,49 @@ def pack_jobs(jobs, max_lanes: int, cap: Optional[int] = None,
     return plan, held
 
 
-def pad_block(n: int, ep=None) -> EntryBlock:
-    """`n` identity padding rows as an EntryBlock: A = R = the identity
+import functools as _functools
+import hashlib as _hashlib
+
+
+@_functools.lru_cache(maxsize=1)
+def _secp_pad_row() -> Tuple[bytes, bytes]:
+    """The secp256k1 padding lane's (pub33, sig64): a REAL lower-S ECDSA
+    signature of the empty message under the generator as pubkey (d = 1,
+    nonce k = 1 ⇒ r = Gx mod n, s = ±(e + r) mod n), so pad rows ride
+    the normal prep/kernel path and verify deterministically True —
+    exactly ed25519's identity-pad convention, no special-casing
+    anywhere downstream. Self-contained integer math (standalone file
+    loads must not need the crypto package)."""
+    n_ord = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+    e = int.from_bytes(_hashlib.sha256(b"").digest(), "big") % n_ord
+    r = gx % n_ord
+    s = (e + r) % n_ord
+    if s > n_ord // 2:
+        s = n_ord - s
+    pub = bytes([2]) + gx.to_bytes(32, "big")  # compress(G); Gy is even
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return pub, sig
+
+
+def pad_block(n: int, ep=None, scheme: str = "ed25519") -> EntryBlock:
+    """`n` padding rows as an EntryBlock. ed25519: A = R = the identity
     encoding (y = 1), s = 0, empty message — verifies trivially under
     any challenge scalar (the `_pack_rows` padding-lane construction).
-    With a warm epoch entry `ep`, rows carry the table's identity-row
-    gather index (vp - 1) and the epoch key, so a cached superbatch's
-    padding gathers the table's own identity row."""
+    secp256k1: the fixed trivially-valid generator signature
+    (_secp_pad_row). With a warm epoch entry `ep`, rows carry the
+    table's pad-row gather index (vp - 1) and the epoch key, so a cached
+    superbatch's padding gathers the table's own pad row."""
     pub = np.zeros((n, 32), dtype=np.uint8)
     sig = np.zeros((n, 64), dtype=np.uint8)
-    if n:
+    pub_aux = None
+    if scheme == "secp256k1":
+        pad_pub, pad_sig = _secp_pad_row()
+        pub_aux = np.full((n,), pad_pub[0], dtype=np.uint8)
+        if n:
+            pub[:] = np.frombuffer(pad_pub[1:], dtype=np.uint8)
+            sig[:] = np.frombuffer(pad_sig, dtype=np.uint8)
+    elif n:
         pub[:, 0] = 1
         sig[:, 0] = 1  # R = identity encoding; s stays 0
     offsets = np.zeros(n + 1, dtype=np.int64)
@@ -291,7 +345,8 @@ def pad_block(n: int, ep=None) -> EntryBlock:
         val_idx = np.full((n,), ep.vp - 1, dtype=np.int32)
         epoch_key = ep.key
     return EntryBlock(pub, sig, b"", offsets,
-                      val_idx=val_idx, epoch_key=epoch_key)
+                      val_idx=val_idx, epoch_key=epoch_key,
+                      scheme=scheme, pub_aux=pub_aux)
 
 
 def _warm_entry(plan: MeshPlan):
@@ -313,34 +368,77 @@ def _warm_entry(plan: MeshPlan):
     return _epoch.lookup(_Probe())
 
 
-def build_superblock(plan: MeshPlan) -> Tuple[EntryBlock, List[Tuple]]:
-    """Materialize the plan: one EntryBlock of exactly `plan.bucket`
-    rows (live jobs + per-lane identity padding + pure-pad lanes) and
-    the global demux spans [(job, row_offset, n), ...]. Column concat is
-    one np.concatenate per column — no per-signature Python."""
+class SchemeSuperBlock:
+    """A mixed-scheme superbatch (ISSUE 19): EntryBlock.concat refuses
+    cross-scheme merges, so the superblock holds one contiguous
+    EntryBlock SEGMENT per scheme plus its global row offset. Demux
+    spans index the fused verdict row exactly as for a plain superblock;
+    prepare_superbatch preps each segment with its scheme's kernel and
+    the launch fn concatenates the per-segment verdicts — ONE dispatch
+    for the whole mixed commit."""
+
+    __slots__ = ("parts", "_n")
+
+    def __init__(self, parts: List[Tuple], n: int):
+        self.parts = parts  # [(scheme, EntryBlock, row_offset), ...]
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def epoch_key(self):  # mixed segments never share one epoch table
+        return None
+
+
+def build_superblock(plan: MeshPlan) -> Tuple[object, List[Tuple]]:
+    """Materialize the plan: exactly `plan.bucket` rows (live jobs +
+    per-lane padding + pure-pad lanes) and the global demux spans
+    [(job, row_offset, n), ...]. Column concat is one np.concatenate per
+    column — no per-signature Python. Single-scheme plans return one
+    EntryBlock; mixed-scheme plans return a SchemeSuperBlock whose
+    segments group each scheme's lanes contiguously (pure-pad filler
+    lanes extend the FIRST scheme's segment)."""
     ep = _warm_entry(plan)
     lb = plan.lane_bucket
-    pieces: List[EntryBlock] = []
+    order = plan.schemes()
+    # emit lanes grouped by scheme; filler pad lanes ride with the first
+    # scheme's segment so every segment stays contiguous
+    seq: List[Tuple] = []
+    for s in order:
+        seq.extend((l, s) for l in plan.lanes if l.scheme == s)
+        if s == order[0]:
+            seq.extend(
+                (None, s) for _ in range(plan.n_lanes - len(plan.lanes))
+            )
+    pieces: dict = {s: [] for s in order}
     spans: List[Tuple] = []
-    for li in range(plan.n_lanes):
-        base = li * lb
-        if li < len(plan.lanes):
-            lane = plan.lanes[li]
+    for pos, (lane, s) in enumerate(seq):
+        base = pos * lb
+        if lane is not None:
             off = 0
             for job in lane.jobs:
                 n = len(job.entries)
                 spans.append((job, base + off, n))
                 if n:
-                    pieces.append(job.entries)
+                    pieces[s].append(job.entries)
                 off += n
             if off < lb:
-                pieces.append(pad_block(lb - off, ep))
+                pieces[s].append(pad_block(lb - off, ep, s))
         else:
-            # pure identity-padding lane (lane count rounded up to pow2)
-            pieces.append(pad_block(lb, ep))
+            # pure padding lane (lane count rounded up to pow2)
+            pieces[s].append(pad_block(lb, ep, s))
     for job in plan.empty_jobs:
         spans.append((job, 0, 0))
-    return EntryBlock.concat(pieces), spans
+    if len(order) == 1:
+        return EntryBlock.concat(pieces[order[0]]), spans
+    parts: List[Tuple] = []
+    off = 0
+    for s in order:
+        blk = EntryBlock.concat(pieces[s])
+        parts.append((s, blk, off))
+        off += len(blk)
+    return SchemeSuperBlock(parts, off), spans
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +447,62 @@ def build_superblock(plan: MeshPlan) -> Tuple[EntryBlock, List[Tuple]]:
 # dispatch-owner thread (which also owns the transfer and any lazy
 # epoch-table upload inside the cached closures).
 # ---------------------------------------------------------------------------
+
+
+def _prepare_mixed_superbatch(sb: SchemeSuperBlock, donate: bool,
+                              bucket: int):
+    """Prep a mixed-scheme superbatch: each scheme segment gets its own
+    kernel + args, fused behind ONE launch fn that slices the flat arg
+    tuple back per segment and concatenates the verdict rows in segment
+    order — a single dispatch event for the whole commit. Per-segment
+    epoch entries still engage the cached gather prep when a segment
+    shares one warm key. XLA per-sig kernels only: the mixed face never
+    routes through pallas or shard_map (follow-up, ROADMAP 3a)."""
+    from . import backend as _backend
+    from . import ed25519_verify as _kernel
+    from . import epoch_cache as _epoch
+
+    seg_fns: List[Tuple] = []
+    flat_args: List = []
+    for scheme, blk, _off in sb.parts:
+        n = len(blk)
+        ep = _epoch.lookup(blk)
+        if scheme == "secp256k1":
+            if ep is not None:
+                args = _backend.prepare_batch_secp_cached(blk, n, ep)
+                fn = _backend.secp_cached_kernel(ep, donate)
+            else:
+                args = _backend.prepare_batch_secp(blk, n)
+                fn = _backend.secp_kernel(donate)
+        else:
+            device_hash = (
+                not _backend.HOST_HASH
+                and _backend._max_msg_len(blk) <= _backend.DEVICE_HASH_MAX_MSG
+            )
+            if ep is not None:
+                if device_hash:
+                    args = _backend.prepare_batch_cached_device_hash(
+                        blk, n, ep
+                    )
+                else:
+                    args = _backend.prepare_batch_cached(blk, n, ep)
+                fn = _backend.cached_kernel(ep, device_hash, donate)
+            elif device_hash:
+                args = _backend.prepare_batch_device_hash(blk, n)
+                fn = _kernel.jitted_verify_device_hash(donate)
+            else:
+                args = _backend.prepare_batch(blk, n)
+                fn = _kernel.jitted_verify(donate)
+        seg_fns.append((fn, len(flat_args), len(flat_args) + len(args)))
+        flat_args.extend(args)
+
+    def _launch(*flat):
+        import jax.numpy as jnp
+
+        outs = [fn(*flat[lo:hi]) for fn, lo, hi in seg_fns]
+        return jnp.concatenate(outs)
+
+    return _launch, tuple(flat_args), None, bucket, None
 
 
 def prepare_superbatch(block: EntryBlock, plan: MeshPlan):
@@ -379,7 +533,20 @@ def prepare_superbatch(block: EntryBlock, plan: MeshPlan):
             f"superblock is {len(block)} rows, plan says {bucket}"
         )
     donate = _backend.donate_enabled()
+    if isinstance(block, SchemeSuperBlock):
+        return _prepare_mixed_superbatch(block, donate, bucket)
     ep = _warm_entry(plan) if block.epoch_key is not None else None
+    if getattr(block, "scheme", "ed25519") == "secp256k1":
+        # secp lane-group: the Strauss+GLV kernel (ops/secp_verify).
+        # Plain jit only — no pallas/shard_map face yet (ROADMAP 3a);
+        # the single-device XLA kernel still fuses all lanes into one
+        # launch, which is what the mesh demux contract needs.
+        if ep is not None and ep.scheme == "secp256k1":
+            args = _backend.prepare_batch_secp_cached(block, bucket, ep)
+            return (_backend.secp_cached_kernel(ep, donate), args, None,
+                    bucket, None)
+        args = _backend.prepare_batch_secp(block, bucket)
+        return _backend.secp_kernel(donate), args, None, bucket, None
     use_mesh = plan.n_lanes > 1 and _sharded.mesh_ready(plan.n_lanes)
     if _backend._use_pallas():
         import jax

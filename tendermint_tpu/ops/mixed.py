@@ -1,10 +1,15 @@
 """Mixed-curve batch verification (BASELINE config #4).
 
 Reference parity: crypto/batch/batch.go:11-33 — batch verifiers exist for
-ed25519 and sr25519; secp256k1 never batches (batch.go:26-33). Here the
-two batchable curves each get a DEVICE lane (ops.pallas_verify /
-ops.pallas_sr25519) and secp256k1 falls back to per-signature host
-verification (OpenSSL ECDSA), mirroring the reference's split.
+ed25519 and sr25519; secp256k1 never batches (batch.go:26-33). Here every
+curve gets a DEVICE lane: ed25519 and sr25519 as before
+(ops.pallas_verify / ops.pallas_sr25519), and since ISSUE 19 secp256k1
+batches through the Strauss+GLV ECDSA kernel (ops.secp_verify) — the
+reference's "no secp batching" is a verifier-interface fact, not a
+verdict change, so the device lane stays bit-identical to per-signature
+verification. The per-signature host loop survives as the
+small-batch / TM_TPU_SECP_DEVICE=0 fallback, thread-pooled because each
+OpenSSL ECDSA_verify releases the GIL.
 
 verify_mixed() partitions one heterogeneous batch by key type, dispatches
 all lanes, and reassembles per-signature verdicts in input order.
@@ -27,6 +32,62 @@ from . import backend as _backend
 # (pure-Python, ~10 ms/sig) host path only for very small counts; the
 # device wins early because host schnorr math is so slow.
 SR_DEVICE_THRESHOLD = int(os.environ.get("TM_TPU_SR_DEVICE_THRESHOLD", "8"))
+
+# secp256k1 scheme lane (ISSUE 19): below this many signatures the
+# device round-trip loses to the host's native ECDSA_verify loop
+SECP_DEVICE_THRESHOLD = int(
+    os.environ.get("TM_TPU_SECP_DEVICE_THRESHOLD", "8")
+)
+# host-fallback pool: ECDSA_verify releases the GIL, so the per-sig loop
+# threads near-linearly; small batches stay single-threaded (pool spawn
+# costs more than it saves)
+SECP_HOST_POOL_MIN = int(os.environ.get("TM_TPU_SECP_HOST_POOL_MIN", "32"))
+
+
+def _secp_device_enabled() -> bool:
+    return os.environ.get("TM_TPU_SECP_DEVICE", "1") == "1"
+
+
+def _secp_host_workers() -> int:
+    w = os.environ.get("TM_TPU_SECP_HOST_WORKERS")
+    if w is not None:
+        return max(1, int(w))
+    return max(1, min(8, (os.cpu_count() or 1)))
+
+
+def _host_secp_batch(lane: Sequence[Tuple[PubKey, bytes, bytes]]) -> np.ndarray:
+    """Per-signature host verification, thread-pooled (satellite of
+    ISSUE 19): each native ECDSA_verify drops the GIL so N workers give
+    ~N×; under TM_TPU_PUREPY_CRYPTO the math is pure Python and the pool
+    is skipped (threads would just interleave GIL-held bignum ops)."""
+    n = len(lane)
+    workers = _secp_host_workers()
+    if n < SECP_HOST_POOL_MIN or workers < 2 or _secp.is_pure_python():
+        return np.array(
+            [pk.verify_signature(m, s) for pk, m, s in lane], dtype=bool
+        )
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return np.fromiter(
+            pool.map(
+                lambda e: e[0].verify_signature(e[1], e[2]),
+                lane,
+                chunksize=max(1, n // (workers * 4)),
+            ),
+            dtype=bool,
+            count=n,
+        )
+
+
+def _verify_secp_batch(lane: Sequence[Tuple[PubKey, bytes, bytes]]) -> np.ndarray:
+    """The secp lane: batched device kernel when enabled and worth the
+    round-trip, the (pooled) host loop otherwise. Device and host agree
+    bit-for-bit on verdicts (tests/test_secp_lane.py pins this)."""
+    if len(lane) >= SECP_DEVICE_THRESHOLD and _secp_device_enabled():
+        entries_b = [(pk.bytes(), m, s) for pk, m, s in lane]
+        return np.array(_backend.verify_batch_secp(entries_b), dtype=bool)
+    return _host_secp_batch(lane)
 
 
 # First device call (the Mosaic compile) is time-boxed: a pathologically
@@ -118,13 +179,16 @@ def verify_mixed(
         lanes[kind].append((pk, msg, sig))
 
     # Lanes run CONCURRENTLY: the ed25519 batch rides the shared async
-    # pipeline (a future), the sr25519 device batch dispatches on a helper
-    # thread, and the secp256k1 host loop fills the main thread while the
-    # device works — the mixed batch costs max(lanes), not sum(lanes).
+    # pipeline (a future), the sr25519 and secp256k1 device batches
+    # dispatch on helper threads, and any host loops fill the main
+    # thread while the device works — the mixed batch costs max(lanes),
+    # not sum(lanes).
     results = {}
     ed_future = None
     sr_thread = None
     sr_holder: dict = {}
+    secp_thread = None
+    secp_holder: dict = {}
     if lanes["ed25519"]:
         ed_entries = [(pk.bytes(), m, s) for pk, m, s in lanes["ed25519"]]
         if len(ed_entries) <= _backend.BUCKETS[-1]:
@@ -147,10 +211,18 @@ def verify_mixed(
         sr_thread = threading.Thread(target=_sr_run, daemon=True)
         sr_thread.start()
     if lanes["secp256k1"]:
-        results["secp256k1"] = np.asarray(
-            [pk.verify_signature(m, s) for pk, m, s in lanes["secp256k1"]],
-            dtype=bool,
-        )
+        import threading
+
+        secp_lane = lanes["secp256k1"]
+
+        def _secp_run():
+            try:
+                secp_holder["res"] = _verify_secp_batch(secp_lane)
+            except Exception as e:  # noqa: BLE001
+                secp_holder["err"] = e
+
+        secp_thread = threading.Thread(target=_secp_run, daemon=True)
+        secp_thread.start()
     if lanes["other"]:
         results["other"] = np.asarray(
             [pk.verify_signature(m, s) for pk, m, s in lanes["other"]],
@@ -165,6 +237,13 @@ def verify_mixed(
         if "err" in sr_holder:
             raise sr_holder["err"]
         results["sr25519"] = sr_holder["res"]
+    if secp_thread is not None:
+        secp_thread.join(timeout=600)
+        if secp_thread.is_alive():
+            raise TimeoutError("secp256k1 lane did not finish in 600s")
+        if "err" in secp_holder:
+            raise secp_holder["err"]
+        results["secp256k1"] = secp_holder["res"]
     return [bool(results[kind][j]) for kind, j in order]
 
 
@@ -186,5 +265,32 @@ class Sr25519DeviceBatchVerifier:
         if not self._entries:
             return False, []
         res = _verify_sr25519_batch(self._entries)
+        valid = [bool(v) for v in res]
+        return all(valid), valid
+
+
+class Secp256k1DeviceBatchVerifier:
+    """crypto.BatchVerifier shape over the secp256k1 scheme lane.
+
+    NOT returned by crypto/batch.create_batch_verifier — that stays None
+    for reference parity (batch.go:26-33), and _verify_commit_batch's
+    ed25519-shaped add_block path must never see 33-byte keys. Callers
+    that want batched secp opt in explicitly (ops.mixed, bench, tests);
+    commits route through prepare_commit_batch / the mesh instead."""
+
+    def __init__(self):
+        self._entries: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, key, msg: bytes, sig: bytes) -> None:
+        if key.type() != _secp.KEY_TYPE:
+            raise TypeError("pubkey is not secp256k1")
+        if len(sig) != _secp.SIGNATURE_LENGTH:
+            raise ValueError("invalid signature length")
+        self._entries.append((key, msg, sig))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._entries:
+            return False, []
+        res = _verify_secp_batch(self._entries)
         valid = [bool(v) for v in res]
         return all(valid), valid

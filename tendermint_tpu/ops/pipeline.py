@@ -510,6 +510,25 @@ class AsyncBatchVerifier:
         # XLA recycles the pages; epoch tables stay exempt in every
         # kernel's donate_argnums
         donate = _backend.donate_enabled()
+        if getattr(entries, "scheme", "ed25519") == "secp256k1":
+            # scheme lane (ISSUE 19): the Strauss+GLV ECDSA kernel.
+            # Plain XLA jit only — no pallas/RLC face for secp yet
+            # (ROADMAP 3a); `ep` is already scheme-guarded by
+            # epoch_cache.lookup so a warm secp committee gathers its
+            # decompressed affine Q columns on device.
+            bucket = _backend._secp_bucket_for(len(entries))
+            with _span("pipeline.prep", n=len(entries), bucket=bucket,
+                       cached=int(ep is not None), scheme="secp256k1"):
+                if ep is not None:
+                    args = _backend.prepare_batch_secp_cached(
+                        entries, bucket, ep
+                    )
+                    kern = _backend.secp_cached_kernel(ep, donate)
+                else:
+                    args = _backend.prepare_batch_secp(entries, bucket)
+                    kern = _backend.secp_kernel(donate)
+            _backend._note_device_batch(len(entries), bucket)
+            return kern, args, None, bucket
         if _backend._use_pallas():
             import jax
 
@@ -602,7 +621,8 @@ class AsyncBatchVerifier:
         see the identity rows the packer added."""
         with _span("pipeline.prep", n=plan.live, bucket=plan.bucket,
                    lanes=plan.n_lanes,
-                   cached=int(block.epoch_key is not None)):
+                   cached=int(getattr(block, "epoch_key", None) is not None),
+                   schemes=len(plan.schemes())):
             res = _mesh.prepare_superbatch(block, plan)
         # prep timing histograms are recorded inside prepare_batch*; the
         # dispatch counters note the LIVE rows against the full bucket
@@ -723,6 +743,10 @@ class AsyncBatchVerifier:
                 # differing-key job is held for the NEXT batch, exactly
                 # like a bucket-overflow job.
                 key0 = job.entries.epoch_key
+                # scheme gate (ISSUE 19): cross-scheme concat RAISES in
+                # EntryBlock.concat (rows would hit the wrong kernel) —
+                # a differing-scheme job is held like a differing key
+                scheme0 = getattr(job.entries, "scheme", "ed25519")
                 # coalescing window: while the device pipeline is busy a
                 # short linger costs nothing (the dispatch would queue
                 # anyway) and fuses straggler jobs into bigger batches —
@@ -750,6 +774,8 @@ class AsyncBatchVerifier:
                     if (
                         total + len(nxt.entries) > limit
                         or nxt.entries.epoch_key != key0
+                        or getattr(nxt.entries, "scheme", "ed25519")
+                        != scheme0
                     ):
                         hold = nxt
                         break
@@ -1354,6 +1380,8 @@ def commit_entries_legacy(
     idx_arr = np.asarray(idxs, dtype=np.int32)
     cols = vals.ed25519_columns()
     epoch_key = None
+    scheme = "ed25519"
+    pub_aux = None
     if cols is not None:
         # columnar valset, non-columnar commit: gather the cached pub
         # rows instead of re-joining pub_key.bytes() per commit (the
@@ -1362,6 +1390,17 @@ def commit_entries_legacy(
         pub = cols[0][idx_arr]
         from . import epoch_cache as _epoch
 
+        epoch_key = _epoch.note_valset(vals)
+    elif (scols := vals.secp256k1_columns()) is not None:
+        # all-secp256k1 committee (ISSUE 19): gather the 33-byte SEC1
+        # rows and route the block through the scheme lane — the prefix
+        # column splits off so downstream columns stay 32-wide
+        raw = scols[0][idx_arr]
+        from . import epoch_cache as _epoch
+
+        pub_aux = np.ascontiguousarray(raw[:, 0])
+        pub = np.ascontiguousarray(raw[:, 1:])
+        scheme = "secp256k1"
         epoch_key = _epoch.note_valset(vals)
     else:
         pub_b = b"".join(vals.validators[i].pub_key.bytes() for i in idxs)
@@ -1375,7 +1414,8 @@ def commit_entries_legacy(
         b"".join(sigs[i].signature for i in idxs), dtype=np.uint8
     ).reshape(n, 64)
     return EntryBlock(pub, sig, buf, offsets,
-                      val_idx=idx_arr, epoch_key=epoch_key), tallied
+                      val_idx=idx_arr, epoch_key=epoch_key,
+                      scheme=scheme, pub_aux=pub_aux), tallied
 
 
 def verify_commits_pipelined(
@@ -1431,6 +1471,12 @@ def verify_commits_pipelined(
         except (ValueError, RuntimeError) as e:
             errors[i] = str(e)
             continue
+        # scheme gate (ISSUE 19): a batch concats only same-scheme
+        # blocks (EntryBlock.concat raises across schemes) — flush the
+        # running batch before a job that switches scheme
+        scheme_i = getattr(entries, "scheme", "ed25519")
+        if cur and getattr(cur[0], "scheme", "ed25519") != scheme_i:
+            _flush()
         pos = 0
         while pos < len(entries):
             take = min(len(entries) - pos, max_b - cur_n)

@@ -1,0 +1,109 @@
+"""Scalar arithmetic mod n (the secp256k1 group order) + GLV decomposition.
+
+The ECDSA lane's scalar layer (ISSUE 19), mirroring `sc.py`'s place in the
+ed25519 stack — with one structural difference: ECDSA's scalar work
+(s^-1 mod n, u1 = e/s, u2 = r/s) is a handful of 256-bit bigint ops per
+signature and does NOT sit inside the device hot loop, so this module is
+host-side math: Python-int modular arithmetic (batched inversion via the
+Montgomery product trick), the GLV endomorphism split that halves the
+device ladder length, and the numpy packing that ships the split scalars
+to the kernel as 13-bit limb rows.
+
+GLV: secp256k1 has the efficient endomorphism phi(x, y) = (beta*x, y) =
+[lambda]P (beta^3 = 1 mod p, lambda^3 = 1 mod n). Any scalar u splits as
+u = u_a + u_b*lambda (mod n) with |u_a|, |u_b| < 2^129, so the kernel's
+joint ladder runs 130 iterations over four ~half-width scalars instead of
+256 over two full-width ones. Constants are the standard lattice basis
+(libsecp256k1 scalar_impl.h); the lattice membership identities are
+asserted at import."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.secp256k1 import _N as N
+
+N_HALF = N // 2  # lower-S bound: valid signatures have s <= N_HALF
+
+# The endomorphism pair: beta (mod p) acts on x; lambda (mod n) acts on
+# the scalar. phi(P) = (beta*x, y) = [lambda]P for all P on the curve.
+LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+
+# Lattice basis vectors v1 = (A1, -B1), v2 = (A2, B2) of
+# {(x, y) : x + y*lambda ≡ 0 (mod n)} — libsecp256k1's g1/g2 basis.
+A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+B1 = 0xE4437ED6010E88286F547FA90ABFE4C3  # = -v1.y (stored positive)
+A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+B2 = A1
+
+# Lattice membership: both basis vectors must annihilate lambda mod n —
+# the entire split correctness rests on these two congruences.
+assert (A1 - B1 * LAMBDA) % N == 0
+assert (A2 + B2 * LAMBDA) % N == 0
+
+SCALAR_BITS = 130  # split magnitudes are < 2^129; one bit of headroom
+SCALAR_LIMBS = 10  # ceil(130 / 13)
+_RADIX = 13
+_MASK = (1 << _RADIX) - 1
+
+
+def glv_split(u: int) -> tuple[int, int]:
+    """u in [0, n) -> (k1, k2) SIGNED ints with u ≡ k1 + k2*lambda (mod n)
+    and |k1|, |k2| < 2^129 (round-to-nearest Babai on the basis above)."""
+    c1 = (B2 * u + (N >> 1)) // N
+    c2 = (B1 * u + (N >> 1)) // N
+    k1 = u - c1 * A1 - c2 * A2
+    k2 = c1 * B1 - c2 * B2
+    return k1, k2
+
+
+def glv_decompose(u: int) -> tuple[int, int, int, int]:
+    """u -> (|k1|, sign1, |k2|, sign2); signs are 0/1 (1 = negate the
+    base point on device)."""
+    k1, k2 = glv_split(u)
+    s1, s2 = int(k1 < 0), int(k2 < 0)
+    m1, m2 = abs(k1), abs(k2)
+    if m1 >> SCALAR_BITS or m2 >> SCALAR_BITS:  # pragma: no cover
+        raise AssertionError("GLV split exceeded 130 bits")
+    return m1, s1, m2, s2
+
+
+def inv_mod_n_many(vals: list[int]) -> list[int]:
+    """Batched modular inverses mod n (one pow + 3 mulmods per element via
+    the Montgomery product trick). Zero entries pass through as 0 — the
+    caller has already marked those rows invalid."""
+    idx = [i for i, v in enumerate(vals) if v]
+    out = [0] * len(vals)
+    if not idx:
+        return out
+    prefix = []
+    acc = 1
+    for i in idx:
+        prefix.append(acc)
+        acc = acc * vals[i] % N
+    inv = pow(acc, -1, N)
+    for j in reversed(range(len(idx))):
+        i = idx[j]
+        out[i] = prefix[j] * inv % N
+        inv = inv * vals[i] % N
+    return out
+
+
+def scalars_to_limbs(vals: list[int]) -> np.ndarray:
+    """Nonnegative ints < 2^130 -> (B, 10) int32 rows of 13-bit limbs
+    (LSB-first), the kernel's scalar wire format. Vectorized through a
+    24-byte-per-row LE buffer -> 3 uint64 words -> 10 shifted windows."""
+    if not vals:
+        return np.zeros((0, SCALAR_LIMBS), dtype=np.int32)
+    buf = b"".join(v.to_bytes(24, "little") for v in vals)
+    w = np.frombuffer(buf, dtype="<u8").reshape(len(vals), 3)
+    out = np.empty((len(vals), SCALAR_LIMBS), dtype=np.int32)
+    for i in range(SCALAR_LIMBS):
+        lo = _RADIX * i
+        word, shift = lo >> 6, lo & 63
+        v = w[:, word] >> np.uint64(shift)
+        if shift + _RADIX > 64 and word + 1 < 3:
+            v = v | (w[:, word + 1] << np.uint64(64 - shift))
+        out[:, i] = (v & np.uint64(_MASK)).astype(np.int32)
+    return out

@@ -1,0 +1,429 @@
+"""Batched secp256k1 ECDSA verification kernel (JAX/XLA, TPU-first).
+
+The scheme-diversity lane (ISSUE 19 / ROADMAP item 3a): plain ECDSA can't
+ride ed25519's randomized-linear-combination fusion (each signature hides
+an independent modular inversion), but each signature's point equation
+
+    R' = (e/s)·G + (r/s)·Q,   accept iff x(R') ≡ r (mod n)
+
+is embarrassingly parallel across batch lanes — exactly the shape of
+`ed25519_verify.verify_kernel`. Semantics are *per-signature* and match
+the host oracle `crypto.secp256k1.PubKey.verify_signature` bit-for-bit
+(including the reference's lower-S rejection, checked host-side like
+ed25519's s < L).
+
+Ladder shape: the host GLV-splits both scalars u1 = e/s and u2 = r/s
+through the secp256k1 endomorphism (sc_secp), so the device runs a joint
+4-scalar Strauss ladder — 130 iterations of (1 doubling + 1 add from a
+16-entry per-lane subset-sum table of {±G, ±φG, ±Q, ±φQ}) — instead of
+256 iterations over two full-width scalars. Point arithmetic uses the
+Renes–Costello–Batina *complete* a=0 formulas (EuroCrypt 2016, Algs 7/9,
+b3 = 3·7 = 21), so identity/equal/negated inputs need no branches and
+all-zero scalar rows (host-rejected lanes) simply walk to the identity.
+
+The final comparison is projective — x(R') ≡ r tests X ≡ r·Z without an
+inversion — with a second candidate column r+n covering the x mod n
+wraparound (possible because n < p < n + 2^129... strictly p - n < 2^129,
+so at most one extra candidate and the host precomputes both).
+
+Host-side prep (this module, `prepare_rows`): SHA-256 digests, one
+batched s^-1 mod n (Montgomery trick), GLV decomposition, and pubkey
+decompression (memoized; the epoch-cached path keeps decompressed Q
+columns device-resident instead — ops/epoch_cache.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import fe_secp as fe
+from . import sc_secp as sc
+from ..crypto import _weierstrass as wst
+
+N = sc.N
+N_HALF = sc.N_HALF
+P = fe.P
+
+SCALAR_BITS = sc.SCALAR_BITS  # 130: GLV halves, one headroom bit
+B3 = 21  # 3*b for y^2 = x^3 + 7 (the RCB formula constant)
+
+# Curve constants in limb form — NUMPY, not jnp (trace-immunity; see the
+# ed25519_verify constants note).
+GX_L = np.asarray(fe.limbs_from_int(wst.GX))
+GY_L = np.asarray(fe.limbs_from_int(wst.GY))
+NEG_GY_L = np.asarray(fe.limbs_from_int(P - wst.GY))
+PHI_GX_L = np.asarray(fe.limbs_from_int(sc.BETA * wst.GX % P))  # x(φG)
+BETA_L = np.asarray(fe.limbs_from_int(sc.BETA))
+ONE_L = np.asarray(fe.limbs_from_int(1))
+
+# The endomorphism must actually act as [λ]: φ(G) = (β·Gx, Gy) = λ·G.
+assert wst.scalar_mult(sc.LAMBDA, wst.G) == (sc.BETA * wst.GX % P, wst.GY)
+
+
+def point_add(p, q):
+    """Complete projective addition for y^2 = x^3 + b, a = 0 (RCB16
+    Algorithm 7, b3 = 21): 12 muls + 3 small-constant muls, valid for ALL
+    inputs including the identity (0, 1, 0) — no branches in the ladder."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = fe.mul(x1, x2)
+    t1 = fe.mul(y1, y2)
+    t2 = fe.mul(z1, z2)
+    t3 = fe.sub(fe.mul(fe.add(x1, y1), fe.add(x2, y2)), fe.add(t0, t1))
+    t4 = fe.sub(fe.mul(fe.add(y1, z1), fe.add(y2, z2)), fe.add(t1, t2))
+    t5 = fe.sub(fe.mul(fe.add(x1, z1), fe.add(x2, z2)), fe.add(t0, t2))
+    t0_3 = fe.mul_small(t0, 3)  # 3·X1X2
+    t2_b = fe.mul_small(t2, B3)  # 3b·Z1Z2
+    zs = fe.add(t1, t2_b)  # Y1Y2 + 3bZ1Z2
+    t1m = fe.sub(t1, t2_b)  # Y1Y2 - 3bZ1Z2
+    t5_b = fe.mul_small(t5, B3)  # 3b·(X1Z2 + X2Z1)
+    x3 = fe.sub(fe.mul(t3, t1m), fe.mul(t4, t5_b))
+    y3 = fe.add(fe.mul(t1m, zs), fe.mul(t5_b, t0_3))
+    z3 = fe.add(fe.mul(zs, t4), fe.mul(t0_3, t3))
+    return (x3, y3, z3)
+
+
+def point_double(p):
+    """Complete projective doubling, a = 0 (RCB16 Algorithm 9)."""
+    x, y, z = p
+    t0 = fe.sq(y)
+    y8 = fe.mul_small(t0, 8)  # 8Y^2
+    t2 = fe.mul_small(fe.sq(z), B3)  # 3bZ^2
+    x3 = fe.mul(t2, y8)  # 24bY^2Z^2
+    y3 = fe.add(t0, t2)  # Y^2 + 3bZ^2
+    z3 = fe.mul(fe.mul(y, z), y8)  # 8Y^3Z
+    t0m = fe.sub(t0, fe.mul_small(t2, 3))  # Y^2 - 9bZ^2
+    y3 = fe.add(x3, fe.mul(t0m, y3))
+    x3 = fe.mul_small(fe.mul(t0m, fe.mul(x, y)), 2)
+    return (x3, y3, z3)
+
+
+def _stack_points(points, axis=0):
+    """[(x,y,z), ...] -> one point whose coords carry a new stacked axis."""
+    return tuple(
+        jnp.stack([pt[c] for pt in points], axis=axis) for c in range(3)
+    )
+
+
+def _unstack_point(point, i):
+    return tuple(c[i] for c in point)
+
+
+def _select_point(table, idx):
+    """table: point with (..., 16, 20) coords; idx: (...,) in [0, 16)."""
+    out = []
+    for c in table:
+        picked = jnp.take_along_axis(c, idx[..., None, None], axis=-2)
+        out.append(picked[..., 0, :])
+    return tuple(out)
+
+
+def scalar_digits(scalars):
+    """(B, 4, 10) int32 13-bit scalar limbs -> (130, B) int32 joint table
+    indices: digit = b1 + 2·b2 + 4·b3 + 8·b4, transposed for the ladder."""
+    shifts = jnp.arange(fe.RADIX, dtype=scalars.dtype)
+    bits = (scalars[:, :, :, None] >> shifts) & 1  # (B, 4, 10, 13)
+    bits = bits.reshape(scalars.shape[0], 4, SCALAR_BITS)
+    return (
+        bits[:, 0] + 2 * bits[:, 1] + 4 * bits[:, 2] + 8 * bits[:, 3]
+    ).T
+
+
+def verify_kernel(qx, qy, scalars, signs, r1, r2, ok_host):
+    """Batched per-signature ECDSA verification.
+
+    Args (B = batch):
+      qx, qy:   (B, 20) int32 — affine pubkey Q limbs (host-decompressed,
+                canonical; rejected pubkeys carry G with ok_host False)
+      scalars:  (B, 4, 10) int32 — |k| limbs of the GLV halves, order
+                (u1_a, u1_b, u2_a, u2_b) for bases (G, φG, Q, φQ)
+      signs:    (B, 4) int32 — 1 = negate that base point
+      r1, r2:   (B, 20) int32 — the x-candidate limbs: r, and r+n when
+                r+n < p (else r again — a harmless duplicate)
+      ok_host:  (B,) bool — host-checked lengths/ranges/lower-S/decompress
+    Returns: (B,) bool.
+    """
+    # Broadcast constants derived from an input (x + 0*input) so they keep
+    # shard_map varying-manual-axes — same trick as ed25519_verify.
+    zero_b = qx - qx
+    one_b = ONE_L + zero_b
+    ident = (zero_b, one_b, zero_b)
+
+    gy_pos = GY_L + zero_b
+    gy_neg = NEG_GY_L + zero_b
+    qy_neg = fe.neg(qy)
+
+    def pick_y(col, pos, neg_):
+        return jnp.where((signs[:, col] == 1)[:, None], neg_, pos)
+
+    b1 = (GX_L + zero_b, pick_y(0, gy_pos, gy_neg), one_b)
+    b2 = (PHI_GX_L + zero_b, pick_y(1, gy_pos, gy_neg), one_b)
+    b3p = (qx, pick_y(2, qy, qy_neg), one_b)
+    b4 = (fe.mul(qx, BETA_L), pick_y(3, qy, qy_neg), one_b)
+
+    # 16-entry subset-sum table, idx = b1 + 2b2 + 4b3 + 8b4, built with
+    # three batched adds (3-lane + 1 + 7-lane) instead of 11 traces.
+    s12 = point_add(
+        _stack_points([b1, b3p, b3p]), _stack_points([b2, b1, b2])
+    )
+    t3 = _unstack_point(s12, 0)  # b1 + b2
+    t5 = _unstack_point(s12, 1)  # b3 + b1
+    t6 = _unstack_point(s12, 2)  # b3 + b2
+    t7 = point_add(t3, b3p)  # b1 + b2 + b3
+    low = [ident, b1, b2, t3, b3p, t5, t6, t7]
+    hi = point_add(_stack_points(low[1:]), _stack_points([b4] * 7))
+    entries = low + [b4] + [_unstack_point(hi, i) for i in range(7)]
+    table = _stack_points(entries, axis=-2)  # coords (..., 16, 20)
+
+    digits = scalar_digits(scalars)  # (130, B)
+
+    def body(i, acc):
+        d = lax.dynamic_index_in_dim(
+            digits, SCALAR_BITS - 1 - i, 0, keepdims=False
+        )
+        acc = point_double(acc)
+        return point_add(acc, _select_point(table, d))
+
+    x, y, z = lax.fori_loop(0, SCALAR_BITS, body, ident)
+
+    # Accept iff R' != O and x(R') ≡ r (mod n): projective compare against
+    # both candidates (X ≡ cand·Z), no field inversion on device.
+    nz = ~fe.is_zero(z)
+    ok_x = fe.is_zero(fe.sub(x, fe.mul(r1, z))) | fe.is_zero(
+        fe.sub(x, fe.mul(r2, z))
+    )
+    return ok_host & nz & ok_x
+
+
+def verify_kernel_cached(
+    qx_tbl, qy_tbl, q_ok_tbl, val_idx, scalars, signs, r1, r2, ok_host
+):
+    """verify_kernel with the committee's decompressed affine Q columns
+    gathered from a device-resident epoch table (ops/epoch_cache.py):
+    qx_tbl/qy_tbl (V, 20) int32, q_ok_tbl (V,) bool (False = the pubkey
+    didn't decompress; its row carries G), val_idx (B,) int32."""
+    qx = qx_tbl[val_idx]
+    qy = qy_tbl[val_idx]
+    ok = ok_host & q_ok_tbl[val_idx]
+    return verify_kernel(qx, qy, scalars, signs, r1, r2, ok)
+
+
+# -- host-side preparation ---------------------------------------------------
+
+
+@functools.lru_cache(maxsize=65536)
+def _decompress_memo(pub: bytes):
+    return wst.decompress(pub)
+
+
+def field_to_limbs(vals) -> np.ndarray:
+    """Canonical field ints (< 2^256) -> (B, 20) int32 rows of 13-bit
+    limbs, vectorized through a LE byte buffer like sc_secp.scalars_to_limbs."""
+    if not len(vals):
+        return np.zeros((0, fe.NLIMBS), dtype=np.int32)
+    buf = b"".join(int(v).to_bytes(32, "little") for v in vals)
+    w = np.frombuffer(buf, dtype="<u8").reshape(len(vals), 4)
+    out = np.empty((len(vals), fe.NLIMBS), dtype=np.int32)
+    for i in range(fe.NLIMBS):
+        lo = fe.RADIX * i
+        word, shift = lo >> 6, lo & 63
+        v = w[:, word] >> np.uint64(shift)
+        if shift + fe.RADIX > 64 and word + 1 < 4:
+            v = v | (w[:, word + 1] << np.uint64(64 - shift))
+        out[:, i] = (v & np.uint64(fe.MASK)).astype(np.int32)
+    return out
+
+
+def table_columns(pubs):
+    """Decompress a committee's 33-byte pubkeys into epoch-table columns:
+    (qx (V+1, 20) int32, qy, q_ok (V+1,) bool). Invalid pubkeys carry G
+    with q_ok False; row V is the padding lane (G, ok)."""
+    xs, ys, oks = [], [], []
+    for pub in pubs:
+        pt = _decompress_memo(bytes(pub)) if len(pub) == 33 else None
+        if pt is None:
+            xs.append(wst.GX)
+            ys.append(wst.GY)
+            oks.append(False)
+        else:
+            xs.append(pt[0])
+            ys.append(pt[1])
+            oks.append(True)
+    xs.append(wst.GX)
+    ys.append(wst.GY)
+    oks.append(True)
+    return (
+        field_to_limbs(xs),
+        field_to_limbs(ys),
+        np.array(oks, dtype=bool),
+    )
+
+
+# A padding lane is a trivially-true row: u1 = 1, u2 = 0, Q = G, x-cand =
+# Gx, so the ladder computes R' = G and the compare passes algebraically
+# (matching ed25519's identity-pad convention: pads never poison a batch
+# and their verdict is deterministic True).
+_PAD_SCALARS = np.zeros((4, sc.SCALAR_LIMBS), dtype=np.int32)
+_PAD_SCALARS[0, 0] = 1
+
+
+def _empty_rows(size: int):
+    qx = np.broadcast_to(GX_L, (size, fe.NLIMBS)).copy()
+    qy = np.broadcast_to(GY_L, (size, fe.NLIMBS)).copy()
+    scalars = np.broadcast_to(
+        _PAD_SCALARS, (size, 4, sc.SCALAR_LIMBS)
+    ).copy()
+    signs = np.zeros((size, 4), dtype=np.int32)
+    r1 = np.broadcast_to(GX_L, (size, fe.NLIMBS)).copy()
+    r2 = r1.copy()
+    ok = np.ones(size, dtype=bool)
+    return qx, qy, scalars, signs, r1, r2, ok
+
+
+def prepare_rows(items, size: int | None = None, with_tables: bool = False):
+    """Host prep for a batch of (pub33, msg, sig64) -> kernel arg arrays.
+
+    Rows [len(items):size] are trivial-accept padding lanes. Rejected rows
+    (bad length / range / non-lower-S / failed decompress) keep padding
+    numerics with ok_host False — the kernel's verdict gate.
+
+    with_tables=False (the default) returns the direct-kernel args
+    (qx, qy, scalars, signs, r1, r2, ok_host); with_tables=True returns
+    (val_idx, scalars, signs, r1, r2, ok_host, (qx, qy, q_ok)) where the
+    pubkey columns are deduplicated for the epoch-cached kernel.
+    """
+    n = len(items)
+    size = n if size is None else size
+    qx, qy, scalars, signs, r1, r2, ok = _empty_rows(size)
+
+    pend = []  # (row, r, e, q) awaiting the batched inversion
+    svals = []
+    for i, (pub, msg, sig) in enumerate(items):
+        ok[i] = False
+        if len(sig) != 64:
+            continue
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if r <= 0 or s <= 0 or r >= N or s > N_HALF:
+            continue
+        q = _decompress_memo(bytes(pub)) if len(pub) == 33 else None
+        if q is None:
+            continue
+        e = int.from_bytes(hashlib.sha256(bytes(msg)).digest(), "big")
+        pend.append((i, r, e, q))
+        svals.append(s)
+
+    winv = sc.inv_mod_n_many(svals)
+    sc_rows, r1_i, r2_i, qx_i, qy_i, rows = [], [], [], [], [], []
+    for (i, r, e, q), w in zip(pend, winv):
+        u1 = e * w % N
+        u2 = r * w % N
+        m1, s1, m2, s2 = sc.glv_decompose(u1)
+        m3, s3, m4, s4 = sc.glv_decompose(u2)
+        signs[i] = (s1, s2, s3, s4)
+        sc_rows.extend((m1, m2, m3, m4))
+        r1_i.append(r)
+        r2_i.append(r + N if r + N < P else r)
+        qx_i.append(q[0])
+        qy_i.append(q[1])
+        rows.append(i)
+        ok[i] = True
+
+    if rows:
+        idx = np.asarray(rows)
+        scalars[idx] = sc.scalars_to_limbs(sc_rows).reshape(
+            len(rows), 4, sc.SCALAR_LIMBS
+        )
+        r1[idx] = field_to_limbs(r1_i)
+        r2[idx] = field_to_limbs(r2_i)
+        qx[idx] = field_to_limbs(qx_i)
+        qy[idx] = field_to_limbs(qy_i)
+    return qx, qy, scalars, signs, r1, r2, ok
+
+
+def prepare_rows_cached(items, val_idx, size: int, pad_idx: int):
+    """Warm-epoch host prep (ops/epoch_cache.py secp_tables): the
+    committee's decompressed Q columns stay device-resident, so the batch
+    ships only gather indices + scalar data — no host decompression at
+    all. Returns the verify_kernel_cached args after the tables:
+    (val_idx (size,) int32, scalars, signs, r1, r2, ok_host). Rows
+    [len(items):size] are trivial-accept pads gathering the table's pad
+    row `pad_idx` (G, ok). A row whose pubkey failed decompression is
+    killed by the TABLE's q_ok lane, matching prepare_rows' verdicts
+    bit-for-bit."""
+    n = len(items)
+    _, _, scalars, signs, r1, r2, ok = _empty_rows(size)
+    idx_col = np.full(size, pad_idx, dtype=np.int32)
+    if n:
+        idx_col[:n] = np.asarray(val_idx, dtype=np.int32)[:n]
+
+    pend = []
+    svals = []
+    for i, (_pub, msg, sig) in enumerate(items):
+        ok[i] = False
+        if len(sig) != 64:
+            continue
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if r <= 0 or s <= 0 or r >= N or s > N_HALF:
+            continue
+        e = int.from_bytes(hashlib.sha256(bytes(msg)).digest(), "big")
+        pend.append((i, r, e))
+        svals.append(s)
+
+    winv = sc.inv_mod_n_many(svals)
+    sc_rows, r1_i, r2_i, rows = [], [], [], []
+    for (i, r, e), w in zip(pend, winv):
+        u1 = e * w % N
+        u2 = r * w % N
+        m1, s1, m2, s2 = sc.glv_decompose(u1)
+        m3, s3, m4, s4 = sc.glv_decompose(u2)
+        signs[i] = (s1, s2, s3, s4)
+        sc_rows.extend((m1, m2, m3, m4))
+        r1_i.append(r)
+        r2_i.append(r + N if r + N < P else r)
+        rows.append(i)
+        ok[i] = True
+
+    if rows:
+        idx = np.asarray(rows)
+        scalars[idx] = sc.scalars_to_limbs(sc_rows).reshape(
+            len(rows), 4, sc.SCALAR_LIMBS
+        )
+        r1[idx] = field_to_limbs(r1_i)
+        r2[idx] = field_to_limbs(r2_i)
+    return idx_col, scalars, signs, r1, r2, ok
+
+
+def verify_rows(items, size: int | None = None) -> np.ndarray:
+    """Convenience host driver: prepare + jitted kernel + np verdicts
+    (the direct, non-epoch-cached path; mirrors backend.verify_batch's
+    use of the ed25519 kernel)."""
+    args = prepare_rows(items, size)
+    return np.array(jitted_secp_verify()(*args))[: len(items)]
+
+
+# Donation contract mirrors ed25519_verify: per-batch buffers may be
+# donated; the epoch-table arguments of the cached kernel (argnums 0-2)
+# are persistent device residents and are NEVER donated.
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_secp_verify(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel, donate_argnums=tuple(range(7)))
+    return jax.jit(verify_kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_secp_verify_cached(donate: bool = False):
+    if donate:
+        return jax.jit(verify_kernel_cached, donate_argnums=tuple(range(3, 9)))
+    return jax.jit(verify_kernel_cached)

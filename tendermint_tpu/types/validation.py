@@ -73,6 +73,21 @@ def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
     )
 
 
+def _should_batch_prepare(vals: ValidatorSet, commit: Commit) -> bool:
+    """The async seam's batch gate (ISSUE 19): the reference's per-key
+    batch-verifier gate, OR a scheme column view the device lanes can
+    take — an all-secp256k1 committee batches through the secp kernel
+    even though crypto/batch.go has no secp verifier (batch.go:26-33
+    returns nil; the device lane is a superset, not a parity break,
+    because verdicts and blame are bit-identical to the single path)."""
+    if _should_batch_verify(vals, commit):
+        return True
+    return (
+        len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
+        and vals.secp256k1_columns() is not None
+    )
+
+
 def _ignore_absent(c: CommitSig) -> bool:
     return c.is_absent()
 
@@ -183,7 +198,7 @@ def prepare_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID,
     async seam cannot represent the set."""
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
-    if not _should_batch_verify(vals, commit):
+    if not _should_batch_prepare(vals, commit):
         _verify_commit_single(
             chain_id, vals, commit, voting_power_needed,
             _ignore_not_for_block, _count_all, False, True,
@@ -243,7 +258,7 @@ def prepare_commit_light_trusting(chain_id: str, vals: ValidatorSet,
             "please provide smaller trustLevel numerator"
         )
     voting_power_needed = total_mul // trust_level.denominator
-    if not _should_batch_verify(vals, commit):
+    if not _should_batch_prepare(vals, commit):
         _verify_commit_single(
             chain_id, vals, commit, voting_power_needed,
             _ignore_not_for_block, _count_all, False, False,
@@ -299,20 +314,24 @@ def prepare_commit_batch(
     Host-side failures raise exactly what _verify_commit_batch raises
     before its verify call."""
     proposer = vals.get_proposer()
+    cols = vals.ed25519_columns()
+    scols = None if cols is not None else vals.secp256k1_columns()
     if (
         proposer is None
-        or not _batch.supports_batch_verifier(proposer.pub_key)
         or len(commit.signatures) < BATCH_VERIFY_THRESHOLD
+        or (scols is None
+            and not _batch.supports_batch_verifier(proposer.pub_key))
     ):
         raise RuntimeError(
             "unsupported signature algorithm or insufficient signatures for batch verification"
         )
-    cols = vals.ed25519_columns()
-    if cols is None:
-        # mixed/non-ed25519 set: the EntryBlock seam is ed25519-shaped;
-        # the synchronous path (per-key typed add) covers this correctly
-        raise PrepareUnsupported("validator set is not columnar ed25519")
-    if look_up_by_index:
+    if cols is None and scols is None:
+        # mixed/non-columnar set: ONE EntryBlock cannot represent it
+        # (per-scheme kernels); mesh-aware callers take
+        # prepare_commit_scheme_split, everyone else falls back to the
+        # synchronous per-key path, which handles every case
+        raise PrepareUnsupported("validator set is not single-scheme columnar")
+    if look_up_by_index and cols is not None:
         fused = _fused_commit_prep(
             chain_id, vals, commit, voting_power_needed,
             ignore_sig, count_sig, count_all_signatures,
@@ -344,7 +363,15 @@ def prepare_commit_batch(
     # val_idx rows are VALIDATOR-SET rows (the device-table gather key),
     # which differ from signature indexes on the by-address path
     rows = _np.asarray([r for _, r, _ in selected], dtype=_np.int32)
-    pub = cols[0][rows]
+    if cols is not None:
+        scheme, pub, pub_aux = "ed25519", cols[0][rows], None
+    else:
+        # all-secp256k1 committee (ISSUE 19): 33-byte SEC1 rows split
+        # into the prefix column so downstream columns stay 32-wide
+        raw = scols[0][rows]
+        scheme = "secp256k1"
+        pub_aux = _np.ascontiguousarray(raw[:, 0])
+        pub = _np.ascontiguousarray(raw[:, 1:])
     epoch_key = _epoch.note_valset(vals)
     sigs_list = commit.signatures
     sig = _np.frombuffer(
@@ -352,8 +379,95 @@ def prepare_commit_batch(
         dtype=_np.uint8,
     ).reshape(len(selected), 64)
     eblk = EntryBlock(pub, sig, buf, offsets,
-                      val_idx=rows, epoch_key=epoch_key)
+                      val_idx=rows, epoch_key=epoch_key,
+                      scheme=scheme, pub_aux=pub_aux)
     return eblk, _blame_conclude(batch_sig_idxs, commit)
+
+
+def prepare_commit_scheme_split(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool] = _ignore_not_for_block,
+    count_sig: Callable[[CommitSig], bool] = _count_all,
+    count_all_signatures: bool = False,
+    look_up_by_index: bool = True,
+):
+    """Mixed-committee prep (ISSUE 19): selection and tally run ONCE
+    (same _select_commit_sigs the sequential path shares), then the
+    selected lanes split per key scheme into one EntryBlock each —
+    submitted together, the mesh packer lands both schemes in different
+    lanes of the SAME superbatch, so a mixed commit still costs one
+    dispatch. Returns (blocks, conclude): `blocks` is the per-scheme
+    EntryBlock list in (ed25519, secp256k1) order and `conclude` takes
+    the verdict rows CONCATENATED in that block order, reproducing the
+    sequential path's exact blame string (first invalid lane in
+    signature order, not concat order). Raises PrepareUnsupported when
+    any key is neither scheme."""
+    view = vals.scheme_rows()
+    if view is None:
+        raise PrepareUnsupported("validator set has non-device key schemes")
+    kinds, pub32, aux = view
+    selected, tallied = _select_commit_sigs(
+        vals, commit, voting_power_needed,
+        ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+    )
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(
+            got=tallied, needed=voting_power_needed
+        )
+    import numpy as _np
+
+    from ..ops.entry_block import EntryBlock
+
+    per: dict = {0: [], 1: []}
+    for sig_idx, val_row, _ in selected:
+        per[int(kinds[val_row])].append((sig_idx, val_row))
+    blocks = []
+    parts_sig_idxs = []
+    sigs_list = commit.signatures
+    for kind, scheme in ((0, "ed25519"), (1, "secp256k1")):
+        lanes = per[kind]
+        if not lanes:
+            continue
+        sig_idxs = [i for i, _ in lanes]
+        with _span("verify_commit.sign_bytes", n=len(lanes), scheme=scheme):
+            buf, offsets = commit.vote_sign_bytes_block(chain_id, sig_idxs)
+        rows = _np.asarray([r for _, r in lanes], dtype=_np.int32)
+        sig = _np.frombuffer(
+            b"".join(sigs_list[i].signature for i in sig_idxs),
+            dtype=_np.uint8,
+        ).reshape(len(lanes), 64)
+        blocks.append(EntryBlock(
+            pub32[rows], sig, buf, offsets, val_idx=rows,
+            scheme=scheme,
+            pub_aux=(_np.ascontiguousarray(aux[rows])
+                     if scheme == "secp256k1" else None),
+        ))
+        parts_sig_idxs.append(sig_idxs)
+    all_idx = _np.concatenate(
+        [_np.asarray(p, dtype=_np.int64) for p in parts_sig_idxs]
+    ) if parts_sig_idxs else _np.zeros(0, dtype=_np.int64)
+
+    def conclude(valid) -> None:
+        valid_arr = _np.asarray(valid, dtype=bool)
+        if valid_arr.size and valid_arr.all():
+            return
+        if not valid_arr.all() and valid_arr.size:
+            # first invalid lane in SIGNATURE order: the concat order is
+            # per-scheme, so min() over the offending sig indexes — not
+            # argmin over the row — matches the sequential walk
+            idx = int(all_idx[~valid_arr].min())
+            sig = commit.signatures[idx]
+            raise ValueError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+            )
+        raise RuntimeError(
+            "BUG: batch verification failed with no invalid signatures"
+        )
+
+    return blocks, conclude
 
 
 def _select_commit_sigs(

@@ -24,6 +24,9 @@ PRIORITY_WINDOW_SIZE_FACTOR = 2  # validator_set.go:30
 # ed25519_columns cache sentinel: "computed, not columnar-representable"
 _NO_ED_COLS = object()
 
+# secp256k1_columns cache sentinel (same protocol)
+_NO_SECP_COLS = object()
+
 
 def _clip64(v: int) -> int:
     return max(INT64_MIN, min(INT64_MAX, v))
@@ -137,6 +140,7 @@ class ValidatorSet:
         self._total_voting_power: int = 0
         self._hash: Optional[bytes] = None
         self._ed_cols: Optional[tuple] = None
+        self._secp_cols: Optional[tuple] = None
 
     # ---- construction -------------------------------------------------
 
@@ -174,6 +178,7 @@ class ValidatorSet:
         # same device epoch (ops/epoch_cache.py keys on hash())
         c._hash = self._hash
         c._ed_cols = self._ed_cols
+        c._secp_cols = self._secp_cols
         return c
 
     # ---- queries ------------------------------------------------------
@@ -292,6 +297,78 @@ class ValidatorSet:
         self._ed_cols = cols if cols is not None else _NO_ED_COLS
         return cols
 
+    def secp256k1_columns(self) -> Optional[tuple]:
+        """(pub (n, 33) uint8, power (n,) int64) columns over the set, or
+        None unless EVERY validator key is secp256k1 — the scheme-lane
+        analog of ed25519_columns (ISSUE 19): the batched commit prep
+        gathers selected 33-byte SEC1 keys from here and the epoch cache
+        keys its decompressed affine Q table on the same hash(). Cached;
+        invalidated alongside the hash cache by _update_with_change_set
+        and shared by copy(). A None result is the TYPE check: mixed or
+        non-secp sets fall back to the object path."""
+        if self._secp_cols is not None:
+            cols = self._secp_cols
+            return cols if cols is not _NO_SECP_COLS else None
+        import numpy as np
+
+        from ..crypto import secp256k1 as _secp
+
+        vals = self.validators
+        n = len(vals)
+        cols = None
+        if n and all(
+            isinstance(v.pub_key, _secp.PubKey) for v in vals
+        ):
+            pub_b = b"".join(v.pub_key.bytes() for v in vals)
+            if len(pub_b) == 33 * n:
+                cols = (
+                    np.frombuffer(pub_b, dtype=np.uint8).reshape(n, 33),
+                    np.fromiter(
+                        (v.voting_power for v in vals),
+                        dtype=np.int64,
+                        count=n,
+                    ),
+                )
+        self._secp_cols = cols if cols is not None else _NO_SECP_COLS
+        return cols
+
+    def scheme_rows(self) -> Optional[tuple]:
+        """Per-validator scheme partition for MIXED device-batchable sets
+        (ISSUE 19 tentpole c): (kinds (n,) uint8 — 0 = ed25519, 1 =
+        secp256k1, pub (n, 32) uint8, aux (n,) uint8). For ed25519 rows
+        `pub` is the key and aux is 0; for secp256k1 rows `pub` is X and
+        aux the SEC1 prefix — exactly EntryBlock's (pub, pub_aux) split,
+        so the commit prep gathers per-scheme blocks without touching
+        Validator objects. None when any key is neither scheme (those
+        sets stay on the object path). Not cached separately: derives
+        from the per-scheme columns when the set is pure, else builds
+        once per call (mixed sets are the rare shape; the gather itself
+        is what the hot path repeats)."""
+        import numpy as np
+
+        from ..crypto import ed25519 as _ed25519
+        from ..crypto import secp256k1 as _secp
+
+        vals = self.validators
+        n = len(vals)
+        if not n:
+            return None
+        kinds = np.zeros(n, dtype=np.uint8)
+        pub = np.zeros((n, 32), dtype=np.uint8)
+        aux = np.zeros(n, dtype=np.uint8)
+        for i, v in enumerate(vals):
+            k = v.pub_key
+            if isinstance(k, _ed25519.PubKey):
+                pub[i] = np.frombuffer(k.bytes(), dtype=np.uint8)
+            elif isinstance(k, _secp.PubKey):
+                kinds[i] = 1
+                b = k.bytes()
+                aux[i] = b[0]
+                pub[i] = np.frombuffer(b, dtype=np.uint8)[1:]
+            else:
+                return None
+        return kinds, pub, aux
+
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():
             raise ValueError("validator set is nil or empty")
@@ -374,6 +451,7 @@ class ValidatorSet:
     def _update_with_change_set(self, changes: List[Validator], allow_deletes: bool) -> None:
         self._hash = None  # membership/power may change below
         self._ed_cols = None
+        self._secp_cols = None
         if not changes:
             return
         updates, deletes = _process_changes(changes)

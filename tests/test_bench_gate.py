@@ -54,7 +54,7 @@ def test_pins_file_is_wellformed():
 @pytest.mark.parametrize(
     "kind",
     ["bench", "multichip", "light", "mempool", "blocksync", "votes", "soak",
-     "fleet"],
+     "fleet", "schemes"],
 )
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.
@@ -127,6 +127,20 @@ def test_soak_gate_is_direction_aware(tmp_path):
         "--gate-pct", str(pins["gate_pct"]),
     ])
     assert rc == 1, "a 50% replay heights/s fall must fail the gate"
+
+
+def test_schemes_artifact_meets_acceptance_floor():
+    """ISSUE 19 acceptance pinned into tier-1: the committed scheme-lane
+    artifact must show the 10k-validator secp commit clearing >= 10x the
+    per-signature baseline in ONE relay launch. bench.py schemes already
+    exits nonzero below 10x; this keeps the COMMITTED record honest."""
+    latest = _latest_of_kind("schemes")
+    assert latest is not None, "no SCHEMES_r*.json committed"
+    with open(os.path.join(REPO_ROOT, latest)) as fh:
+        art = json.load(fh)
+    assert art["vs_per_sig"] >= 10.0
+    assert art["launches"] == 1
+    assert art["vals"] >= 10_000
 
 
 def test_light_artifact_in_trajectory(capsys):

@@ -182,7 +182,9 @@ class TestKnobs:
         monkeypatch.setenv("TM_TPU_MESH_LANE_BUCKET", "1024")
         assert ms.lane_cap() == 1024
         monkeypatch.setenv("TM_TPU_MESH_LANE_BUCKET", "4")
-        assert ms.lane_cap() == 128  # floored at the smallest bucket
+        # floored at the secp lane-bucket floor (ISSUE 19), not 128: the
+        # scheme lane's per-row kernel cost makes small lanes worthwhile
+        assert ms.lane_cap() == 16
         monkeypatch.setenv("TM_TPU_MESH_LANE_BUCKET", "999999")
         assert ms.lane_cap() == 10240  # clamped into the bucket ladder
 

@@ -187,6 +187,17 @@ class TestDonationAliasing:
         assert not lint(src, "tendermint_tpu/light/client.py",
                         "donation-aliasing")
 
+    def test_negative_owned_array_of_launch(self):
+        # the ISSUE 19 secp chunked-verify shape: np.array(...) copies
+        # by default (numpy 2), so slicing/appending the result is clean
+        src = """
+            import numpy as np
+            def f(kern, args, n):
+                res = np.array(kern(*args))
+                return res[:n]
+        """
+        assert not lint(src, OPS_PATH, "donation-aliasing")
+
     def test_suppressed(self):
         src = """
             import numpy as np

@@ -94,6 +94,9 @@ METRIC_KEYS = (
     # verification-fleet scale-out artifacts (FLEET_r*, ISSUE 18); the
     # headline "value" is the aggregate sigs/s at the largest host count
     "clients",
+    # scheme-lane artifacts (SCHEMES_r*, ISSUE 19); the headline "value"
+    # is counted secp256k1 commit sigs/s through ONE relay launch
+    "secp_seq_sigs_per_s", "vs_per_sig", "launches", "sigs_counted",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -117,11 +120,12 @@ COMPARE_KEYS = (
     "vs_kernel_serial", "consensus_commit_p99_ms", "light_verdict_p99_ms",
     "ingress_admission_p99_ms", "replay_heights_per_s",
     "lanes_adaptive_idle_p99_ms", "lanes_adaptive_sigs_per_window",
+    "vs_per_sig",
 )
 
 _NAME_RE = re.compile(
-    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES|FLEET)"
-    r"_r(\d+)",
+    r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES|FLEET"
+    r"|SCHEMES)_r(\d+)",
     re.I)
 
 
@@ -243,6 +247,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "SOAK_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "LANES_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "FLEET_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "SCHEMES_r*.json")))
     return paths
 
 
@@ -260,7 +265,8 @@ def validate(art: dict) -> List[str]:
         probs.append("; ".join(art["notes"]))
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
-                           "blocksync", "votes", "soak", "lanes", "fleet"):
+                           "blocksync", "votes", "soak", "lanes", "fleet",
+                           "schemes"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -643,6 +643,257 @@ def run_mesh(args) -> int:
     return rc
 
 
+def run_schemes(args) -> int:
+    """--schemes: the ISSUE 19 scheme-lane gate. A mixed
+    ed25519+secp256k1 committee must verify in ONE superbatch launch
+    with verdicts AND blame byte-identical to the sequential reference
+    walk. Every kernel runs REAL (live verdicts) — correctness is the
+    gate here; throughput is `bench.py schemes` (SCHEMES_r*.json).
+    Asserts:
+
+      split    prepare_commit_scheme_split partitions a mixed commit
+               into per-scheme EntryBlocks (ed25519 first), covering
+               every counted signature exactly once
+      pack     the mesh packer takes both blocks into ONE plan whose
+               superblock is a SchemeSuperBlock with contiguous
+               per-scheme segments in plan.schemes() order
+      launch   prepare_superbatch hands back ONE launch fn; a single
+               call verifies every lane — one relay command for a
+               mixed-scheme commit (the mixed-commit acceptance)
+      parity   demuxed per-job verdict rows are bit-identical to the
+               single-scheme device path (backend.verify_batch), on
+               the direct drive AND through the pipeline mesh worker
+      blame    a tampered secp256k1 signature raises from conclude()
+               with the EXACT error string of the sequential
+               _verify_commit_single walk; same for a tampered
+               ed25519 signature
+      lanes    the secp device verdict row equals the host
+               per-signature loop bit-for-bit, including a
+               non-lower-S rejection
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    os.environ["TM_TPU_MESH_LANE_BUCKET"] = "16"
+
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.crypto import secp256k1 as _secp
+    from tendermint_tpu.ops import backend, device_pool as dp, mesh as ms
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool
+    from tendermint_tpu.types import (
+        BlockID,
+        PartSetHeader,
+        Timestamp,
+        Validator,
+        ValidatorSet,
+        Vote,
+        VoteSet,
+    )
+    from tendermint_tpu.types.block import CommitSig
+    from tendermint_tpu.types.vote import PRECOMMIT_TYPE
+    from tendermint_tpu.types import validation as V
+
+    chain_id = "schemes-gate"
+    n_vals = 12
+    print(f"prep_bench --schemes: vals={n_vals} (mixed ed25519+secp256k1) "
+          "lane_bucket=16")
+    rc = 0
+
+    def build_commit(tag):
+        """A mixed committee (every 3rd validator ed25519, the rest
+        secp256k1) with REAL signatures — blame must see live verdicts."""
+        pairs = []
+        for i in range(n_vals):
+            seed = (tag * 4096 + i + 1).to_bytes(32, "big")
+            sk = (_ed.gen_priv_key(seed) if i % 3 == 0
+                  else _secp.PrivKey(seed))
+            pairs.append((sk, Validator.new(sk.pub_key(), 100)))
+        vset = ValidatorSet.new([v for _, v in pairs])
+        by_addr = {v.address: sk for sk, v in pairs}
+        sks = [by_addr[v.address] for v in vset.validators]
+        bid = BlockID(hash=b"\x05" * 32,
+                      part_set_header=PartSetHeader(total=1, hash=b"\x05" * 32))
+        vs = VoteSet(chain_id, 7, 0, PRECOMMIT_TYPE, vset)
+        for i, sk in enumerate(sks):
+            vote = Vote(type=PRECOMMIT_TYPE, height=7, round=0, block_id=bid,
+                        timestamp=Timestamp(seconds=1_600_000_000, nanos=0),
+                        validator_address=vset.validators[i].address,
+                        validator_index=i)
+            sig = sk.sign(vote.sign_bytes(chain_id))
+            vs.add_vote(Vote(**{**vote.__dict__, "signature": sig}))
+        return vset, vs.make_commit()
+
+    def tamper(commit, i):
+        cs = commit.signatures[i]
+        bad = bytearray(cs.signature)
+        bad[9] ^= 0x3C
+        commit.signatures[i] = CommitSig(
+            block_id_flag=cs.block_id_flag,
+            validator_address=cs.validator_address,
+            timestamp=cs.timestamp, signature=bytes(bad))
+
+    def seq_error(vset, commit):
+        try:
+            V._verify_commit_single(
+                chain_id, vset, commit, vset.total_voting_power() * 2 // 3,
+                V._ignore_not_for_block, V._count_all, False, True)
+            return None
+        except ValueError as e:
+            return str(e)
+
+    class _J:
+        def __init__(self, blk):
+            self.entries = blk
+
+    def one_launch(blocks):
+        """The acceptance drive: both scheme blocks through the
+        PRODUCTION pack/build/prep path, verified by a SINGLE call of
+        the one launch fn prepare_superbatch returns."""
+        jobs = [_J(b) for b in blocks]
+        plan, held = ms.pack_jobs(jobs, len(jobs))
+        assert not held, "scheme blocks must pack into one plan"
+        block, spans = ms.build_superblock(plan)
+        res = ms.prepare_superbatch(block, plan)
+        f, fargs = res[0], res[1]
+        shardings = res[4] if len(res) > 4 else None
+        arr = np.asarray(f(*dp.transfer(fargs, shardings=shardings)))
+        if arr.ndim == 2:
+            arr = arr[0]
+        arr = arr.astype(bool)
+        by_job = {id(j): (off, n) for j, off, n in spans}
+        outs = []
+        for j in jobs:
+            off, n = by_job[id(j)]
+            outs.append(arr[off:off + n])
+        return plan, block, outs
+
+    # -- split + pack + ONE launch + verdict parity (good commit) -------
+    vset, commit = build_commit(1)
+    blocks, conclude = V.prepare_commit_scheme_split(
+        chain_id, vset, commit, vset.total_voting_power() * 2 // 3)
+    schemes = [b.scheme for b in blocks]
+    covered = sum(len(b) for b in blocks)
+    # equal powers: the selection walk stops at the first signature that
+    # crosses 2/3 of total power, exactly like _verify_commit_single
+    want_rows = (vset.total_voting_power() * 2 // 3) // 100 + 1
+    print(f"  split: blocks={schemes} rows={[len(b) for b in blocks]} "
+          f"(threshold walk selects {want_rows})")
+    if schemes != ["ed25519", "secp256k1"] or covered != want_rows:
+        print("  FAIL: mixed commit must split into ed25519+secp256k1 "
+              "blocks covering every counted signature exactly once",
+              file=sys.stderr)
+        rc = 1
+    plan, sblock, outs = one_launch(blocks)
+    is_super = isinstance(sblock, ms.SchemeSuperBlock)
+    parts = [s for s, _, _ in sblock.parts] if is_super else []
+    print(f"  pack : superblock={'SchemeSuperBlock' if is_super else type(sblock).__name__} "
+          f"parts={parts} schemes={plan.schemes()}")
+    if not is_super or parts != plan.schemes():
+        print("  FAIL: mixed plan must build a SchemeSuperBlock with "
+              "per-scheme segments in plan order", file=sys.stderr)
+        rc = 1
+    print("  launch: 1 (single fn call covered all "
+          f"{plan.bucket} rows, {plan.live} live)")
+    mism = None
+    for i, (b, got) in enumerate(zip(blocks, outs)):
+        want = np.asarray(backend.verify_batch(b))
+        if not np.array_equal(got, want):
+            mism = i
+    print(f"  parity vs single-scheme device  : "
+          f"{'OK' if mism is None else f'MISMATCH block {mism}'}")
+    if mism is not None:
+        rc = 1
+    try:
+        conclude(np.concatenate(outs))
+        print("  good commit verdict             : OK (verified)")
+    except ValueError as e:
+        print(f"  FAIL: good mixed commit rejected: {e}", file=sys.stderr)
+        rc = 1
+
+    # -- blame parity: tampered secp sig, then tampered ed sig ----------
+    for label, bad_i in (("secp256k1", 1), ("ed25519", 0)):
+        vset, commit = build_commit(2)
+        # pick a commit index of the wanted scheme
+        kinds, _, _ = vset.scheme_rows()
+        want_kind = 1 if label == "secp256k1" else 0
+        idx = int(np.nonzero(kinds == want_kind)[0][bad_i])
+        tamper(commit, idx)
+        want_err = seq_error(vset, commit)
+        blocks, conclude = V.prepare_commit_scheme_split(
+            chain_id, vset, commit, vset.total_voting_power() * 2 // 3)
+        _, _, outs = one_launch(blocks)
+        try:
+            conclude(np.concatenate(outs))
+            got_err = None
+        except ValueError as e:
+            got_err = str(e)
+        ok = want_err is not None and got_err == want_err
+        print(f"  blame parity ({label:9s})      : "
+              f"{'OK' if ok else 'MISMATCH'}")
+        if not ok:
+            print(f"  FAIL: sequential={want_err!r} batched={got_err!r}",
+                  file=sys.stderr)
+            rc = 1
+
+    # -- pipeline mesh worker: same verdicts through the async path -----
+    vset, commit = build_commit(1)
+    blocks, conclude = V.prepare_commit_scheme_split(
+        chain_id, vset, commit, vset.total_voting_power() * 2 // 3)
+    v = pl.AsyncBatchVerifier(depth=2, mesh_lanes=2)
+    try:
+        futs = [v.submit(b) for b in blocks]
+        res = [np.asarray(f.result(timeout=600)) for f in futs]
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+    finally:
+        v.close()
+    pipe_ok = all(
+        np.array_equal(r, np.asarray(backend.verify_batch(b)))
+        for b, r in zip(blocks, res)
+    )
+    print(f"  pipeline mesh worker parity     : "
+          f"{'OK' if pipe_ok else 'MISMATCH'}")
+    print(f"  pool                            : {pool}")
+    if not pipe_ok:
+        rc = 1
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+
+    # -- secp lane: device row == host per-signature loop ---------------
+    n_lane = 16
+    lane = []
+    for i in range(n_lane):
+        sk = _secp.PrivKey((7000 + i).to_bytes(32, "big"))
+        m = b"lane-%d" % i
+        lane.append((sk.pub_key(), m, sk.sign(m)))
+    # one tampered, one non-lower-S (upper-S re-encoding of a valid sig)
+    pk3, m3, s3 = lane[3]
+    lane[3] = (pk3, m3, s3[:32] + s3[32:][::-1])
+    pk5, m5, s5 = lane[5]
+    s_hi = int.from_bytes(s5[32:], "big")
+    n_order = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    lane[5] = (pk5, m5, s5[:32] + (n_order - s_hi).to_bytes(32, "big"))
+    host = np.asarray([pk.verify_signature(m, s) for pk, m, s in lane])
+    dev = np.asarray(backend.verify_batch_secp(
+        [(pk.bytes(), m, s) for pk, m, s in lane]))
+    lane_ok = (np.array_equal(host, dev) and not dev[3] and not dev[5]
+               and dev.sum() == n_lane - 2)
+    print(f"  secp device vs host lane        : "
+          f"{'OK' if lane_ok else 'MISMATCH'} "
+          f"(rejected {n_lane - int(dev.sum())}/{n_lane}: tampered + "
+          "non-lower-S)")
+    if not lane_ok:
+        rc = 1
+    return rc
+
+
 def run_light(args) -> int:
     """--light: the round-11 light-service gate on a mocked relay (slow
     readback over REAL kernels — verdicts are live). Asserts the three
@@ -1966,6 +2217,15 @@ def main() -> int:
         "server is rejoined, zero pool-slot leak",
     )
     ap.add_argument(
+        "--schemes",
+        action="store_true",
+        help="round-19 gate: scheme-keyed verification lanes — a mixed "
+        "ed25519+secp256k1 commit verifies in ONE superbatch launch with "
+        "verdicts and blame byte-identical to the sequential walk, and "
+        "the secp device lane matches the host per-signature loop "
+        "bit-for-bit (incl. non-lower-S rejection)",
+    )
+    ap.add_argument(
         "--soak",
         action="store_true",
         help="round-16 gate: soak-harness hygiene on a mocked relay — "
@@ -1981,6 +2241,8 @@ def main() -> int:
         return run_overlap(args)
     if args.mesh:
         return run_mesh(args)
+    if args.schemes:
+        return run_schemes(args)
     if args.light:
         return run_light(args)
     if args.ingress:
