@@ -2442,6 +2442,205 @@ def schemes_main(argv) -> None:
         sys.exit(1)
 
 
+def bls_main(argv) -> None:
+    """`bench.py bls` — the BLS12-381 aggregation lane at committee
+    scale (ISSUE 20).
+
+    Drives K aggregated commits (ONE 96-byte signature + a signer
+    bitmap each, 2302.00418's BLS shape) through the FULL production
+    seam (prepare_aggregated_commit -> AggBlock -> pipeline coalescer
+    -> fused multi-pairing launch -> conclude) with the device mocked
+    behind a fixed per-launch relay RTT (mock_vote_prepare: the real
+    host prep — signature/pubkey status walk, epoch G1-table columns,
+    mask/RLC-coefficient packing — and the H2D transfer run unchanged;
+    the launch's verdict matures rtt_ms after launch). Headline:
+    aggregated commits/s to conclude().
+
+    Two economics columns ride along, both ANALYTIC from the launch
+    ledger (widths the coalescer actually dispatched), not timed:
+
+      pairings_per_commit   a sequential BLS verify pays 2 pairings
+                            (2 Miller loops + 2 final exponentiations)
+                            per commit; the fused lane pays 2W Miller
+                            loops + ONE shared final exp per W-wide
+                            launch — counting a pairing as one Miller +
+                            one final exp, that amortizes to
+                            1 + 1/(2W) < 2. This RLC fusion is the
+                            structural contrast with the ECDSA lane,
+                            where no such cross-signature fusion exists.
+      wire_ratio_vs_ed25519 bytes of the aggregated commit vs the SAME
+                            committee's per-signature ed25519 commit
+                            (96B sig + V/8 bitmap vs V 64-byte rows +
+                            addresses + timestamps) — gated at <= 0.10
+                            for the 128-validator acceptance committee.
+
+    Exits nonzero when a gate fails. Prints ONE JSON line; --out also
+    writes it as an artifact file (AGG_r*.json, schema_version 1,
+    rendered by tools/bench_report.py --trajectory and gated by
+    --compare)."""
+    import argparse
+
+    import numpy as np
+
+    ap = argparse.ArgumentParser(prog="bench.py bls")
+    ap.add_argument("--vals", type=int, default=128,
+                    help="BLS validators in the committee (default 128)")
+    ap.add_argument("--commits", type=int, default=16,
+                    help="aggregated commits in the window (default 16)")
+    ap.add_argument("--rtt-ms", type=float, default=40.0,
+                    help="mocked relay round-trip per launch (default 40)")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON to this path")
+    args = ap.parse_args(argv)
+
+    from tendermint_tpu.libs import jaxcache
+
+    import jax
+
+    jaxcache.enable(jax, os.path.dirname(os.path.abspath(__file__)))
+
+    from tendermint_tpu.crypto import bls12381 as _bls
+    from tendermint_tpu.libs.bits import BitArray
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as _pl
+    from tendermint_tpu.ops._testing import mock_vote_prepare
+    from tendermint_tpu.types import validation as V
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        AggregatedCommit,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.wire.canonical import Timestamp
+
+    chain_id = "bls-bench"
+    print(f"# deriving {args.vals} bls12381 validators (pure-python G1 "
+          "scalar muls)", file=sys.stderr)
+    vals = []
+    for i in range(args.vals):
+        pk = _bls.PrivKey((i + 1).to_bytes(32, "big")).pub_key()
+        vals.append(Validator.new(pk, 100))
+    vset = ValidatorSet(validators=vals, proposer=vals[0])
+    bid = BlockID(hash=b"\x20" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x20" * 32))
+
+    # ONE real signature shared across the window: the mocked relay
+    # never runs the pairing, but the host prep's signature_status
+    # (decompress + G2 subgroup check) must see a live aggregate — and
+    # memoizes per sig bytes exactly like production's repeated gossip
+    print("# signing one aggregate (hash-to-G2 + cofactor clearing)",
+          file=sys.stderr)
+    full = BitArray(args.vals)
+    for i in range(args.vals):
+        full.set_index(i, True)
+    probe = AggregatedCommit(height=1, round=0, block_id=bid, signers=full)
+    sig = _bls.PrivKey(b"\x2a" * 32).sign(probe.sign_bytes(chain_id))
+
+    def agg_at(h):
+        ba = BitArray(args.vals)
+        for i in range(args.vals):
+            ba.set_index(i, True)
+        return AggregatedCommit(height=h, round=0, block_id=bid,
+                                signature=sig, signers=ba)
+
+    # -- wire economics (real encodings, independent of the relay) ------
+    agg_bytes = len(agg_at(1).encode())
+    ed_sigs = [CommitSig(
+        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+        validator_address=v.address,
+        timestamp=Timestamp(seconds=1_700_000_000, nanos=i + 1),
+        signature=bytes(64),
+    ) for i, v in enumerate(vals)]
+    ed_bytes = len(Commit(height=1, round=0, block_id=bid,
+                          signatures=ed_sigs).encode())
+    wire_ratio = agg_bytes / ed_bytes
+
+    _epoch.reset(8)
+    _epoch.note_valset(vset)  # register
+    _epoch.note_valset(vset)  # warm: pub48 columns + device G1 tables
+    real_prepare = _pl.AsyncBatchVerifier._prepare
+    widths = []
+    mocked = mock_vote_prepare(real_prepare, args.rtt_ms / 1e3)
+
+    def counting(entries):
+        widths.append(len(entries))
+        return mocked(entries)
+
+    _pl.AsyncBatchVerifier._prepare = staticmethod(counting)
+    v = _pl.AsyncBatchVerifier(depth=3)
+    try:
+        def run_once():
+            pairs = [V.prepare_aggregated_commit(
+                chain_id, vset, bid, h, agg_at(h), k_hint=args.commits)
+                for h in range(1, args.commits + 1)]
+            futs = [(v.submit(blk), conc) for blk, conc in pairs]
+            for fut, conc in futs:
+                conc(np.asarray(fut.result(timeout=600)))
+            return len(pairs)
+
+        # warm rep: pubkey_status memoization + epoch table upload +
+        # shape warmup happen once per process, outside the timed window
+        run_once()
+        widths.clear()
+        t0 = time.perf_counter()
+        k = run_once()
+        dt = time.perf_counter() - t0
+    finally:
+        v.close()
+        _pl.AsyncBatchVerifier._prepare = real_prepare
+
+    launches = len(widths)
+    # a pairing = one Miller loop + one final exponentiation; a W-wide
+    # fused launch runs 2W Millers (pads included — they burn device
+    # lanes like any fixed-shape batch) and ONE shared final exp
+    millers = sum(2 * w for w in widths)
+    final_exps = launches
+    pairings = millers / 2 + final_exps / 2
+    pairings_per_commit = pairings / k
+    sigs_replaced_per_pairing = (args.vals * k) / pairings
+    rate = k / dt
+
+    out = {
+        "schema_version": 1,
+        "metric": "bls_agg_commits_per_s",
+        "value": round(rate, 1),
+        "unit": "commits/s",
+        "mode": "mocked-relay",
+        "backend": os.environ.get("JAX_PLATFORMS", "") or "cpu",
+        "scheme": "bls12381",
+        "vals": args.vals,
+        "commits": k,
+        "relay_rtt_ms": args.rtt_ms,
+        "launches": launches,
+        "launch_widths": widths,
+        "epoch": "warm",
+        "pairings_per_commit": round(pairings_per_commit, 4),
+        "sigs_replaced_per_pairing": round(sigs_replaced_per_pairing, 1),
+        "agg_wire_bytes": agg_bytes,
+        "ed25519_wire_bytes": ed_bytes,
+        "wire_ratio_vs_ed25519": round(wire_ratio, 4),
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+            fh.write("\n")
+    fails = []
+    if pairings_per_commit >= 2.0:
+        fails.append(f"pairings_per_commit {pairings_per_commit:.3f} >= 2 "
+                     "(fusion must amortize the final exponentiation)")
+    if args.vals >= 128 and wire_ratio > 0.10:
+        fails.append(f"wire ratio {wire_ratio:.4f} > 0.10 vs the "
+                     "per-signature ed25519 commit")
+    for f in fails:
+        print(f"# FAIL: {f} (ISSUE 20 acceptance)", file=sys.stderr)
+    if fails:
+        sys.exit(1)
+
+
 def lanes_main(argv) -> None:
     """`bench.py lanes` — the ingress-fabric latency-vs-load curve
     (ISSUE 17).
@@ -2797,6 +2996,8 @@ if __name__ == "__main__":
         votes_main(sys.argv[2:])
     elif sys.argv[1:2] == ["schemes"]:
         schemes_main(sys.argv[2:])
+    elif sys.argv[1:2] == ["bls"]:
+        bls_main(sys.argv[2:])
     elif sys.argv[1:2] == ["lanes"]:
         lanes_main(sys.argv[2:])
     elif sys.argv[1:2] == ["soak"]:

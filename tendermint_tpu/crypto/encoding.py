@@ -12,10 +12,14 @@ from . import PubKey
 from . import ed25519 as _ed25519
 from . import secp256k1 as _secp256k1
 from . import sr25519 as _sr25519
+from . import bls12381 as _bls12381
 
 _FIELD_ED25519 = 1
 _FIELD_SECP256K1 = 2
 _FIELD_SR25519 = 3
+# local extension (ISSUE 20): upstream keys.proto stops at sr25519; the
+# aggregation lane's min-pubkey BLS keys ride the next oneof slot
+_FIELD_BLS12381 = 4
 
 
 def pubkey_to_proto(pk: PubKey) -> bytes:
@@ -28,6 +32,8 @@ def pubkey_to_proto(pk: PubKey) -> bytes:
         w.write_bytes(_FIELD_SECP256K1, pk.bytes(), always=True)
     elif t == _sr25519.KEY_TYPE:
         w.write_bytes(_FIELD_SR25519, pk.bytes(), always=True)
+    elif t == _bls12381.KEY_TYPE:
+        w.write_bytes(_FIELD_BLS12381, pk.bytes(), always=True)
     else:
         raise ValueError(f"unsupported key type {t}")
     return w.bytes()
@@ -41,4 +47,6 @@ def pubkey_from_proto(data: bytes) -> PubKey:
         return _secp256k1.PubKey(field_bytes(fields, _FIELD_SECP256K1))
     if _FIELD_SR25519 in fields:
         return _sr25519.PubKey(field_bytes(fields, _FIELD_SR25519))
+    if _FIELD_BLS12381 in fields:
+        return _bls12381.PubKey(field_bytes(fields, _FIELD_BLS12381))
     raise ValueError("unknown or empty PublicKey oneof")

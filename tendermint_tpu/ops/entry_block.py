@@ -303,6 +303,197 @@ class EntryBlock:
                           scheme=scheme, pub_aux=pub_aux)
 
 
+class AggBlock:
+    """Columnar AGGREGATED-commit batch — the BLS12-381 lane's analogue
+    of EntryBlock (ISSUE 20). One row is one whole commit, not one
+    signature:
+
+        sig     (k, 96) uint8   aggregated G2 signatures (compressed)
+        bits    (k, v)  bool    signer bitmap rows over ONE committee
+        msgs    bytes           all sign-bytes concatenated (one per row)
+        offsets (k+1,)  int64   msgs[offsets[i]:offsets[i+1]] is row i
+        pub48   (v, 48) uint8   the committee's compressed G1 pubkeys —
+                                a host snapshot carried so a cold/evicted
+                                epoch can still build kernel tables
+        is_pad  (k,)    bool    mesh padding rows (verdicts discarded)
+
+    Unlike EntryBlock there is no val_idx column: the bitmap IS the
+    committee reference, so `epoch_key` (ValidatorSet.hash()) is ALWAYS
+    set — the mesh packer keys lanes on it, which is what guarantees two
+    different committees' bitmaps never share a device launch. Pad
+    blocks are committee-free (bits width 0) and adopt the committee of
+    whatever non-pad block they are concatenated with."""
+
+    __slots__ = ("sig", "bits", "msgs", "offsets", "pub48", "is_pad",
+                 "epoch_key", "scheme", "val_idx")
+
+    def __init__(self, sig: np.ndarray, bits: np.ndarray,
+                 msgs: Union[bytes, memoryview], offsets: np.ndarray,
+                 pub48: np.ndarray, epoch_key: bytes,
+                 is_pad: "np.ndarray" = None):
+        k = sig.shape[0]
+        if sig.shape != (k, 96):
+            raise ValueError("sig must be (k, 96) uint8")
+        if bits.ndim != 2 or bits.shape[0] != k:
+            raise ValueError("bits must be (k, v) bool")
+        if offsets.shape != (k + 1,):
+            raise ValueError("offsets must be (k+1,)")
+        if k and bool((np.diff(offsets) < 0).any()):
+            raise ValueError("offsets must be non-decreasing")
+        if pub48.shape != (bits.shape[1], 48):
+            raise ValueError("pub48 must be (v, 48) matching bits width")
+        self.sig = sig
+        self.bits = bits
+        self.msgs = msgs
+        self.offsets = offsets
+        self.pub48 = pub48
+        self.epoch_key = epoch_key
+        if is_pad is None:
+            is_pad = np.zeros(k, dtype=bool)
+        elif is_pad.shape != (k,):
+            raise ValueError("is_pad must be (k,)")
+        self.is_pad = is_pad
+        self.scheme = "bls12381"
+        self.val_idx = None  # epoch_cache.lookup() bypass: bitmap-indexed
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_commits(cls, commits, pub48: np.ndarray,
+                     epoch_key: bytes) -> "AggBlock":
+        """[(bits_bool_row, sign_bytes, sig96), ...] over one committee."""
+        k = len(commits)
+        v = pub48.shape[0]
+        if k == 0:
+            return cls(np.zeros((0, 96), dtype=np.uint8),
+                       np.zeros((0, v), dtype=bool), b"", _EMPTY_OFFSETS,
+                       pub48, epoch_key)
+        sig = np.frombuffer(
+            b"".join(s for _, _, s in commits), dtype=np.uint8
+        ).reshape(k, 96)
+        bits = np.stack([np.asarray(b, dtype=bool) for b, _, _ in commits])
+        lens = np.fromiter((len(m) for _, m, _ in commits), dtype=np.int64,
+                           count=k)
+        offsets = np.zeros(k + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        msgs = b"".join(m for _, m, _ in commits)
+        return cls(sig, bits, msgs, offsets, pub48, epoch_key)
+
+    @classmethod
+    def pad(cls, n: int) -> "AggBlock":
+        """Committee-free padding rows (bits width 0; the backend preps
+        pads from its fixed self-signed pad commit, not from the bitmap).
+        epoch_key None: mesh pad blocks are built per lane AFTER packing,
+        so they concat-adopt the lane's key/committee."""
+        return cls(
+            np.zeros((n, 96), dtype=np.uint8),
+            np.zeros((n, 0), dtype=bool),
+            b"",
+            np.zeros(n + 1, dtype=np.int64),
+            np.zeros((0, 48), dtype=np.uint8),
+            None,
+            is_pad=np.ones(n, dtype=bool),
+        )
+
+    # -- shape / access -----------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self.sig.shape[0]
+
+    def __len__(self) -> int:
+        return self.sig.shape[0]
+
+    def msg_nbytes(self) -> int:
+        return int(self.offsets[-1] - self.offsets[0])
+
+    def msg(self, i: int) -> bytes:
+        o = self.offsets
+        return bytes(memoryview(self.msgs)[int(o[i]) : int(o[i + 1])])
+
+    def msgs_contiguous(self):
+        base = int(self.offsets[0])
+        end = int(self.offsets[-1])
+        buf = self.msgs
+        if base != 0 or end != len(buf):
+            buf = memoryview(buf)[base:end]
+        if base == 0:
+            return buf, self.offsets
+        return buf, self.offsets - base
+
+    def __getitem__(self, key: slice) -> "AggBlock":
+        if not isinstance(key, slice):
+            raise TypeError("AggBlock indexing takes a slice")
+        start, stop, step = key.indices(self.n)
+        if step != 1:
+            raise ValueError("AggBlock slices must be contiguous")
+        o = self.offsets
+        base = int(o[start])
+        mv = memoryview(self.msgs)[base : int(o[stop])]
+        return AggBlock(
+            self.sig[start:stop],
+            self.bits[start:stop],
+            mv,
+            o[start : stop + 1] - base,
+            self.pub48,
+            self.epoch_key,
+            is_pad=self.is_pad[start:stop],
+        )
+
+    # -- combination --------------------------------------------------------
+
+    @staticmethod
+    def concat(blocks: Sequence["AggBlock"]) -> "AggBlock":
+        """Same one-concatenate-per-column discipline as EntryBlock. The
+        committee comes from the non-pad blocks, which must AGREE (the
+        mesh keys agg lanes on epoch_key, so a mixed-committee concat is
+        a caller bug); width-0 pad blocks adopt it."""
+        blocks = [b for b in blocks if len(b)]
+        if not blocks:
+            raise ValueError("cannot concat zero aggregated rows")
+        if len(blocks) == 1:
+            return blocks[0]
+        live = [b for b in blocks if b.epoch_key is not None]
+        if live:
+            epoch_key = live[0].epoch_key
+            pub48 = live[0].pub48
+            if any(b.epoch_key != epoch_key for b in live):
+                raise ValueError("cannot concat mixed-committee AggBlocks")
+        else:  # all-pad merge keeps the committee-free form
+            epoch_key = None
+            pub48 = blocks[0].pub48
+        v = pub48.shape[0]
+        bits = np.zeros((sum(len(b) for b in blocks), v), dtype=bool)
+        pos = 0
+        for b in blocks:
+            if b.bits.shape[1]:
+                bits[pos : pos + len(b)] = b.bits
+            pos += len(b)
+        sig = np.concatenate([b.sig for b in blocks])
+        is_pad = np.concatenate([b.is_pad for b in blocks])
+        msgs = b"".join(b.msgs_contiguous()[0] for b in blocks)
+        offsets = np.zeros(len(sig) + 1, dtype=np.int64)
+        pos = 0
+        base = 0
+        for b in blocks:
+            _, o = b.msgs_contiguous()
+            offsets[pos + 1 : pos + len(b) + 1] = o[1:] + base
+            pos += len(b)
+            base += int(o[-1])
+        return AggBlock(sig, bits, msgs, offsets, pub48, epoch_key,
+                        is_pad=is_pad)
+
+
+def block_concat(blocks):
+    """Type-dispatched concat for the mesh/pipeline coalescers: a lane is
+    homogeneous (EntryBlocks or AggBlocks, never both — scheme-keyed
+    packing), but the CALLER is generic over lanes."""
+    blocks = list(blocks)
+    if blocks and isinstance(blocks[0], AggBlock):
+        return AggBlock.concat(blocks)
+    return EntryBlock.concat(blocks)
+
+
 class CommitBlock:
     """Columnar commit-signature representation — populated ONCE at wire
     decode (types/block.py Commit.decode) so the verify hot path never
@@ -357,6 +548,6 @@ EntriesLike = Union[EntryBlock, Sequence[Entry]]
 
 def as_block(entries: EntriesLike) -> EntryBlock:
     """Normalize the public tuple-list API onto the columnar form."""
-    if isinstance(entries, EntryBlock):
+    if isinstance(entries, (EntryBlock, AggBlock)):
         return entries
     return EntryBlock.from_entries(list(entries))

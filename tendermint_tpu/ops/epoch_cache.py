@@ -79,6 +79,24 @@ def _secp_pad_pub() -> np.ndarray:
     return np.frombuffer(_wst.compress(_wst.G), dtype=np.uint8)
 
 
+@functools.lru_cache(maxsize=1)
+def _bls_pad_pub() -> np.ndarray:
+    """The bls12381 padding row's pubkey: the compressed G1 generator —
+    the pad lane's self-signed pad commit verifies under sk=1
+    (ops/bls_verify.PAD_MSG)."""
+    from ..crypto import bls12381 as _bls
+
+    return np.frombuffer(_bls.g1_compress(_bls.G1_GEN), dtype=np.uint8)
+
+
+def _pad_row_for(scheme: str) -> np.ndarray:
+    if scheme == "ed25519":
+        return _IDENT_ENC
+    if scheme == "bls12381":
+        return _bls_pad_pub()
+    return _secp_pad_pub()
+
+
 class EpochEntry:
     """One validator set's device-resident pubkey tables.
 
@@ -105,10 +123,11 @@ class EpochEntry:
         rows = np.empty((vp, pub_col.shape[1]), dtype=np.uint8)
         rows[:v] = pub_col
         # padding rows: the scheme's trivial gather target — ed25519's
-        # identity encoding, or secp256k1's compressed generator (the
-        # secp pad lane verifies a fixed signature under G; ops/mesh.py
-        # _secp_pad_row)
-        rows[v:] = _IDENT_ENC if scheme == "ed25519" else _secp_pad_pub()
+        # identity encoding, secp256k1's compressed generator (the secp
+        # pad lane verifies a fixed signature under G; ops/mesh.py
+        # _secp_pad_row), or bls12381's compressed G1 generator (the agg
+        # pad commit is self-signed under sk=1; ops/bls_verify)
+        rows[v:] = _pad_row_for(scheme)
         self.key = key
         self.n_vals = v
         self.vp = vp
@@ -228,12 +247,44 @@ class EpochEntry:
                 self._dev["secp"] = t
             return t
 
+    def bls_tables(self) -> Tuple:
+        """((vp, 36) int32 gx limbs, (vp, 36) int32 gy limbs, (vp,) bool
+        ok) on device — the committee's DECOMPRESSED affine G1 columns
+        for the aggregation kernel's masked point-sum
+        (ops/bls_verify.verify_kernel). Decompression (one Fp square root
+        per key) runs once per epoch on the host; rows that fail to
+        decompress or sit outside the G1 subgroup carry the generator
+        with ok False, and every padding row is (G1, True) — the pad
+        commit's sk=1 base."""
+        with self._mtx:
+            t = self._dev.get("bls")
+            if t is None:
+                _devcheck.note_relay_touch("epoch_cache.bls_tables")
+                import jax
+
+                from . import bls_verify as _bv
+
+                # table_columns_g1 appends ONE pad row itself; feed it
+                # the first vp-1 rows so the device shape lands on vp
+                gx, gy, ok = _bv.table_columns_g1(
+                    [r.tobytes() for r in self.pub_rows[: self.vp - 1]]
+                )
+                with _span("pipeline.table_upload", layout="bls",
+                           vp=self.vp):
+                    t = (jax.device_put(gx), jax.device_put(gy),
+                         jax.device_put(ok))
+                self._dev["bls"] = t
+            return t
+
     def nbytes_host(self) -> int:
         """Host bytes a FULL table upload ships (every layout the kernels
         consume) — the cold-epoch H2D cost the --transfer gate accounts."""
         if self.scheme == "secp256k1":
             # qx + qy limb tables + ok flags
             return self.vp * (2 * 20 * 4 + 1)
+        if self.scheme == "bls12381":
+            # gx + gy 36-limb tables + ok flags
+            return self.vp * (2 * 36 * 4 + 1)
         # xla limbs+sign, pallas coords+ok
         return self.vp * (20 * 4 + 4) + self.vp * (4 * 32 * 4 + 4)
 
@@ -366,6 +417,9 @@ def note_valset(vals) -> Optional[bytes]:
     if cols is None:
         cols = vals.secp256k1_columns()
         scheme = "secp256k1"
+    if cols is None:
+        cols = vals.bls12381_columns()
+        scheme = "bls12381"
     if cols is None:
         return None
     key = vals.hash()

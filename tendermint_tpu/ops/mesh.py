@@ -61,7 +61,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 try:
-    from .entry_block import EntryBlock
+    from .entry_block import AggBlock, EntryBlock, block_concat
 except ImportError:  # pragma: no cover — standalone file load (crypto-less
     # containers exec this module by path for the jax-free packing tests;
     # entry_block is numpy-only and loads the same way)
@@ -77,6 +77,8 @@ except ImportError:  # pragma: no cover — standalone file load (crypto-less
     _eb = _ilu.module_from_spec(_eb_spec)
     _eb_spec.loader.exec_module(_eb)
     EntryBlock = _eb.EntryBlock
+    AggBlock = _eb.AggBlock
+    block_concat = _eb.block_concat
 
 # single-device bucket ladder (ops/backend.BUCKETS, duplicated here so the
 # packing layer stays importable without the device stack; backend asserts
@@ -87,6 +89,28 @@ _BUCKETS = (128, 1024, 10240)
 # per-row kernel cost makes small lanes worthwhile, and the ed25519
 # kernel handles any shape the packer emits
 _LANE_BUCKET_FLOOR = 16
+
+# BLS12-381 aggregation lanes (ISSUE 20) quantize to their OWN tiny
+# ladder (backend.BLS_BUCKETS, asserted in sync at prep time): one row
+# is one whole aggregated commit costing two Miller loops, so padding an
+# agg lane out to `lane_bucket` per-signature rows would burn orders of
+# magnitude more kernel time than the live work. Superbatch row offsets
+# therefore accumulate per-lane widths instead of assuming a uniform
+# lane stride.
+_BLS_LANE_BUCKETS = (4, 16)
+
+
+def _lane_width(n: int, scheme: str, lane_bucket: int) -> int:
+    """Padded row count of one lane: `lane_bucket` for per-signature
+    schemes, the smallest BLS bucket covering `n` commits (exact above
+    the ladder top — the kernel jits per shape either way) for the
+    aggregation lane."""
+    if scheme != "bls12381":
+        return lane_bucket
+    for b in _BLS_LANE_BUCKETS:
+        if n <= b:
+            return b
+    return n
 
 
 def lanes_from_env() -> int:
@@ -187,7 +211,16 @@ class MeshPlan:
 
     @property
     def bucket(self) -> int:
-        return self.n_lanes * self.lane_bucket
+        """Total superbatch rows. With only per-signature lanes this is
+        `n_lanes * lane_bucket` (every lane strides uniformly); BLS
+        aggregation lanes contribute their own quantized width
+        (_lane_width) instead."""
+        fill = self.n_lanes - len(self.lanes)
+        s0 = self.schemes()[0] if fill else None
+        total = fill * _lane_width(0, s0, self.lane_bucket) if fill else 0
+        for l in self.lanes:
+            total += _lane_width(l.n, l.scheme, self.lane_bucket)
+        return total
 
     @property
     def live(self) -> int:
@@ -324,9 +357,14 @@ def pad_block(n: int, ep=None, scheme: str = "ed25519") -> EntryBlock:
     encoding (y = 1), s = 0, empty message — verifies trivially under
     any challenge scalar (the `_pack_rows` padding-lane construction).
     secp256k1: the fixed trivially-valid generator signature
-    (_secp_pad_row). With a warm epoch entry `ep`, rows carry the
-    table's pad-row gather index (vp - 1) and the epoch key, so a cached
+    (_secp_pad_row). bls12381: committee-free AggBlock pad rows — the
+    backend preps those from its fixed self-signed pad commit
+    (bls_verify.PAD_MSG), and AggBlock.concat lets them adopt the lane's
+    committee. With a warm epoch entry `ep`, rows carry the table's
+    pad-row gather index (vp - 1) and the epoch key, so a cached
     superbatch's padding gathers the table's own pad row."""
+    if scheme == "bls12381":
+        return AggBlock.pad(n)
     pub = np.zeros((n, 32), dtype=np.uint8)
     sig = np.zeros((n, 64), dtype=np.uint8)
     pub_aux = None
@@ -375,7 +413,13 @@ class SchemeSuperBlock:
     spans index the fused verdict row exactly as for a plain superblock;
     prepare_superbatch preps each segment with its scheme's kernel and
     the launch fn concatenates the per-segment verdicts — ONE dispatch
-    for the whole mixed commit."""
+    for the whole mixed commit.
+
+    BLS12-381 aggregation lanes (ISSUE 20) appear as one segment PER
+    LANE (an AggBlock is bound to one committee's pubkey table, so two
+    agg lanes never merge), and their verdict rows are int32 codes —
+    concatenating them with the boolean per-signature verdicts promotes
+    the fused row to int32, which demux slicing is agnostic to."""
 
     __slots__ = ("parts", "_n")
 
@@ -411,31 +455,40 @@ def build_superblock(plan: MeshPlan) -> Tuple[object, List[Tuple]]:
             seq.extend(
                 (None, s) for _ in range(plan.n_lanes - len(plan.lanes))
             )
-    pieces: dict = {s: [] for s in order}
+    # segments in seq order: per-signature lanes of one scheme merge into
+    # one contiguous EntryBlock segment; every BLS lane stays its OWN
+    # segment — agg lanes are keyed on epoch_key and two committees'
+    # AggBlocks must never cross-concat (each lane gathers from its own
+    # pubkey table)
+    segs: List[Tuple] = []  # [(scheme, [blocks])]
     spans: List[Tuple] = []
-    for pos, (lane, s) in enumerate(seq):
-        base = pos * lb
+    base = 0
+    for lane, s in seq:
+        w = _lane_width(lane.n if lane is not None else 0, s, lb)
+        blocks: List = []
+        off = 0
         if lane is not None:
-            off = 0
             for job in lane.jobs:
                 n = len(job.entries)
                 spans.append((job, base + off, n))
                 if n:
-                    pieces[s].append(job.entries)
+                    blocks.append(job.entries)
                 off += n
-            if off < lb:
-                pieces[s].append(pad_block(lb - off, ep, s))
+        if off < w:
+            blocks.append(pad_block(w - off, ep, s))
+        if s != "bls12381" and segs and segs[-1][0] == s:
+            segs[-1][1].extend(blocks)
         else:
-            # pure padding lane (lane count rounded up to pow2)
-            pieces[s].append(pad_block(lb, ep, s))
+            segs.append((s, blocks))
+        base += w
     for job in plan.empty_jobs:
         spans.append((job, 0, 0))
-    if len(order) == 1:
-        return EntryBlock.concat(pieces[order[0]]), spans
+    if len(segs) == 1 and segs[0][0] != "bls12381":
+        return EntryBlock.concat(segs[0][1]), spans
     parts: List[Tuple] = []
     off = 0
-    for s in order:
-        blk = EntryBlock.concat(pieces[s])
+    for s, blocks in segs:
+        blk = block_concat(blocks)
         parts.append((s, blk, off))
         off += len(blk)
     return SchemeSuperBlock(parts, off), spans
@@ -466,6 +519,23 @@ def _prepare_mixed_superbatch(sb: SchemeSuperBlock, donate: bool,
     flat_args: List = []
     for scheme, blk, _off in sb.parts:
         n = len(blk)
+        if scheme == "bls12381":
+            # aggregation lane (ISSUE 20): the whole segment is one
+            # committee's AggBlock at its exact quantized width — no
+            # lane_bucket padding (each pad row costs two Miller loops).
+            # masks/coeffs ship to the device; ok/reasons ride the
+            # closure for the host-side verdict-code fold.
+            bep = _backend._bls_epoch(blk)
+            vp = bep.vp if bep is not None else blk.pub48.shape[0] + 1
+            masks, coeffs, ok, reasons = _backend.prepare_batch_bls(
+                blk, n, vp, bad_rows=_backend._bls_bad_rows(blk.pub48)
+            )
+            args = (masks, coeffs)
+            fn = _backend.bls_kernel(blk, ok, reasons, ep=bep,
+                                     donate=donate)
+            seg_fns.append((fn, len(flat_args), len(flat_args) + len(args)))
+            flat_args.extend(args)
+            continue
         ep = _epoch.lookup(blk)
         if scheme == "secp256k1":
             if ep is not None:
@@ -527,6 +597,9 @@ def prepare_superbatch(block: EntryBlock, plan: MeshPlan):
     from . import sharded as _sharded
 
     assert _BUCKETS == _backend.BUCKETS, "bucket ladders diverged"
+    assert _BLS_LANE_BUCKETS == _backend.BLS_BUCKETS, (
+        "BLS bucket ladders diverged"
+    )
     bucket = plan.bucket
     if len(block) != bucket:
         raise ValueError(

@@ -102,8 +102,47 @@ def _sr_compile_timeout() -> float:
     return float(os.environ.get("TM_TPU_SR_COMPILE_TIMEOUT", "300"))
 
 
+# host-fallback pool for sr25519 (satellite of ISSUE 20, mirroring the
+# secp pool): the native schnorrkel batch call computes outside the GIL,
+# so splitting a big batch across workers scales ~linearly; the
+# pure-Python fallback is GIL-held bignum math and stays single-threaded
+SR_HOST_POOL_MIN = int(os.environ.get("TM_TPU_SR_HOST_POOL_MIN", "32"))
+
+
+def _sr_host_workers() -> int:
+    w = os.environ.get("TM_TPU_SR_HOST_WORKERS")
+    if w is not None:
+        return max(1, int(w))
+    return max(1, min(8, (os.cpu_count() or 1)))
+
+
+def _sr_native_batch_available() -> bool:
+    from ..native import load as _load_native
+
+    native = _load_native()
+    return native is not None and hasattr(native, "sr25519_verify_batch")
+
+
 def _host_sr_batch(entries) -> np.ndarray:
-    return np.array(_sr.verify_batch(list(entries)), dtype=bool)
+    """Host sr25519 verdicts, thread-pooled over native batch chunks.
+    Small batches (or the pure-Python fallback, where threads would only
+    interleave GIL-held math) run the single verify_batch call."""
+    entries = list(entries)
+    n = len(entries)
+    workers = _sr_host_workers()
+    if (
+        n < SR_HOST_POOL_MIN
+        or workers < 2
+        or not _sr_native_batch_available()
+    ):
+        return np.array(_sr.verify_batch(entries), dtype=bool)
+    from concurrent.futures import ThreadPoolExecutor
+
+    step = -(-n // workers)
+    chunks = [entries[i:i + step] for i in range(0, n, step)]
+    with ThreadPoolExecutor(max_workers=len(chunks)) as pool:
+        parts = list(pool.map(_sr.verify_batch, chunks))
+    return np.concatenate([np.asarray(p, dtype=bool) for p in parts])
 
 
 def _sr_device_enabled() -> bool:

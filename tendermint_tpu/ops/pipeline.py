@@ -39,7 +39,7 @@ from . import backend as _backend
 from . import device_pool as _dpool
 from . import ed25519_verify as _kernel
 from . import mesh as _mesh
-from .entry_block import EntryBlock, as_block
+from .entry_block import EntryBlock, as_block, block_concat
 
 _span = _trace.span
 
@@ -84,7 +84,10 @@ class _Readback:
 
     def __init__(self, dev, start_async: bool):
         self.dev = dev
-        if start_async:
+        # a launch closure may hand back a host array (the BLS lane's
+        # two-launch protocol reduces residues host-side and returns the
+        # verdict-code row as numpy) — nothing left to copy back then
+        if start_async and hasattr(dev, "copy_to_host_async"):
             dev.copy_to_host_async()
 
     def wait(self) -> np.ndarray:
@@ -510,6 +513,25 @@ class AsyncBatchVerifier:
         # XLA recycles the pages; epoch tables stay exempt in every
         # kernel's donate_argnums
         donate = _backend.donate_enabled()
+        if getattr(entries, "scheme", "ed25519") == "bls12381":
+            # aggregation lane (ISSUE 20): one row = one whole commit.
+            # `ep` above is None by construction (AggBlocks carry no
+            # gather indices); the lane keys its epoch on the bitmap's
+            # committee directly.
+            ep = _backend._bls_epoch(entries)
+            bucket = _backend._bls_bucket_for(len(entries))
+            vp = ep.vp if ep is not None else entries.pub48.shape[0] + 1
+            with _span("pipeline.prep", n=len(entries), bucket=bucket,
+                       cached=int(ep is not None), scheme="bls12381"):
+                masks, coeffs, ok, reasons = _backend.prepare_batch_bls(
+                    entries, bucket, vp,
+                    bad_rows=_backend._bls_bad_rows(entries.pub48),
+                )
+                kern = _backend.bls_kernel(
+                    entries, ok, reasons, ep=ep, donate=donate
+                )
+            _backend._note_device_batch(len(entries), bucket)
+            return kern, (masks, coeffs), None, bucket
         if getattr(entries, "scheme", "ed25519") == "secp256k1":
             # scheme lane (ISSUE 19): the Strauss+GLV ECDSA kernel.
             # Plain XLA jit only — no pallas/RLC face for secp yet
@@ -805,11 +827,15 @@ class AsyncBatchVerifier:
                     off += len(j.entries)
                 # columnar coalescing: one concatenate per column instead
                 # of a per-signature list-extend; a single-job dispatch
-                # passes its EntryBlock through BY IDENTITY (zero copies)
+                # passes its block through BY IDENTITY (zero copies).
+                # block_concat dispatches on block type — the scheme gate
+                # above keeps a window homogeneous (AggBlocks carry
+                # scheme "bls12381"), so agg commits coalesce with agg
+                # commits only.
                 entries = (
                     jobs[0].entries
                     if len(jobs) == 1
-                    else EntryBlock.concat([j.entries for j in jobs])
+                    else block_concat([j.entries for j in jobs])
                 )
                 # a fused batch inherits the most urgent class of its
                 # jobs: a consensus job fused with ingress stragglers

@@ -18,6 +18,11 @@ and drives combined load through one shared `AsyncBatchVerifier`:
 - **replay** — a node crashed early rejoins via `CatchupDriver`
   (optionally from 1000+ heights behind with `catchup_at_height`),
   its ReplayEngine injected with the SAME shared verifier.
+- **bls_agg** (ISSUE 20) — an aggregated-commit echo probe: a
+  pre-signed BLS12-381 `AggregatedCommit` rides the same shared
+  verifier each tick at `PRIORITY_CONSENSUS`, exercising the full
+  prepare → AggBlock → fused-pairing-launch → conclude seam under
+  mixed load, with its own wall-latency SLO budget.
 
 A `TelemetrySampler` snapshots the gauge/counter surfaces on a SimClock
 cadence; declarative `SLOBudget`s (consensus commit p99, light verdict
@@ -41,7 +46,8 @@ TM_TPU_SOAK_SAMPLE_S, TM_TPU_SOAK_WARMUP_S, TM_TPU_SOAK_TX_BURST,
 TM_TPU_SOAK_LIGHT_FLEET, TM_TPU_SOAK_INGRESS_TIMEOUT_S,
 TM_TPU_SOAK_CATCHUP_AT_HEIGHT, TM_TPU_SOAK_CONSENSUS_P99_MS,
 TM_TPU_SOAK_LIGHT_P99_MS, TM_TPU_SOAK_INGRESS_P99_MS,
-TM_TPU_SOAK_REPLAY_HPS, TM_TPU_SOAK_MAX_WALL_S.
+TM_TPU_SOAK_REPLAY_HPS, TM_TPU_SOAK_MAX_WALL_S,
+TM_TPU_SOAK_BLS_P99_MS, TM_TPU_SOAK_BLS_COMMITTEE.
 """
 
 from __future__ import annotations
@@ -106,11 +112,16 @@ class SoakConfig:
     # partition/heal across the tx flood (partition_at_s <= 0 disables)
     partition_at_s: float = 6.0
     partition_heal_s: float = 3.0
+    # bls aggregated-commit echo probe (ISSUE 20; committee <= 0 disables)
+    bls_echo_every_s: float = 1.0
+    bls_committee: int = 4
+    bls_echo_timeout_s: float = 60.0
     # SLO budgets
     consensus_commit_p99_ms: float = 15000.0  # VIRTUAL ms (partition stall fits)
     light_verdict_p99_ms: float = 30000.0     # wall
     ingress_admission_p99_ms: float = 10000.0  # wall
     replay_min_heights_per_s: float = 10.0    # virtual heights/s
+    bls_echo_p99_ms: float = 30000.0          # wall
 
     @classmethod
     def from_env(cls, **overrides) -> "SoakConfig":
@@ -132,6 +143,10 @@ class SoakConfig:
                                             cls.ingress_admission_p99_ms),
             replay_min_heights_per_s=_env_f("TM_TPU_SOAK_REPLAY_HPS",
                                             cls.replay_min_heights_per_s),
+            bls_echo_p99_ms=_env_f("TM_TPU_SOAK_BLS_P99_MS",
+                                   cls.bls_echo_p99_ms),
+            bls_committee=_env_i("TM_TPU_SOAK_BLS_COMMITTEE",
+                                 cls.bls_committee),
             max_wall_s=_env_f("TM_TPU_SOAK_MAX_WALL_S", cls.max_wall_s),
         )
         gap = os.environ.get("TM_TPU_SOAK_CATCHUP_AT_HEIGHT", "")
@@ -209,9 +224,12 @@ class SoakDriver:
         self._echo_next = 2
         self._light_anchor = None
         self._tx_nonce = 0
+        self._bls = None          # built lazily on first bls tick
         # lane counters (all surfaced in the result record)
         self.echo_submitted = 0
         self.echo_errors = 0
+        self.bls_echoes = 0
+        self.bls_echo_errors = 0
         self.light_verdicts = 0
         self.light_rejects = 0
         self.light_timeouts = 0
@@ -309,6 +327,79 @@ class SoakDriver:
                 except Exception:  # noqa: BLE001
                     self.echo_errors += 1
         c.clock.call_later(cfg.echo_every_s, self._echo_tick)
+
+    # -- bls aggregation lane (ISSUE 20) -----------------------------------
+
+    def _bls_setup(self) -> dict:
+        """One-time probe state: a BLS12-381 committee, one height-1
+        AggregatedCommit signed by every member. Signing (hash-to-G2 +
+        cofactor clearing) is pure-python-slow, so it happens ONCE; each
+        tick then re-verifies the same aggregate — host prep, the
+        masked-apk point sum, and the fused pairing launch all run per
+        tick, exactly like a validator re-checking gossiped commits."""
+        from ..crypto import bls12381 as _bls
+        from ..libs.bits import BitArray
+        from ..ops import epoch_cache as _epoch
+        from ..types.block import AggregatedCommit, BlockID, PartSetHeader
+        from ..types.validator_set import Validator, ValidatorSet
+
+        cfg = self.cfg
+        privs = [
+            _bls.PrivKey((cfg.seed * 7919 + i + 1).to_bytes(32, "big"))
+            for i in range(cfg.bls_committee)
+        ]
+        vals = [Validator.new(p.pub_key(), 100) for p in privs]
+        vset = ValidatorSet(validators=vals, proposer=vals[0])
+        _epoch.note_valset(vset)
+        bid = BlockID(
+            hash=b"\x14" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x14" * 32))
+        signers = BitArray(len(vals))
+        for i in range(len(vals)):
+            signers.set_index(i, True)
+        probe = AggregatedCommit(height=1, round=0, block_id=bid,
+                                 signers=signers)
+        msg = probe.sign_bytes(self.cluster.chain_id)
+        sig = _bls.aggregate([p.sign(msg) for p in privs])
+        return {
+            "vset": vset,
+            "bid": bid,
+            "agg": AggregatedCommit(height=1, round=0, block_id=bid,
+                                    signature=sig, signers=signers),
+        }
+
+    def _bls_tick(self) -> None:
+        """Aggregated-commit echo: the pre-signed AggregatedCommit rides
+        the shared verifier at PRIORITY_CONSENSUS through the fused
+        multi-pairing lane (k_hint above BLS_DEVICE_THRESHOLD keeps it
+        off the synchronous oracle path)."""
+        if not self._live():
+            return
+        import numpy as _np
+
+        from ..ops import pipeline as _pl
+        from ..types import validation as _val
+
+        c, cfg = self.cluster, self.cfg
+        if self._bls is None:
+            self._bls = self._bls_setup()
+        st = self._bls
+        t_v, t_w = c.clock.time(), time.perf_counter()
+        try:
+            blk, conc = _val.prepare_aggregated_commit(
+                c.chain_id, st["vset"], st["bid"], 1, st["agg"], k_hint=4)
+            fut = self.v.submit(blk, priority=_pl.PRIORITY_CONSENSUS)
+            conc(_np.asarray(fut.result(timeout=cfg.bls_echo_timeout_s)))
+            self._record("bls_agg", t_v,
+                         (time.perf_counter() - t_w) * 1e3, t_w)
+            self.bls_echoes += 1
+        except _cfut.TimeoutError:
+            self.bls_echo_errors += 1
+            self._record("bls_agg", t_v, cfg.bls_echo_timeout_s * 1e3,
+                         t_w, always=True)
+        except Exception:  # noqa: BLE001 — probe must not kill the run
+            self.bls_echo_errors += 1
+        c.clock.call_later(cfg.bls_echo_every_s, self._bls_tick)
 
     # -- light lane --------------------------------------------------------
 
@@ -439,7 +530,14 @@ class SoakDriver:
                 _ts.KIND_RATE_MIN, cfg.replay_min_heights_per_s,
                 description="catch-up replay throughput in virtual "
                             "heights/s"),
-        ]
+        ] + ([
+            _ts.SLOBudget(
+                "bls_agg_p99_ms", "bls_agg",
+                _ts.KIND_P99_MS_MAX, cfg.bls_echo_p99_ms,
+                min_samples=3,
+                description="aggregated-commit echo wall latency through "
+                            "the fused BLS pairing lane"),
+        ] if cfg.bls_committee > 0 else [])
 
     # -- the run -----------------------------------------------------------
 
@@ -481,6 +579,8 @@ class SoakDriver:
             c.clock.call_later(cfg.echo_every_s, self._echo_tick)
             c.clock.call_later(cfg.light_every_s, self._light_tick)
             c.clock.call_later(cfg.tx_every_s, self._tx_tick)
+            if cfg.bls_committee > 0:
+                c.clock.call_later(cfg.bls_echo_every_s, self._bls_tick)
             c.clock.run_until(
                 predicate=((lambda: self._abort_reason is not None)
                            if cfg.fail_fast else None),
@@ -567,6 +667,8 @@ class SoakDriver:
                 "counters": {
                     "echo_submitted": self.echo_submitted,
                     "echo_errors": self.echo_errors,
+                    "bls_echoes": self.bls_echoes,
+                    "bls_echo_errors": self.bls_echo_errors,
                     "light_verdicts": self.light_verdicts,
                     "light_rejects": self.light_rejects,
                     "light_timeouts": self.light_timeouts,

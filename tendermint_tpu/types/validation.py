@@ -470,6 +470,163 @@ def prepare_commit_scheme_split(
     return blocks, conclude
 
 
+# -- BLS12-381 aggregated commits (ISSUE 20) --------------------------------
+#
+# Blame strings are built ONCE by the helpers below and shared by the
+# sequential reference walk and the batched conclude(), so the
+# byte-exactness the acceptance gate pins cannot drift between paths.
+
+_AGG_APK_IDENTITY = "aggregate pubkey is the identity"
+
+
+def _agg_sig_blame(word: str, sig: bytes) -> str:
+    return f"{word} aggregate signature: {sig.hex().upper()}"
+
+
+def _agg_pub_blame(word: str, idx: int) -> str:
+    return f"{word} aggregate pubkey (validator #{idx})"
+
+
+def _agg_basic_and_tally(vals, block_id, height, agg,
+                         voting_power_needed: int):
+    """Shared host half of both aggregated-commit paths: shape checks,
+    bitmap-size sanity, then the power tally — which runs BEFORE any
+    crypto (a commit that cannot reach quorum must not spend pairings).
+    Returns the signer validator rows in ascending order."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if agg is None:
+        raise ValueError("nil commit")
+    if agg.signers is None or agg.signers.size() != vals.size():
+        raise ErrInvalidCommitSignatures(
+            vals.size(),
+            agg.signers.size() if agg.signers is not None else 0,
+        )
+    if height != agg.height:
+        raise ErrInvalidCommitHeight(height, agg.height)
+    if block_id != agg.block_id:
+        raise ValueError(
+            f"invalid commit -- wrong block ID: want {block_id}, "
+            f"got {agg.block_id}"
+        )
+    idxs = agg.signers.get_true_indices()
+    tallied = sum(vals.validators[i].voting_power for i in idxs)
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(
+            got=tallied, needed=voting_power_needed
+        )
+    return idxs
+
+
+def verify_aggregated_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, agg
+) -> None:
+    """Sequential reference for an aggregated commit (the pure-Python
+    oracle walk the batched path is pinned byte-exact against). Check
+    order IS the contract: basic shape -> bitmap size -> power tally
+    (before any crypto) -> aggregate signature status -> pubkey statuses
+    in ascending validator order -> apk-is-identity -> the one pairing
+    check."""
+    from ..crypto import bls12381 as _bls
+
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    with _span("verify_agg_commit", n=1, height=height):
+        idxs = _agg_basic_and_tally(
+            vals, block_id, height, agg, voting_power_needed
+        )
+        sig = bytes(agg.signature)
+        _, reason = _bls.signature_status(sig)
+        if reason is not None:
+            raise ValueError(_agg_sig_blame(reason, sig))
+        pubs = []
+        for i in idxs:
+            pub = vals.validators[i].pub_key.bytes()
+            _, preason = _bls.pubkey_status(pub)
+            if preason is not None:
+                raise ValueError(_agg_pub_blame(preason, i))
+            pubs.append(pub)
+        apk, _ = _bls.aggregate_pubkeys(pubs)
+        if apk is None:
+            raise ValueError(_AGG_APK_IDENTITY)
+        if not _bls.fast_aggregate_verify(
+            pubs, agg.sign_bytes(chain_id), sig
+        ):
+            raise ValueError(_agg_sig_blame("wrong", sig))
+
+
+def prepare_aggregated_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    agg,
+    k_hint: int = 1,
+):
+    """The async-seam half for an aggregated commit: host checks run
+    here (raising exactly what the sequential walk raises), then the
+    commit is returned as a one-row AggBlock plus a conclude(codes)
+    decoding the device lane's int32 verdict code back into the SAME
+    pinned blame strings. The shared pipeline coalesces same-committee
+    AggBlocks, so K concurrent commits still land in one fused
+    multi-pairing launch.
+
+    `k_hint` is the caller's concurrency estimate: below
+    backend.BLS_DEVICE_THRESHOLD a fused launch cannot amortize its
+    final exponentiation, so the commit verifies synchronously through
+    the oracle and (None, None) is returned."""
+    from ..ops import backend as _backend
+
+    if k_hint < _backend.BLS_DEVICE_THRESHOLD:
+        verify_aggregated_commit(chain_id, vals, block_id, height, agg)
+        return None, None
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    idxs = _agg_basic_and_tally(
+        vals, block_id, height, agg, voting_power_needed
+    )
+    cols = vals.bls12381_columns()
+    if cols is None:
+        raise PrepareUnsupported(
+            "validator set is not bls12381-columnar"
+        )
+    pub48 = cols[0]
+    import numpy as _np
+
+    from ..ops import epoch_cache as _epoch
+    from ..ops.entry_block import AggBlock
+
+    bits = _np.zeros(vals.size(), dtype=bool)
+    bits[idxs] = True
+    _epoch.note_valset(vals)  # register/refresh the G1 epoch tables
+    sig = bytes(agg.signature)
+    blk = AggBlock.from_commits(
+        [(bits, agg.sign_bytes(chain_id), sig)], pub48, vals.hash()
+    )
+
+    def conclude(codes) -> None:
+        from ..crypto import bls12381 as _bls
+        from ..ops import bls_verify as _bv
+
+        code = int(_np.asarray(codes).reshape(-1)[0])
+        if code == _bv.CODE_VALID:
+            return
+        if code == _bv.CODE_PAIRING:
+            raise ValueError(_agg_sig_blame("wrong", sig))
+        if code == _bv.CODE_APK_IDENTITY:
+            raise ValueError(_AGG_APK_IDENTITY)
+        word = _bv.SIG_CODE_WORDS.get(code)
+        if word is not None:
+            raise ValueError(_agg_sig_blame(word, sig))
+        if code >= _bv.CODE_PUB_BASE:
+            i = code - _bv.CODE_PUB_BASE
+            # the word re-derives from the committee snapshot — the
+            # status is memoized per key bytes, so this is a dict hit
+            word = _bls.pubkey_status(pub48[i].tobytes())[1]
+            raise ValueError(_agg_pub_blame(word or "malformed", i))
+        raise RuntimeError(f"BUG: unknown BLS verdict code {code}")
+
+    return blk, conclude
+
+
 def _select_commit_sigs(
     vals: ValidatorSet,
     commit: Commit,

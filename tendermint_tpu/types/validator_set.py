@@ -27,6 +27,9 @@ _NO_ED_COLS = object()
 # secp256k1_columns cache sentinel (same protocol)
 _NO_SECP_COLS = object()
 
+# bls12381_columns cache sentinel (same protocol)
+_NO_BLS_COLS = object()
+
 
 def _clip64(v: int) -> int:
     return max(INT64_MIN, min(INT64_MAX, v))
@@ -141,6 +144,7 @@ class ValidatorSet:
         self._hash: Optional[bytes] = None
         self._ed_cols: Optional[tuple] = None
         self._secp_cols: Optional[tuple] = None
+        self._bls_cols: Optional[tuple] = None
 
     # ---- construction -------------------------------------------------
 
@@ -179,6 +183,7 @@ class ValidatorSet:
         c._hash = self._hash
         c._ed_cols = self._ed_cols
         c._secp_cols = self._secp_cols
+        c._bls_cols = self._bls_cols
         return c
 
     # ---- queries ------------------------------------------------------
@@ -332,6 +337,40 @@ class ValidatorSet:
         self._secp_cols = cols if cols is not None else _NO_SECP_COLS
         return cols
 
+    def bls12381_columns(self) -> Optional[tuple]:
+        """(pub (n, 48) uint8, power (n,) int64) columns over the set, or
+        None unless EVERY validator key is bls12381 — the aggregation
+        lane's committee snapshot (ISSUE 20): prepare_aggregated_commit
+        carries these compressed G1 rows on the AggBlock and the epoch
+        cache keys its decompressed G1 limb table on the same hash().
+        Cached; invalidated alongside the hash cache by
+        _update_with_change_set and shared by copy()."""
+        if self._bls_cols is not None:
+            cols = self._bls_cols
+            return cols if cols is not _NO_BLS_COLS else None
+        import numpy as np
+
+        from ..crypto import bls12381 as _bls
+
+        vals = self.validators
+        n = len(vals)
+        cols = None
+        if n and all(
+            isinstance(v.pub_key, _bls.PubKey) for v in vals
+        ):
+            pub_b = b"".join(v.pub_key.bytes() for v in vals)
+            if len(pub_b) == 48 * n:
+                cols = (
+                    np.frombuffer(pub_b, dtype=np.uint8).reshape(n, 48),
+                    np.fromiter(
+                        (v.voting_power for v in vals),
+                        dtype=np.int64,
+                        count=n,
+                    ),
+                )
+        self._bls_cols = cols if cols is not None else _NO_BLS_COLS
+        return cols
+
     def scheme_rows(self) -> Optional[tuple]:
         """Per-validator scheme partition for MIXED device-batchable sets
         (ISSUE 19 tentpole c): (kinds (n,) uint8 — 0 = ed25519, 1 =
@@ -452,6 +491,7 @@ class ValidatorSet:
         self._hash = None  # membership/power may change below
         self._ed_cols = None
         self._secp_cols = None
+        self._bls_cols = None
         if not changes:
             return
         updates, deletes = _process_changes(changes)

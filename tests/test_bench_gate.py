@@ -54,7 +54,7 @@ def test_pins_file_is_wellformed():
 @pytest.mark.parametrize(
     "kind",
     ["bench", "multichip", "light", "mempool", "blocksync", "votes", "soak",
-     "fleet", "schemes"],
+     "fleet", "schemes", "agg"],
 )
 def test_ratchet_gate(kind, capsys):
     """--compare pinned-last-good → newest-committed must pass the gate.
@@ -141,6 +141,23 @@ def test_schemes_artifact_meets_acceptance_floor():
     assert art["vs_per_sig"] >= 10.0
     assert art["launches"] == 1
     assert art["vals"] >= 10_000
+
+
+def test_agg_artifact_meets_acceptance_floor():
+    """ISSUE 20 acceptance pinned into tier-1: the committed
+    aggregation-lane artifact must show K commits fused into one
+    multi-pairing launch (pairings amortized under 2 per commit) and the
+    128-validator aggregated commit within 1/10 of the per-signature
+    ed25519 commit on the wire. bench.py bls already exits nonzero past
+    these floors; this keeps the COMMITTED record honest."""
+    latest = _latest_of_kind("agg")
+    assert latest is not None, "no AGG_r*.json committed"
+    with open(os.path.join(REPO_ROOT, latest)) as fh:
+        art = json.load(fh)
+    assert art["pairings_per_commit"] < 2.0
+    assert art["wire_ratio_vs_ed25519"] <= 0.10
+    assert art["launches"] == 1
+    assert art["vals"] >= 128
 
 
 def test_light_artifact_in_trajectory(capsys):

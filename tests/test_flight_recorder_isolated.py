@@ -353,7 +353,8 @@ class TestCommittedArtifacts:
         rows = [ln for ln in out.splitlines()
                 if ln.startswith(("bench_r", "multichip_r", "light_r",
                                   "mempool_r", "blocksync_r", "votes_r",
-                                  "soak_r", "lanes_r"))]
+                                  "soak_r", "lanes_r", "fleet_r",
+                                  "schemes_r", "agg_r"))]
         assert len(rows) == n, out
         assert any("152,542" in ln or "152542" in ln for ln in rows), (
             "r03's sustained figure must survive normalization"
@@ -364,7 +365,8 @@ class TestCommittedArtifacts:
         rows = json.loads(capsys.readouterr().out)
         assert {r["kind"] for r in rows} == {"bench", "multichip", "light",
                                              "mempool", "blocksync", "votes",
-                                             "soak", "lanes"}
+                                             "soak", "lanes", "fleet",
+                                             "schemes", "agg"}
         r5 = next(r for r in rows
                   if r["kind"] == "bench" and r["round"] == 5)
         assert r5["kernel_stream"] == pytest.approx(470560.0)

@@ -57,7 +57,7 @@ def test_mini_soak_smoke_green_and_replay_exact(tmp_path, seed, duration):
     assert v["ok"] is True, v["reason"]
     assert v["replay_exact"] is True and v["runs"] == 2
     assert v["mode"] == "mocked-relay"
-    assert v["slo"]["ok"] and v["slo"]["evaluated"] == 4
+    assert v["slo"]["ok"] and v["slo"]["evaluated"] == 5
     assert v["violations"] == []
     # every workload lane demonstrably ran (a lane that silently no-ops
     # would still produce a "green" verdict — refuse that)
@@ -65,6 +65,11 @@ def test_mini_soak_smoke_green_and_replay_exact(tmp_path, seed, duration):
     assert c["echo_submitted"] > 0 and c["echo_errors"] == 0
     assert c["light_verdicts"] > 0 and c["light_timeouts"] == 0
     assert c["ingress_admitted"] > 0 and c["ingress_timeouts"] == 0
+    # aggregated-commit echo probe (ISSUE 20): rode the shared verifier
+    # through the fused BLS pairing seam, its SLO evaluated
+    assert c["bls_echoes"] > 0 and c["bls_echo_errors"] == 0
+    assert any(b["slo"] == "bls_agg_p99_ms" and b["ok"]
+               for b in v["slo"]["results"])
     cu = v["catchup"][0]
     assert cu["rejoined"] and cu["heights_applied"] > 0
     # the shared verifier saw both consensus-priority and ingress traffic

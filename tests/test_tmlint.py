@@ -324,6 +324,51 @@ class TestRelayOwnership:
         """
         assert not lint(src, LIGHT_PATH, "relay-ownership")
 
+    # -- ISSUE 20: BLS aggregation lane launch builders --------------------
+
+    def test_positive_bls_pairing_launch_outside_whitelist(self):
+        """ISSUE 20 satellite: jitting the fused multi-pairing kernel or
+        driving the direct BLS code-row path outside the dispatcher
+        whitelist is flagged — aggregated commits reach the device only
+        through AsyncBatchVerifier / the mesh."""
+        src = """
+            from tendermint_tpu.ops import bls_verify
+
+            def sneaky_pairing(gx, gy, masks, coeffs):
+                fn = bls_verify.jitted_bls_verify(True)
+                return fn(gx, gy, masks, coeffs)
+        """
+        assert rules_of(lint(src, REACTOR_PATH)) == ["relay-ownership"]
+        src_kern = """
+            def sneaky_kernel(_backend, blk):
+                return _backend.bls_kernel(blk.bucket)(blk.rows)
+        """
+        assert rules_of(lint(src_kern, REACTOR_PATH)) == ["relay-ownership"]
+        src_codes = """
+            from tendermint_tpu.ops.backend import verify_batch_bls_codes
+
+            def sneaky_codes(blk):
+                return verify_batch_bls_codes(blk)
+        """
+        assert rules_of(lint(src_codes, REACTOR_PATH)) == ["relay-ownership"]
+
+    def test_negative_bls_kernel_module_is_whitelisted(self):
+        """The kernel-definition module and the sanctioned direct path in
+        ops/backend.py hold these call sites legitimately."""
+        src = """
+            def _warm(gx, gy, masks, coeffs):
+                return jitted_bls_verify(False)(gx, gy, masks, coeffs)
+        """
+        assert not lint(src, "tendermint_tpu/ops/bls_verify.py",
+                        "relay-ownership")
+        src_backend = """
+            def verify_batch_bls(blk):
+                codes = verify_batch_bls_codes(blk)
+                return codes == 1
+        """
+        assert not lint(src_backend, "tendermint_tpu/ops/backend.py",
+                        "relay-ownership")
+
 
 class TestFleetTransport:
     """ISSUE 18: the fleet wire codec has exactly three sanctioned homes

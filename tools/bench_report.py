@@ -97,6 +97,11 @@ METRIC_KEYS = (
     # scheme-lane artifacts (SCHEMES_r*, ISSUE 19); the headline "value"
     # is counted secp256k1 commit sigs/s through ONE relay launch
     "secp_seq_sigs_per_s", "vs_per_sig", "launches", "sigs_counted",
+    # aggregation-lane artifacts (AGG_r*, ISSUE 20); the headline "value"
+    # is aggregated BLS commits/s through the fused multi-pairing launch
+    "pairings_per_commit", "sigs_replaced_per_pairing",
+    "wire_ratio_vs_ed25519", "agg_wire_bytes", "ed25519_wire_bytes",
+    "commits",
 )
 
 # gate semantics: for these, SMALLER is better (a rise is the regression)
@@ -110,6 +115,9 @@ _LOWER_IS_BETTER = {
     # lanes-curve idle latencies regress on a RISE
     "lanes_adaptive_idle_p99_ms", "lanes_shallow_idle_p99_ms",
     "lanes_deep_idle_p99_ms",
+    # aggregation-lane economics regress on a RISE: more pairings per
+    # commit or more wire bytes than the pinned round
+    "pairings_per_commit", "wire_ratio_vs_ed25519",
 }
 
 # keys a COMPARE tracks by default (rate-like, present across most rounds)
@@ -120,12 +128,12 @@ COMPARE_KEYS = (
     "vs_kernel_serial", "consensus_commit_p99_ms", "light_verdict_p99_ms",
     "ingress_admission_p99_ms", "replay_heights_per_s",
     "lanes_adaptive_idle_p99_ms", "lanes_adaptive_sigs_per_window",
-    "vs_per_sig",
+    "vs_per_sig", "pairings_per_commit", "wire_ratio_vs_ed25519",
 )
 
 _NAME_RE = re.compile(
     r"(BENCH|MULTICHIP|LIGHT|MEMPOOL|BLOCKSYNC|VOTES|SOAK|LANES|FLEET"
-    r"|SCHEMES)_r(\d+)",
+    r"|SCHEMES|AGG)_r(\d+)",
     re.I)
 
 
@@ -248,6 +256,7 @@ def default_paths(root: str = REPO) -> List[str]:
     paths += sorted(glob.glob(os.path.join(root, "LANES_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "FLEET_r*.json")))
     paths += sorted(glob.glob(os.path.join(root, "SCHEMES_r*.json")))
+    paths += sorted(glob.glob(os.path.join(root, "AGG_r*.json")))
     return paths
 
 
@@ -266,7 +275,7 @@ def validate(art: dict) -> List[str]:
         return probs
     if art["kind"] not in ("bench", "multichip", "light", "mempool",
                            "blocksync", "votes", "soak", "lanes", "fleet",
-                           "schemes"):
+                           "schemes", "agg"):
         probs.append(f"unknown kind {art['kind']!r}")
     if art["round"] is None:
         probs.append("cannot derive the round number (filename or 'n')")

@@ -894,6 +894,287 @@ def run_schemes(args) -> int:
     return rc
 
 
+def run_bls(args) -> int:
+    """--bls: the ISSUE 20 aggregation-lane gate. K aggregated commits
+    (ONE BLS signature + a signer bitmap each) must verify in a single
+    fused multi-pairing launch with verdicts AND blame byte-identical
+    to the pure-Python reference walk (crypto/bls12381.py). Every
+    kernel runs REAL — correctness is the gate; throughput is
+    `bench.py bls` (AGG_r*.json). Asserts:
+
+      wire     AggregatedCommit proto roundtrip, and the commit ships
+               96 sig bytes + ceil(V/8) bitmap bytes instead of V
+               per-signature rows
+      codes    the fused K=4 launch (good / forged / non-subgroup sig /
+               non-subgroup pubkey) returns exactly the verdict codes
+               the host prep + kernel contract pins — including a
+               CRAFTED on-curve-but-out-of-subgroup G2 signature and
+               G1 pubkey (rejecting those is what makes apk
+               aggregation sound)
+      blame    conclude() raises the EXACT string of the sequential
+               verify_aggregated_commit walk for every row — pairing
+               failure, subgroup sig, subgroup pubkey (validator #i),
+               and the pre-crypto wrong-bitmap-size reject
+      lanes    an ed25519 + secp256k1 + bls12381 three-lane superbatch
+               builds ONE SchemeSuperBlock (BLS segment at its
+               quantized width 4, NOT the per-sig lane bucket) and a
+               single launch fn call verifies all three lanes; the
+               async pipeline fuses the same three submissions into
+               ONE dispatch (launch count from the tracer)
+      no leak  zero buffer-pool slots in flight once drained
+    """
+    import jax
+
+    from tendermint_tpu.libs import jaxcache
+
+    jaxcache.enable(jax, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    os.environ["TM_TPU_MESH_LANE_BUCKET"] = "16"
+
+    from tendermint_tpu.crypto import bls12381 as bls
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.crypto import secp256k1 as _secp
+    from tendermint_tpu.libs.bits import BitArray
+    from tendermint_tpu.observability import trace as tr
+    from tendermint_tpu.ops import backend, device_pool as dp, mesh as ms
+    from tendermint_tpu.ops import epoch_cache as _epoch
+    from tendermint_tpu.ops import pipeline as pl
+    from tendermint_tpu.ops._testing import drain_pool
+    from tendermint_tpu.ops.entry_block import EntryBlock
+    from tendermint_tpu.types import BlockID, PartSetHeader, Validator, ValidatorSet
+    from tendermint_tpu.types import validation as V
+    from tendermint_tpu.types.block import AggregatedCommit
+    from tendermint_tpu.types.validation import ErrInvalidCommitSignatures
+
+    chain_id = "bls-gate"
+    rc = 0
+
+    # -- craft on-curve, out-of-subgroup points (the subgroup rows) -----
+    def bad_g1():
+        x = 1
+        while True:
+            y2 = (x * x * x + bls.B) % bls.P
+            y = bls.fp_sqrt(y2)
+            if y is not None and not bls.g1_in_subgroup((x, y)):
+                return bls.g1_compress((x, y))
+            x += 1
+
+    def bad_g2():
+        c = 1
+        while True:
+            x = (c, 0)
+            y2 = bls.f2_add(bls.f2_mul(x, bls.f2_sqr(x)),
+                            bls.f2_scalar(bls.XI, bls.B))
+            y = bls.f2_sqrt(y2)
+            if y is not None and not bls.g2_in_subgroup((x, y)):
+                return bls.g2_compress((x, y))
+            c += 1
+
+    rogue_pub, rogue_sig = bad_g1(), bad_g2()
+    st_pub = bls.pubkey_status(rogue_pub)[1]
+    st_sig = bls.signature_status(rogue_sig)[1]
+    print(f"prep_bench --bls: crafted subgroup violations "
+          f"(pub={st_pub}, sig={st_sig}) lane_bucket=16")
+    if st_pub != "subgroup" or st_sig != "subgroup":
+        print("  FAIL: crafted points must decompress on-curve but fail "
+              "the subgroup check", file=sys.stderr)
+        return 1
+
+    # -- committee: 7 real signers + 1 rogue (non-subgroup) pubkey ------
+    n_vals = 8
+    sks = [bls.PrivKey((i + 1).to_bytes(32, "big")) for i in range(7)]
+    vals = [Validator.new(sk.pub_key(), 100) for sk in sks]
+    vals.append(Validator.new(bls.PubKey(rogue_pub), 100))
+    vset = ValidatorSet.new(vals)
+    by_addr = {sk.pub_key().address(): sk for sk in sks}
+    order = [by_addr.get(v.address) for v in vset.validators]
+    rogue_idx = order.index(None)
+    real = [i for i in range(n_vals) if i != rogue_idx]
+    bid = BlockID(hash=b"\x14" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x15" * 32))
+
+    def make_agg(signers, forge=False, sig=None):
+        ba = BitArray(n_vals)
+        for i in signers:
+            ba.set_index(i, True)
+        agg = AggregatedCommit(height=9, round=0, block_id=bid, signers=ba)
+        if sig is not None:
+            agg.signature = sig
+            return agg
+        msg = agg.sign_bytes(chain_id)
+        parts = [order[i].sign(msg) for i in signers if order[i] is not None]
+        if forge:
+            parts[-1] = order[signers[-1]].sign(b"not-the-vote")
+        agg.signature = bls.aggregate(parts)
+        return agg
+
+    def seq_error(agg):
+        try:
+            V.verify_aggregated_commit(chain_id, vset, bid, 9, agg)
+            return None
+        except ValueError as e:
+            return str(e)
+
+    # -- wire: proto roundtrip + aggregated footprint -------------------
+    good = make_agg(real[:6])
+    dec = AggregatedCommit.decode(good.encode())
+    wire = len(good.encode())
+    print(f"  wire : roundtrip={'OK' if dec == good else 'FAIL'} "
+          f"bytes={wire} (96B sig + {(n_vals + 7) // 8}B bitmap, "
+          f"not {n_vals} per-sig rows)")
+    if dec != good:
+        rc = 1
+
+    # -- fused K=4 launch: codes + blame parity -------------------------
+    _epoch.reset(8)
+    _epoch.note_valset(vset)
+    _epoch.note_valset(vset)
+    aggs = [
+        good,                                       # valid
+        make_agg(real[:6], forge=True),             # pairing failure
+        make_agg(real[:6], sig=rogue_sig),          # non-subgroup sig
+        make_agg(real[:5] + [rogue_idx]),           # non-subgroup pubkey
+    ]
+    want_errs = [seq_error(a) for a in aggs]
+    pairs = [V.prepare_aggregated_commit(chain_id, vset, bid, 9, a, k_hint=4)
+             for a in aggs]
+    fused = ms.block_concat([blk for blk, _ in pairs])
+    t0 = time.perf_counter()
+    codes = np.asarray(backend.verify_batch_bls_codes(fused))
+    dt = time.perf_counter() - t0
+    from tendermint_tpu.ops import bls_verify as bv
+    want_codes = [bv.CODE_VALID, bv.CODE_PAIRING, bv.CODE_SIG["subgroup"],
+                  bv.CODE_PUB_BASE + rogue_idx]
+    print(f"  codes: {codes.tolist()} want={want_codes} "
+          f"(K=4 fused, {dt:.1f}s)")
+    if codes.tolist() != want_codes:
+        print("  FAIL: fused launch verdict codes diverge from the "
+              "host-prep/kernel contract", file=sys.stderr)
+        rc = 1
+    mism = []
+    for j, ((_, conc), want) in enumerate(zip(pairs, want_errs)):
+        try:
+            conc(codes[j:j + 1])
+            got = None
+        except ValueError as e:
+            got = str(e)
+        if got != want:
+            mism.append((j, want, got))
+    print(f"  blame: {'OK (4/4 byte-identical)' if not mism else 'MISMATCH'}")
+    for j, want, got in mism:
+        print(f"  FAIL row {j}: sequential={want!r} batched={got!r}",
+              file=sys.stderr)
+        rc = 1
+
+    # -- wrong bitmap size: pre-crypto reject, parity, zero launches ----
+    short = make_agg(real[:6])
+    short.signers = BitArray(n_vals + 3)
+    for i in real[:6]:
+        short.signers.set_index(i, True)
+    errs = []
+    for fn in (lambda: V.verify_aggregated_commit(chain_id, vset, bid, 9, short),
+               lambda: V.prepare_aggregated_commit(chain_id, vset, bid, 9,
+                                                   short, k_hint=4)):
+        try:
+            fn()
+            errs.append(None)
+        except ErrInvalidCommitSignatures as e:
+            errs.append(str(e))
+    bitmap_ok = errs[0] is not None and errs[0] == errs[1]
+    print(f"  bitmap: {'OK' if bitmap_ok else 'FAIL'} "
+          f"(both paths: {errs[0]!r})")
+    if not bitmap_ok:
+        rc = 1
+
+    # -- three-lane superbatch: one plan, one launch fn call ------------
+    def ed_block(n, bad=()):
+        rows = []
+        for i in range(n):
+            sk = _ed.gen_priv_key((5000 + i).to_bytes(32, "little"))
+            m = b"agg-ed-%d" % i
+            rows.append((sk.pub_key().bytes(), m,
+                         sk.sign(m) if i not in bad else b"\x07" * 64))
+        return EntryBlock.from_entries(rows)
+
+    def secp_block(n, bad=()):
+        rows = []
+        for i in range(n):
+            sk = _secp.PrivKey((6000 + i).to_bytes(32, "big"))
+            m = b"agg-secp-%d" % i
+            rows.append((sk.pub_key().bytes(), m,
+                         sk.sign(m) if i not in bad else b"\x07" * 64))
+        return EntryBlock.from_entries(rows, scheme="secp256k1")
+
+    class _J:
+        def __init__(self, blk):
+            self.entries = blk
+
+    blocks = [ed_block(10, bad=(4,)), secp_block(7, bad=(2,)), fused]
+    jobs = [_J(b) for b in blocks]
+    plan, held = ms.pack_jobs(jobs, 4)
+    sblock, spans = ms.build_superblock(plan)
+    is_super = isinstance(sblock, ms.SchemeSuperBlock)
+    parts = [(s, len(b)) for s, b, _ in sblock.parts] if is_super else []
+    bls_w = dict((s, n) for s, n in parts).get("bls12381")
+    print(f"  lanes: schemes={plan.schemes()} parts={parts} "
+          f"rows={plan.bucket}")
+    if held or not is_super or bls_w != 4:
+        print("  FAIL: three-lane plan must build one SchemeSuperBlock "
+              "with the BLS segment at quantized width 4", file=sys.stderr)
+        rc = 1
+    res = ms.prepare_superbatch(sblock, plan)
+    f, fargs = res[0], res[1]
+    shardings = res[4] if len(res) > 4 else None
+    arr = np.asarray(f(*dp.transfer(fargs, shardings=shardings)))
+    by_job = {id(j): (off, n) for j, off, n in spans}
+    lane_ok = True
+    for j, want in ((jobs[0], np.asarray(backend.verify_batch(blocks[0]))),
+                    (jobs[1], np.asarray(backend.verify_batch(blocks[1])))):
+        off, n = by_job[id(j)]
+        lane_ok &= np.array_equal(arr[off:off + n].astype(bool), want)
+    off, n = by_job[id(jobs[2])]
+    lane_ok &= arr[off:off + n].tolist() == want_codes
+    print(f"  launch: 1 fn call, demux parity "
+          f"{'OK' if lane_ok else 'MISMATCH'} "
+          f"(ed25519 + secp256k1 bool rows, bls12381 code row)")
+    if not lane_ok:
+        rc = 1
+
+    # -- pipeline: three submissions fuse into ONE dispatch, no leak ----
+    tr.TRACER.clear()
+    tr.configure(enabled=True)
+    v = pl.AsyncBatchVerifier(depth=2, mesh_lanes=4)
+    try:
+        futs = [v.submit(b) for b in blocks]
+        rows = [np.asarray(fu.result(timeout=600)) for fu in futs]
+        drain_pool(v._pool)
+        pool = v._pool.stats()
+    finally:
+        tr.configure(enabled=False)
+        v.close()
+    launches = sum(1 for name, *_ in tr.TRACER.events()
+                   if name == "pipeline.dispatch")
+    pipe_ok = (np.array_equal(rows[0].astype(bool),
+                              np.asarray(backend.verify_batch(blocks[0])))
+               and np.array_equal(rows[1].astype(bool),
+                                  np.asarray(backend.verify_batch(blocks[1])))
+               and rows[2].tolist() == want_codes)
+    print(f"  pipeline: launches={launches} parity="
+          f"{'OK' if pipe_ok else 'MISMATCH'} pool={pool}")
+    if launches != 1:
+        print(f"  FAIL: three same-window submissions must fuse into one "
+              f"dispatch, saw {launches}", file=sys.stderr)
+        rc = 1
+    if not pipe_ok:
+        rc = 1
+    if pool["in_flight"] != 0:
+        print(f"  FAIL: {pool['in_flight']} pool slots leaked",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_light(args) -> int:
     """--light: the round-11 light-service gate on a mocked relay (slow
     readback over REAL kernels — verdicts are live). Asserts the three
@@ -2226,6 +2507,16 @@ def main() -> int:
         "bit-for-bit (incl. non-lower-S rejection)",
     )
     ap.add_argument(
+        "--bls",
+        action="store_true",
+        help="round-20 gate: the BLS12-381 aggregation lane — K "
+        "aggregated commits (one signature + signer bitmap each) verify "
+        "in ONE fused multi-pairing launch with verdict codes and blame "
+        "byte-identical to the pure-Python reference, incl. crafted "
+        "non-subgroup G1/G2 points and the pre-crypto bitmap reject; an "
+        "ed25519+secp256k1+bls three-lane superbatch is one launch",
+    )
+    ap.add_argument(
         "--soak",
         action="store_true",
         help="round-16 gate: soak-harness hygiene on a mocked relay — "
@@ -2243,6 +2534,8 @@ def main() -> int:
         return run_mesh(args)
     if args.schemes:
         return run_schemes(args)
+    if args.bls:
+        return run_bls(args)
     if args.light:
         return run_light(args)
     if args.ingress:

@@ -36,6 +36,7 @@ WHITELIST = frozenset({
     "tendermint_tpu/ops/sharded.py",
     "tendermint_tpu/ops/mesh.py",          # mesh-dispatcher packing + prep
     "tendermint_tpu/ops/mixed.py",
+    "tendermint_tpu/ops/bls_verify.py",    # BLS pairing kernel definitions
     "tendermint_tpu/ops/_testing.py",      # test scaffolding, not production
 })
 
@@ -62,6 +63,14 @@ ENTRY_POINTS = frozenset({
     "epoch_tables_sharded",
     "sharded_xla_tables",
     "prepare_superbatch",
+    # BLS aggregation lane (ISSUE 20): the fused multi-pairing launch
+    # builders and the direct code-row path — aggregated commits must
+    # reach the device through AsyncBatchVerifier / the mesh, never by
+    # jitting the pairing kernels at the call site
+    "jitted_bls_verify",
+    "jitted_bls_finalexp",
+    "bls_kernel",
+    "verify_batch_bls_codes",
     # mocked-relay device doubles (ISSUE 11): these REPLACE the relay for
     # benches/gates — production code (the light service's dispatch path
     # included) must route through AsyncBatchVerifier, never wire a mock
